@@ -1,0 +1,24 @@
+"""flink_ms_tpu — a TPU-native framework with the capabilities of mmziyad/flink-ms.
+
+Not a port: the reference's Flink DataSet/DataStream pipelines are re-designed as
+sharded JAX arrays on a TPU mesh (pjit/shard_map + XLA collectives), and its
+queryable-state serving layer as a device-resident sharded model table behind a
+host lookup server. See SURVEY.md for the structural analysis of the reference
+and the layer-by-layer parity map.
+
+Package layout
+--------------
+core/      flags (ParameterTool-parity parser), text-format contracts, IO
+parallel/  device mesh bootstrap, sharding helpers
+ops/       numerical kernels: blocked ALS, CoCoA/SDCA SVM, online SGD math
+models/    model containers (factor models, linear models)
+train/     training CLIs (als_train, svm_train) — parity with ALSImpl/SVMImpl
+serve/     sharded model table, ingest journal, state backends, lookup server
+online/    streaming online-SGD updater (closes the loop into serving)
+eval/      MSE evaluator, mean-vector job
+gen/       synthetic model generators
+client/    predict REPLs + random-load latency harnesses
+utils/     logging, misc
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,65 @@
+import pytest
+
+from flink_ms_tpu.core.params import Params, field_delimiter_from
+
+
+def test_basic_kv():
+    p = Params.from_args(["--input", "/tmp/x", "--iterations", "20"])
+    assert p.get("input") == "/tmp/x"
+    assert p.get_int("iterations", 10) == 20
+    assert p.get_int("numFactors", 10) == 10
+
+
+def test_single_dash_and_bare_flags():
+    p = Params.from_args(["-topic", "models", "--partition", "--range", "500"])
+    assert p.get("topic") == "models"
+    assert p.has("partition")
+    assert p.get_bool("partition") is True
+    assert p.get_int("range", 1000) == 500
+
+
+def test_bool_values():
+    p = Params.from_args(["--partition", "true", "--ignoreFirstLine", "false"])
+    assert p.get_bool("partition") is True
+    assert p.get_bool("ignoreFirstLine", True) is False
+    assert p.get_bool("absent", True) is True
+
+
+def test_negative_number_values():
+    p = Params.from_args(["--thresholdValue", "-0.5"])
+    assert p.get_float("thresholdValue") == -0.5
+
+
+def test_required():
+    p = Params.from_args(["--jobId", "abc"])
+    assert p.get_required("jobId") == "abc"
+    with pytest.raises(KeyError):
+        p.get_required("input")
+
+
+def test_trailing_bare_flag():
+    p = Params.from_args(["--continuous"])
+    assert p.has("continuous")
+    assert p.get("continuous") is None  # no value attached
+
+
+def test_non_flag_token_rejected():
+    with pytest.raises(ValueError):
+        Params.from_args(["input", "/tmp/x"])
+
+
+def test_field_delimiter_mapping():
+    assert field_delimiter_from(Params.from_args([])) == ","
+    assert field_delimiter_from(Params.from_args(["--fieldDelimiter", "tab"])) == "\t"
+    assert field_delimiter_from(Params.from_args(["--fieldDelimiter", "comma"])) == ","
+    # SGD/MSE default to a literal tab (SGD.java:106)
+    assert field_delimiter_from(Params.from_args([]), default="tab") == "\t"
+
+
+def test_properties_passthrough():
+    p = Params.from_args(
+        ["--topic", "m", "--bootstrap.servers", "h:9092", "--group.id", "g"]
+    )
+    props = p.properties()
+    assert props["bootstrap.servers"] == "h:9092"
+    assert props["group.id"] == "g"
