@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Headline benchmark: blocked-ALS training throughput (sec/iter) at
+MovieLens-20M scale, rank 50 — the BASELINE.md north-star config.
+
+Prints ONE JSON line:
+  {"metric": "als_ml20m_sec_per_iter", "value": N, "unit": "s/iter",
+   "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md), so the comparison baseline
+is measured in-process: the identical XLA program on the host CPU backend
+(all cores — the single-machine stand-in for the reference's TaskManager
+cluster), timed at a reduced nnz and scaled linearly to the full config.
+vs_baseline > 1 means the TPU path is that many times faster. Override via
+env BENCH_BASELINE_SEC_PER_ITER to pin an externally measured Flink baseline.
+
+Env knobs: BENCH_NNZ, BENCH_USERS, BENCH_ITEMS, BENCH_RANK, BENCH_ITERS,
+BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def synth_ratings(n_users, n_items, nnz, seed=0):
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, n_users, nnz)
+    items = rng.integers(0, n_items, nnz)
+    ratings = rng.uniform(1.0, 5.0, nnz)
+    return users, items, ratings
+
+
+def time_fit(mesh, problem, cfg_base, iters, users, items, ratings):
+    """Steady-state sec/iter: same compiled program (dynamic trip count),
+    timed at 1 iteration and at `iters`, difference isolates per-iter cost."""
+    import dataclasses
+
+    from flink_ms_tpu.ops.als import ALSConfig, als_fit
+
+    iters = max(iters, 2)  # need two points to isolate per-iter cost
+
+    def run(n_it):
+        cfg = dataclasses.replace(cfg_base, iterations=n_it)
+        t0 = time.time()
+        als_fit(users, items, ratings, cfg, mesh, problem=problem)
+        return time.time() - t0
+
+    run(1)  # compile + warmup
+    t1 = run(1)
+    tn = run(iters)
+    return max((tn - t1) / (iters - 1), 1e-9)
+
+
+def main() -> None:
+    small = os.environ.get("BENCH_SMALL") == "1"
+    n_users = int(os.environ.get("BENCH_USERS", 20_000 if small else 138_493))
+    n_items = int(os.environ.get("BENCH_ITEMS", 2_000 if small else 26_744))
+    nnz = int(os.environ.get("BENCH_NNZ", 500_000 if small else 20_000_000))
+    rank = int(os.environ.get("BENCH_RANK", 16 if small else 50))
+    iters = int(os.environ.get("BENCH_ITERS", 3 if small else 5))
+
+    import jax
+
+    from flink_ms_tpu.ops.als import ALSConfig, prepare_blocked
+    from flink_ms_tpu.parallel.mesh import make_mesh
+
+    users, items, ratings = synth_ratings(n_users, n_items, nnz)
+    cfg = ALSConfig(num_factors=rank, iterations=1, lambda_=0.1, seed=42)
+
+    accel = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    mesh = make_mesh(devices=accel)
+    _log(f"[bench] devices: {accel}, nnz={nnz}, rank={rank}")
+
+    t0 = time.time()
+    problem = prepare_blocked(users, items, ratings, mesh.devices.size)
+    _log(f"[bench] prepare_blocked: {time.time() - t0:.1f}s")
+
+    sec_per_iter = time_fit(mesh, problem, cfg, iters, users, items, ratings)
+    _log(f"[bench] TPU steady-state: {sec_per_iter:.3f} s/iter")
+
+    baseline_env = os.environ.get("BENCH_BASELINE_SEC_PER_ITER")
+    if baseline_env:
+        baseline = float(baseline_env)
+    elif os.environ.get("BENCH_SKIP_CPU") == "1":
+        baseline = sec_per_iter  # vs_baseline = 1.0, no comparison available
+    else:
+        # CPU stand-in baseline at reduced nnz, scaled linearly to full nnz
+        cpu_nnz = min(nnz, 2_000_000)
+        cpu_dev = jax.devices("cpu")
+        cpu_mesh = make_mesh(devices=cpu_dev[:1])
+        cu, ci, cr = users[:cpu_nnz], items[:cpu_nnz], ratings[:cpu_nnz]
+        cpu_problem = prepare_blocked(cu, ci, cr, 1)
+        cpu_spi = time_fit(cpu_mesh, cpu_problem, cfg, 2, cu, ci, cr)
+        baseline = cpu_spi * (nnz / cpu_nnz)
+        _log(
+            f"[bench] CPU stand-in: {cpu_spi:.3f} s/iter @ {cpu_nnz} nnz "
+            f"-> scaled {baseline:.3f} s/iter @ {nnz}"
+        )
+
+    print(
+        json.dumps(
+            {
+                "metric": "als_ml20m_sec_per_iter" if not small else "als_small_sec_per_iter",
+                "value": round(sec_per_iter, 4),
+                "unit": "s/iter",
+                "vs_baseline": round(baseline / sec_per_iter, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

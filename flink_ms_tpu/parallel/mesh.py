@@ -1,0 +1,51 @@
+"""Device-mesh bootstrap and sharding helpers.
+
+The reference distributes work over Flink TaskManager slots (`setBlocks` /
+`setParallelism`); the TPU-native equivalent is a `jax.sharding.Mesh` whose
+single "blocks" axis plays the role of the reference's block/parallelism
+count (SURVEY.md §2.3).  Intra-slice exchanges ride ICI via XLA collectives
+(`all_gather` for factor broadcast, `psum` for CoCoA averaging); multi-host
+scaling layers DCN on top through `jax.distributed` without code changes
+here — the mesh simply spans more devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BLOCK_AXIS = "blocks"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the first `n_devices` devices (default: all).
+
+    The reference's `--blocks`/`--parallelism` flags map to the mesh size;
+    a block count larger than the device count is handled inside the kernels
+    by stacking multiple logical blocks per device.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (BLOCK_AXIS,))
+
+
+def block_sharding(mesh: Mesh, *, rank: int = 2) -> NamedSharding:
+    """Shard the leading axis over the block axis, replicate the rest."""
+    return NamedSharding(mesh, P(BLOCK_AXIS, *([None] * (rank - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def num_blocks(mesh: Mesh) -> int:
+    return mesh.shape[BLOCK_AXIS]

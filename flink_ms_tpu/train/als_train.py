@@ -1,0 +1,109 @@
+"""ALS training CLI — TPU-native counterpart of ``ALSImpl``
+(``flink-als/src/main/scala/de/tub/it4bi/ALSImpl.scala``).
+
+Accepts the reference's flag inventory (SURVEY.md Appendix A) and writes the
+same ``id,U|I,f1;f2;...`` model rows, so downstream tools (mean-vector job,
+producer/consumer, clients) interoperate with files from either framework.
+
+Flags beyond the reference (TPU-native surface):
+  --implicit true      confidence-weighted implicit-feedback ALS (BASELINE.md)
+  --alpha 40.0         implicit confidence scale
+  --devices N          mesh size (defaults to all visible devices; the
+                       reference's --blocks maps to Flink's internal blocking
+                       and is accepted — blocking here always equals the mesh)
+
+``--temporaryPath`` (reference: stage loop intermediates to disk,
+ALSImpl.scala:42-44) is accepted and stages a copy of the final factors
+under that path; the training loop itself is one fused XLA program, so
+there are no per-iteration host-side intermediates to spill.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from ..core import formats as F
+from ..core.params import Params, field_delimiter_from
+from ..ops.als import ALSConfig, ALSModel, als_fit, rmse
+from ..parallel.mesh import make_mesh
+
+
+def run(params: Params) -> ALSModel | None:
+    if not params.has("input"):
+        print("Use --input to specify file input.")
+        return None
+
+    delim = field_delimiter_from(params)
+    users, items, ratings = F.read_ratings(
+        params.get_required("input"),
+        field_delimiter=delim,
+        ignore_first_line=params.get_bool("ignoreFirstLine", True),
+    )
+
+    config = ALSConfig(
+        num_factors=params.get_int("numFactors", 10),
+        iterations=params.get_int("iterations", 10),
+        lambda_=params.get_float("lambda", 0.9),
+        seed=params.get_int("seed", 42),
+        implicit=params.get_bool("implicit", False),
+        alpha=params.get_float("alpha", 40.0),
+    )
+
+    n_devices = params.get_int("devices")
+    blocks = params.get_int("blocks")
+    import jax
+
+    avail = len(jax.devices())
+    if n_devices is None:
+        # --blocks larger than the device count is legal in the reference
+        # (more blocks than slots); here blocking == mesh size, capped
+        n_devices = min(blocks, avail) if blocks is not None else avail
+    mesh = make_mesh(n_devices)
+
+    t0 = time.time()
+    model = als_fit(users, items, ratings, config, mesh)
+    train_s = time.time() - t0
+    print(
+        f"[ALS] model-training: {len(users)} ratings, "
+        f"{len(model.user_ids)} users x {len(model.item_ids)} items, "
+        f"k={config.num_factors}, {config.iterations} iters, "
+        f"{mesh.devices.size} device(s), {train_s:.2f}s "
+        f"({train_s / max(config.iterations, 1):.3f} s/iter), "
+        f"train RMSE={rmse(model, users, items, ratings):.4f}"
+    )
+
+    if params.has("temporaryPath"):
+        tmp = params.get_required("temporaryPath").rstrip("/")
+        F.write_als_model(f"{tmp}/userFactors", model.user_ids, F.USER, model.user_factors)
+        F.write_als_model(f"{tmp}/itemFactors", model.item_ids, F.ITEM, model.item_factors)
+
+    if params.has("itemFactors") and params.has("userFactors"):
+        F.write_als_model(
+            params.get_required("itemFactors"), model.item_ids, F.ITEM, model.item_factors
+        )
+        F.write_als_model(
+            params.get_required("userFactors"), model.user_ids, F.USER, model.user_factors
+        )
+    else:
+        print(
+            "Printing results to stdout. Use --itemFactors and --userFactors "
+            "to specify output locations."
+        )
+        print("==== USER FACTORS ====")
+        for id_, row in zip(model.user_ids, model.user_factors):
+            print(F.format_als_row(id_, F.USER, row))
+        print("==== ITEM FACTORS ====")
+        for id_, row in zip(model.item_ids, model.item_factors):
+            print(F.format_als_row(id_, F.ITEM, row))
+    return model
+
+
+def main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,18 +2,26 @@
 
 Multi-chip TPU hardware isn't available in CI; per SURVEY.md §4 the
 multi-device code paths are validated by host simulation
-(``xla_force_host_platform_device_count``).  These env vars must be set
-before jax initializes its backends, hence a conftest at the root.
+(``xla_force_host_platform_device_count``).
+
+Note: this image's sitecustomize pre-imports jax and pins
+``jax_platforms='axon,cpu'`` (the single-chip TPU tunnel), so setting
+JAX_PLATFORMS in the environment is NOT enough — the config object must be
+updated before the first backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
