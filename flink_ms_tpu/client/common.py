@@ -1,0 +1,33 @@
+"""Shared client plumbing (positional-arg parsing used by the REPL clients —
+``ALSPredict.java:26-35``, ``SVMPredict.java:23-34``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..serve.client import QueryClient
+
+
+def repl_client_from_argv(argv: Sequence[str], usage: str) -> QueryClient:
+    if len(argv) == 0:
+        raise ValueError(
+            "Missing required job ID argument. Usage: " + usage
+        )
+    job_id = argv[0]
+    host = argv[1] if len(argv) > 1 else "localhost"
+    port = int(argv[2]) if len(argv) > 2 else 6123
+    print(f"Using JobManager {host}:{port}")
+    return QueryClient(host=host, port=port, timeout_s=5.0, job_id=job_id)
+
+
+def parse_factors(payload: str) -> List[float]:
+    return [float(t) for t in payload.split(";") if t]
+
+
+def read_lines(prompt: str = "$ "):
+    """Console REPL line source (jline ConsoleReader stand-in)."""
+    while True:
+        try:
+            yield input(prompt)
+        except EOFError:
+            return

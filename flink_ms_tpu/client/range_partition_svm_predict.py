@@ -1,0 +1,93 @@
+"""Range-partitioned SVM latency harness (query-per-bucket) — counterpart of
+``RangePartitionSVMPredict`` (``flink-queryable-client/.../qs/RangePartitionSVMPredict.java``).
+
+Same random sparse vectors as the per-feature harness, but features are
+grouped by ``bucket = featureID / range`` (:60-70) and the model is queried
+once per bucket; the ``idx:w;...`` bucket payload is parsed client-side and
+matched against the query features (:80-101).  This is the client half of
+the serving-side range-partitioning optimization produced by
+``SVMImpl --partition`` (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from collections import defaultdict
+from typing import Dict
+
+import numpy as np
+
+from ..core import formats as F
+from ..core.params import Params
+from ..serve.client import QueryClient
+from ..serve.consumer import SVM_STATE
+from .svm_predict import decide
+from .svm_predict_random import random_sparse_vector
+
+
+def run(params: Params) -> int:
+    host = params.get("jobManagerHost", "localhost")
+    port = params.get_int("jobManagerPort", 6123)
+    timeout = params.get_int("queryTimeout", 5)
+    num_queries = params.get_int("numQueries", 1000)
+    output_decision = params.get_bool("outputDecisionFunction", False)
+    threshold = params.get_float("thresholdValue", 0.0)
+    max_features = int(params.get_required("maxNoOfFeatures"))
+    min_pct = params.get_int("minPercentageOfFeatures", 10)
+    range_ = params.get_int("range", 1000)
+    out_file = params.get_required("outputFile")
+    job_id = params.get_required("jobId")
+
+    rng = np.random.default_rng()
+    rows = []
+    with QueryClient(host, port, timeout, job_id) as client:
+        for qid in range(num_queries):
+            vec = random_sparse_vector(rng, max_features, min_pct)
+            by_bucket: Dict[int, Dict[int, float]] = defaultdict(dict)
+            for fid, val in vec.items():
+                by_bucket[fid // range_][fid] = val
+
+            raw_value = 0.0
+            t0 = time.perf_counter()
+            for bucket, feats in by_bucket.items():
+                try:
+                    payload = client.query_state(SVM_STATE, str(bucket))
+                    if payload is None:
+                        print(
+                            f"The current Range of Keys {bucket} do not exist "
+                            "in the model. "
+                        )
+                        continue
+                    ref: Dict[int, float] = {}
+                    for tok in payload.split(";"):
+                        if not tok:
+                            continue
+                        idx_s, w_s = tok.split(":")
+                        ref[int(idx_s)] = float(w_s)
+                    for fid, val in feats.items():
+                        if fid in ref:
+                            raw_value += val * ref[fid]
+                except Exception as e:
+                    print(
+                        "current query failed because of the following "
+                        f"Exception:\n{e}"
+                    )
+            prediction = decide(raw_value, output_decision, threshold)
+            ms = (time.perf_counter() - t0) * 1000.0
+            rows.append(F.format_svm_latency_row(qid, len(vec), prediction, ms))
+    F.write_lines(out_file, rows)
+    print(
+        "Output is written in the format: "
+        "query ID, number of features in the query, prediction, "
+        "query time in milliseconds"
+    )
+    return len(rows)
+
+
+def main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    main()

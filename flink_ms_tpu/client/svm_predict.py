@@ -1,0 +1,69 @@
+"""SVM console client — counterpart of ``SVMPredict``
+(``flink-queryable-client/.../qs/SVMPredict.java``).
+
+REPL: sparse vector ``idx:val idx:val ...`` -> one ``SVM_MODEL`` query per
+feature, accumulating w.x (:63-79); prediction is the raw decision value or
+the sign against a threshold (:80-86 — the client-side replica of FlinkML's
+ThresholdValue/OutputDecisionFunction semantics).
+
+Positional args: jobID [host] [port] [outputDecisionFunction] [thresholdValue].
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable
+
+from ..serve.client import QueryClient
+from ..serve.consumer import SVM_STATE
+from .common import read_lines, repl_client_from_argv
+
+USAGE = (
+    "python -m flink_ms_tpu.client.svm_predict <jobID> [jobManagerHost] "
+    "[jobManagerPort] [outputDecisionFunction] [thresholdValue]"
+)
+
+
+def decide(raw_value: float, output_decision_function: bool, threshold: float) -> float:
+    if output_decision_function:
+        return raw_value
+    return 1.0 if raw_value > threshold else -1.0
+
+
+def run(
+    client: QueryClient,
+    lines: Iterable[str],
+    output_decision_function: bool = False,
+    threshold: float = 0.0,
+    out=sys.stdout,
+) -> None:
+    print("Enter Vector data to predict.", file=out)
+    for line in lines:
+        if not line.strip():
+            continue
+        print(f"[info] Querying the model for vector '{line}' ", file=out)
+        try:
+            raw_value = 0.0
+            for tok in line.strip().split(" "):
+                fid, val_s = tok.split(":")
+                payload = client.query_state(SVM_STATE, fid)
+                if payload is None:
+                    print(f"Could not find the value for feature ID: {fid} ", file=out)
+                    continue
+                raw_value += float(payload) * float(val_s)
+            prediction = decide(raw_value, output_decision_function, threshold)
+            print(f"SVM Prediction =  {prediction:f}", file=out)
+        except Exception as e:
+            print(f"Query failed because of the following Exception:\n{e}", file=out)
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    output_decision = len(argv) > 3 and argv[3].lower() == "true"
+    threshold = float(argv[4]) if len(argv) > 4 else 0.0
+    with repl_client_from_argv(argv, USAGE) as client:
+        run(client, read_lines(), output_decision, threshold)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""SVM random-load latency harness (query-per-feature) — counterpart of
+``SVMPredictRandom`` (``flink-queryable-client/.../qs/SVMPredictRandom.java``).
+
+Each query builds a random sparse vector with between
+``maxNoOfFeatures*minPercentageOfFeatures/100`` and ``maxNoOfFeatures``
+distinct features (ids 1..maxNoOfFeatures, values U(0,1) — :56-63), issues
+one state query per feature (:68-81), and logs ``qId,nFeatures,prediction,ms``
+(:89-93).  Missing features are skipped (contribute 0).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+from ..core import formats as F
+from ..core.params import Params
+from ..serve.client import QueryClient
+from ..serve.consumer import SVM_STATE
+from .svm_predict import decide
+
+
+def random_sparse_vector(rng, max_features: int, min_pct: int) -> Dict[int, float]:
+    min_val = max_features * min_pct // 100
+    n = int(rng.integers(min_val, max_features)) if max_features > min_val else min_val
+    vec: Dict[int, float] = {}
+    for _ in range(n):
+        vec[int(rng.integers(1, max_features + 1))] = float(rng.uniform())
+    return vec
+
+
+def run(params: Params) -> int:
+    host = params.get("jobManagerHost", "localhost")
+    port = params.get_int("jobManagerPort", 6123)
+    timeout = params.get_int("queryTimeout", 5)
+    num_queries = params.get_int("numQueries", 1000)
+    output_decision = params.get_bool("outputDecisionFunction", False)
+    threshold = params.get_float("thresholdValue", 0.0)
+    max_features = int(params.get_required("maxNoOfFeatures"))
+    min_pct = params.get_int("minPercentageOfFeatures", 10)
+    out_file = params.get_required("outputFile")
+    job_id = params.get_required("jobId")
+
+    rng = np.random.default_rng()
+    rows = []
+    with QueryClient(host, port, timeout, job_id) as client:
+        for qid in range(num_queries):
+            vec = random_sparse_vector(rng, max_features, min_pct)
+            raw_value = 0.0
+            t0 = time.perf_counter()
+            for fid, val in vec.items():
+                try:
+                    payload = client.query_state(SVM_STATE, str(fid))
+                    if payload is None:
+                        print(f"Feature {fid} do not exist in the model. ")
+                        continue
+                    raw_value += float(payload) * val
+                except Exception as e:
+                    print(
+                        "current query failed because of the following "
+                        f"Exception:\n{e}"
+                    )
+            prediction = decide(raw_value, output_decision, threshold)
+            ms = (time.perf_counter() - t0) * 1000.0
+            rows.append(F.format_svm_latency_row(qid, len(vec), prediction, ms))
+    F.write_lines(out_file, rows)
+    print(
+        "Output is written in the format:"
+        "query ID, number of features in the query, prediction, "
+        "query time in milliseconds"
+    )
+    return len(rows)
+
+
+def main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    main()

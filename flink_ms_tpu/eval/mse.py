@@ -1,0 +1,171 @@
+"""MSE evaluator — counterpart of ``MSE``
+(``als-ms/src/main/java/de/tub/it4bi/modelserving/evaluation/MSE.java``).
+
+Evaluates mean squared error of a ratings set against an ALS model.  Two
+sources, matching the reference's deployment shape plus an offline mode:
+
+- **live** (reference parity): queries the serving layer one user per group
+  and one item per rating (MSE.java:122-159) through the query client —
+  flags ``--jobId --jobManagerHost --jobManagerPort --queryTimeout``.
+- **offline** (``--model path[,path...]``): reads model row files directly
+  and computes predictions as one batched device op.
+
+Skip semantics preserved from the reference: a missing user drops that
+user's whole group (MSE.java:137-139 ``break``), a missing item drops just
+that rating (:156-158) — minus the reference's NPE ordering bug (SURVEY.md
+Appendix C #7).  Input CSV always skips the first line (MSE.java:43
+``ignoreFirstLine()``).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core import formats as F
+from ..core.params import Params, field_delimiter_from
+
+
+def _load_model_tables(paths: str) -> Dict[str, np.ndarray]:
+    """Read ALS rows from comma-separated paths into a {key: factors} map
+    keyed like the serving state: ``"<id>-U"`` / ``"<id>-I"``
+    (ALSKafkaConsumer.java:75-82)."""
+    table: Dict[str, np.ndarray] = {}
+    for path in paths.split(","):
+        for line in F.iter_lines(path):
+            id_, typ, vec = F.parse_als_row(line)
+            table[f"{id_}-{typ}"] = vec
+    return table
+
+
+def compute_mse(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    lookup,
+) -> Tuple[Optional[float], int, int]:
+    """Reference group/skip semantics over an arbitrary key->factors lookup.
+
+    Returns (mse | None if nothing scored, n_scored, n_skipped).
+    """
+    sq_sum = 0.0
+    n_scored = 0
+    n_skipped = 0
+    user_cache: Dict[int, Optional[np.ndarray]] = {}
+    for u in np.unique(users):
+        uf = user_cache.get(u)
+        if u not in user_cache:
+            uf = lookup(f"{u}-U")
+            user_cache[u] = uf
+        sel = users == u
+        if uf is None:
+            print(f"No record found for the user ID: {u}-U", file=sys.stderr)
+            n_skipped += int(sel.sum())
+            continue
+        for it, r in zip(items[sel], ratings[sel]):
+            itf = lookup(f"{it}-I")
+            if itf is None:
+                print(
+                    f"No record found for the itemID query: {it}-I", file=sys.stderr
+                )
+                n_skipped += 1
+                continue
+            pred = float(np.dot(uf, itf))
+            sq_sum += (r - pred) ** 2
+            n_scored += 1
+    return (sq_sum / n_scored if n_scored else None), n_scored, n_skipped
+
+
+def _compute_mse_offline_batched(
+    users, items, ratings, table: Dict[str, np.ndarray]
+) -> Tuple[Optional[float], int, int]:
+    """Same semantics as compute_mse, but predictions in one device op."""
+    from ..ops.als import ALSModel, predict
+
+    def numeric_ids(suffix: str):
+        out = set()
+        for key in table:
+            if key.endswith(suffix):
+                id_part = key[: -len(suffix)]
+                # model dumps legitimately contain the MEAN cold-start row
+                # (ALSMeanVector.scala:35); only numeric ids are scoreable
+                if id_part.lstrip("-").isdigit():
+                    out.add(int(id_part))
+        return sorted(out)
+
+    u_ids = numeric_ids("-U")
+    i_ids = numeric_ids("-I")
+    if not u_ids or not i_ids:
+        return None, 0, len(ratings)
+    uf = np.stack([table[f"{u}-U"] for u in u_ids])
+    itf = np.stack([table[f"{i}-I"] for i in i_ids])
+    model = ALSModel(
+        user_ids=np.asarray(u_ids),
+        item_ids=np.asarray(i_ids),
+        user_factors=uf,
+        item_factors=itf,
+    )
+    known_u = np.isin(users, model.user_ids)
+    known_i = np.isin(items, model.item_ids)
+    ok = known_u & known_i
+    preds = predict(model, users[ok], items[ok])
+    err = ratings[ok] - preds
+    n_scored = int(ok.sum())
+    return (
+        (float(np.mean(err * err)) if n_scored else None),
+        n_scored,
+        int((~ok).sum()),
+    )
+
+
+def run(params: Params, lookup=None) -> Optional[float]:
+    delim = field_delimiter_from(params, default="tab")
+    users, items, ratings = F.read_ratings(
+        params.get_required("input"), field_delimiter=delim, ignore_first_line=True
+    )
+
+    if params.has("model"):
+        table = _load_model_tables(params.get_required("model"))
+        mse, n_scored, n_skipped = _compute_mse_offline_batched(
+            users, items, ratings, table
+        )
+    else:
+        if lookup is None:
+            from ..serve.client import QueryClient
+
+            client = QueryClient(
+                host=params.get("jobManagerHost", "localhost"),
+                port=params.get_int("jobManagerPort", 6123),
+                timeout_s=params.get_int("queryTimeout", 5),
+            )
+
+            def lookup(key: str):
+                payload = client.query_state("ALS_MODEL", key)
+                if payload is None:
+                    return None
+                # serving values are the factor payload "f1;f2;..."
+                return np.asarray([float(t) for t in payload.split(";") if t])
+
+        mse, n_scored, n_skipped = compute_mse(users, items, ratings, lookup)
+
+    if n_skipped:
+        print(f"skipped {n_skipped} ratings with missing keys", file=sys.stderr)
+    if mse is None:
+        print("No predictions could be made (empty model?)", file=sys.stderr)
+        return None
+    if params.has("output"):
+        F.write_lines(params.get_required("output"), [repr(mse)])
+    else:
+        print("Printing result to stdout. Use --output to specify output path.")
+        print(mse)
+    return mse
+
+
+def main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    main()

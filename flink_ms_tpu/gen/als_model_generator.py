@@ -1,0 +1,97 @@
+"""Synthetic ALS model generator — counterpart of ``ALSModelGenerator``
+(``model-generator/src/main/scala/de/tub/it4bi/ALSModelGenerator.scala``).
+
+"Only for testing the latency and throughput. Not for quality."
+(ALSModelGenerator.scala:12).  Row format and id conventions match the
+reference: ids 1..numUsers typed U then 1..numItems typed I, factor entries
+drawn from the same heavy-tailed ratio distribution
+``nextDouble()/nextDouble() * latentFactors`` (ALSModelGenerator.scala:28-32).
+
+Generation runs as a jitted JAX program in batches (device RNG), so the
+10M-user scale envelope in BASELINE.md is device-bound, not Python-bound.
+``--parallelism p`` (default 2, reference parity) writes a directory of p
+part files named "1".."p" exactly like Flink's parallel ``writeAsText``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import formats as F
+from ..core.params import Params
+
+_BATCH = 1 << 16
+
+
+def _random_factor_batch(key, n_rows: int, latent: int) -> np.ndarray:
+    a, b = jax.random.split(key)
+    num = jax.random.uniform(a, (n_rows, latent), dtype=jnp.float32)
+    den = jax.random.uniform(b, (n_rows, latent), dtype=jnp.float32)
+    # same shape as the reference's nextDouble()/nextDouble() * latentFactors:
+    # ratio of uniforms, scaled (heavy-tailed; occasionally huge)
+    return np.asarray(num / jnp.maximum(den, 1e-12) * latent, dtype=np.float64)
+
+
+def generate_rows(
+    n: int, category: str, latent: int, seed: int = 0
+) -> Iterator[str]:
+    """Rows ``id,U|I,f1;...`` for ids 1..n (reference ids are 1-based —
+    ALSModelGenerator.scala:47-53)."""
+    key = jax.random.PRNGKey(seed)
+    done = 0
+    while done < n:
+        m = min(_BATCH, n - done)
+        key, sub = jax.random.split(key)
+        block = _random_factor_batch(sub, m, latent)
+        for j in range(m):
+            yield F.format_als_row(done + j + 1, category, block[j])
+        done += m
+
+
+def _write_parallel(path: str, rows: Iterator[str], parallelism: int) -> None:
+    if parallelism <= 1:
+        F.write_lines(path, rows)
+        return
+    os.makedirs(path, exist_ok=True)
+    files = [open(os.path.join(path, str(i + 1)), "w") for i in range(parallelism)]
+    try:
+        for n, row in enumerate(rows):
+            f = files[n % parallelism]
+            f.write(row)
+            f.write("\n")
+    finally:
+        for f in files:
+            f.close()
+
+
+def run(params: Params) -> None:
+    num_users = int(params.get_required("numUsers"))
+    num_items = int(params.get_required("numItems"))
+    latent = int(params.get_required("latentFactors"))
+    p = params.get_int("parallelism", 2)
+    seed = params.get_int("seed", 0)
+
+    def all_rows():
+        yield from generate_rows(num_users, F.USER, latent, seed)
+        yield from generate_rows(num_items, F.ITEM, latent, seed + 1)
+
+    if params.has("output"):
+        _write_parallel(params.get_required("output"), all_rows(), p)
+    else:
+        print("Printing results to stdout. Use --output to specify output location")
+        for row in all_rows():
+            print(row)
+
+
+def main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""CLI entry: ALS serving job (see consumer.py; ALSKafkaConsumer parity)."""
+from .consumer import als_main
+
+if __name__ == "__main__":
+    als_main()

@@ -1,0 +1,5 @@
+"""CLI entry: ALS model loader (see producer.py; ALSKafkaProducer parity)."""
+from .producer import als_main
+
+if __name__ == "__main__":
+    als_main()

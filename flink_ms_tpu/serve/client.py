@@ -1,0 +1,105 @@
+"""Query client — the single counterpart of the reference's duplicated
+``QueryClientHelper`` classes (``als-ms/.../utils/QueryClientHelper.java`` and
+``flink-queryable-client/.../QueryClientHelper.java`` are byte-identical;
+SURVEY.md Appendix C #9 says collapse to one — this is the one).
+
+``query_state(name, key)`` returns the value payload or None for unknown
+keys (the reference maps ``UnknownKeyOrNamespaceException`` to
+``Optional.empty()`` — QueryClientHelper.java:135-137).  Network/timeout
+errors raise, matching queryState's throws clause (callers like SGD catch
+and continue — SGD.java:221-227).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+
+class QueryClient:
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = 6123,
+        timeout_s: float = 5.0,
+        job_id: Optional[str] = None,
+    ):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.job_id = job_id  # accepted for reference-CLI parity; the local
+        # lookup server serves a single job, so the id is informational
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+
+    def _connect(self):
+        sock = socket.create_connection((self.host, self.port), self.timeout_s)
+        sock.settimeout(self.timeout_s)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+
+    def _roundtrip(self, request: str) -> str:
+        if self._sock is None:
+            self._connect()
+        try:
+            self._sock.sendall(request.encode("utf-8") + b"\n")
+            line = self._rfile.readline()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # one reconnect attempt (server restart is expected: the serving
+            # job has fixed-delay restart semantics)
+            self.close()
+            self._connect()
+            self._sock.sendall(request.encode("utf-8") + b"\n")
+            line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("lookup server closed the connection")
+        return line.decode("utf-8").rstrip("\n")
+
+    def query_state(self, name: str, key: str) -> Optional[str]:
+        if "\t" in key or "\n" in key:
+            raise ValueError("keys must not contain tabs/newlines")
+        reply = self._roundtrip(f"GET\t{name}\t{key}")
+        if reply.startswith("V\t"):
+            return reply[2:]
+        if reply == "N":
+            return None
+        raise RuntimeError(f"query failed: {reply}")
+
+    def topk(self, name: str, user_id: str, k: int):
+        """Device-scored top-k recommendations for a user; returns a list of
+        (item_id, score) or None if the user is unknown."""
+        reply = self._roundtrip(f"TOPK\t{name}\t{user_id}\t{k}")
+        if reply == "N":
+            return None
+        if not reply.startswith("V\t"):
+            raise RuntimeError(f"topk failed: {reply}")
+        payload = reply[2:]
+        out = []
+        if payload:
+            for tok in payload.split(";"):
+                item, _, score = tok.rpartition(":")
+                out.append((item, float(score)))
+        return out
+
+    def ping(self) -> str:
+        return self._roundtrip("PING")
+
+    def close(self) -> None:
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except OSError:
+                pass
+            self._rfile = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,299 @@
+"""Serving job — counterpart of ``ALSKafkaConsumer`` / ``SVMKafkaConsumer``
+(``als-ms/.../qs/ALSKafkaConsumer.java``, ``svm-ms/.../qs/SVMKafkaConsumer.java``).
+
+Pipeline parity (ALSKafkaConsumer.java:26-92):
+
+    journal topic  ->  poll  ->  parse row  ->  keyed put into the sharded
+    model table    ->  table is queryable through the lookup server
+
+with the reference's operational envelope re-built natively:
+
+- periodic checkpointing, max 1 concurrent (:44-46): a timer thread writes
+  (table snapshot, journal offset) through the selected state backend;
+- fixed-delay restart (3 attempts, 10 s — :48-51): the consume loop is
+  wrapped in a restart supervisor that restores the last checkpoint and
+  replays the journal from the committed offset (at-least-once; duplicate
+  rows are last-writer-wins like ``ValueState``);
+- state backends (:53-65): ``memory`` (snapshots held in RAM),
+  ``fs`` (snapshot dirs under --checkpointDataUri), ``rocksdb`` (the C++
+  persistent store when built, otherwise falls back to ``fs`` with a
+  warning — same selection flag surface).
+
+Key derivation:
+- ALS rows ``id,T,factors`` -> key ``"<id>-<T>"`` (ALSKafkaConsumer.java:75-82)
+- SVM rows ``first,rest``   -> key = raw first CSV token (featureID or
+  bucket — SVMKafkaConsumer.java:74-82)
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import uuid
+from typing import Callable, List, Optional, Tuple
+
+from ..core.params import Params
+from .journal import Journal
+from .server import LookupServer
+from .table import ModelTable
+
+ALS_STATE = "ALS_MODEL"
+SVM_STATE = "SVM_MODEL"
+
+
+def parse_als_record(line: str) -> Tuple[str, str]:
+    id_, typ, payload = line.split(",", 2)
+    return f"{id_}-{typ}", payload
+
+
+def parse_svm_record(line: str) -> Tuple[str, str]:
+    key, _, payload = line.partition(",")
+    return key, payload
+
+
+# ---------------------------------------------------------------------------
+# state backends
+# ---------------------------------------------------------------------------
+
+class MemoryStateBackend:
+    """Snapshots kept in process RAM — survives consume-loop restarts inside
+    the job, lost on process death (MemoryStateBackend parity)."""
+
+    kind = "memory"
+
+    def __init__(self):
+        self._snap: Optional[Tuple[int, List[dict]]] = None
+
+    def snapshot(self, table: ModelTable, offset: int) -> None:
+        with table._lock:
+            self._snap = (offset, [dict(s) for s in table._shards])
+
+    def restore(self, table: ModelTable) -> Optional[int]:
+        if self._snap is None:
+            return None
+        offset, shards = self._snap
+        with table._lock:
+            table._shards = [dict(s) for s in shards]
+        return offset
+
+
+class FsStateBackend:
+    """Snapshot dirs under the checkpoint URI (FsStateBackend parity)."""
+
+    kind = "fs"
+
+    def __init__(self, checkpoint_uri: str):
+        import os
+
+        self.dir = checkpoint_uri
+        os.makedirs(self.dir, exist_ok=True)
+
+    def snapshot(self, table: ModelTable, offset: int) -> None:
+        table.snapshot(self.dir, offset)
+
+    def restore(self, table: ModelTable) -> Optional[int]:
+        return table.restore(self.dir)
+
+
+def make_backend(kind: str, checkpoint_uri: Optional[str]):
+    if kind == "memory":
+        return MemoryStateBackend()
+    if kind == "fs":
+        if not checkpoint_uri:
+            raise ValueError("fs state backend requires --checkpointDataUri")
+        return FsStateBackend(checkpoint_uri)
+    if kind == "rocksdb":
+        if not checkpoint_uri:
+            raise ValueError("rocksdb state backend requires --checkpointDataUri")
+        try:
+            from .native_backend import NativeStateBackend
+
+            return NativeStateBackend(checkpoint_uri)
+        except Exception as e:  # .so not built yet
+            print(
+                f"[serve] native store unavailable ({e}); rocksdb mode "
+                "falling back to fs snapshots",
+                file=sys.stderr,
+            )
+            return FsStateBackend(checkpoint_uri)
+    raise ValueError(f"unknown state backend: {kind} (use rocksdb|fs|memory)")
+
+
+# ---------------------------------------------------------------------------
+# the job
+# ---------------------------------------------------------------------------
+
+class ServingJob:
+    def __init__(
+        self,
+        journal: Journal,
+        state_name: str,
+        parse_fn: Callable[[str], Tuple[str, str]],
+        backend,
+        n_shards: int = 8,
+        checkpoint_interval_ms: int = 60_000,
+        poll_interval_s: float = 0.1,
+        host: str = "0.0.0.0",
+        port: int = 6123,
+        job_id: Optional[str] = None,
+        restart_attempts: int = 3,
+        restart_delay_s: float = 10.0,
+    ):
+        self.journal = journal
+        self.state_name = state_name
+        self.parse_fn = parse_fn
+        self.backend = backend
+        self.table = ModelTable(n_shards)
+        self.checkpoint_interval_s = checkpoint_interval_ms / 1000.0
+        self.poll_interval_s = poll_interval_s
+        self.job_id = job_id or uuid.uuid4().hex
+        self.restart_attempts = restart_attempts
+        self.restart_delay_s = restart_delay_s
+        self.offset = 0
+        self.parse_errors = 0
+        self._stop = threading.Event()
+        self._consumer_thread: Optional[threading.Thread] = None
+        topk_handlers = {}
+        if state_name == ALS_STATE:
+            # device-scored top-k over the live item factors (serve/topk.py)
+            from .topk import make_als_topk_handler
+
+            topk_handlers[state_name] = make_als_topk_handler(self.table)
+        self.server = LookupServer(
+            {state_name: self.table},
+            host=host,
+            port=port,
+            job_id=self.job_id,
+            topk_handlers=topk_handlers,
+        )
+        self.port = self.server.port
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingJob":
+        restored = self.backend.restore(self.table)
+        if restored is not None:
+            self.offset = restored
+            print(
+                f"[serve:{self.state_name}] restored {len(self.table)} rows, "
+                f"journal offset {self.offset}",
+                file=sys.stderr,
+            )
+        self.server.start()
+        self._consumer_thread = threading.Thread(
+            target=self._supervised_consume, name="journal-consumer", daemon=True
+        )
+        self._consumer_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._consumer_thread:
+            self._consumer_thread.join(timeout=10)
+        self.server.stop()
+
+    def wait(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(0.5)
+
+    # -- consume loop with fixed-delay restart -----------------------------
+
+    def _supervised_consume(self) -> None:
+        attempts = 0
+        while not self._stop.is_set():
+            try:
+                self._consume_loop()
+                return  # clean stop
+            except Exception as e:
+                attempts += 1
+                if attempts > self.restart_attempts:
+                    print(
+                        f"[serve:{self.state_name}] giving up after "
+                        f"{self.restart_attempts} restarts: {e}",
+                        file=sys.stderr,
+                    )
+                    self._stop.set()
+                    return
+                print(
+                    f"[serve:{self.state_name}] consume loop failed ({e}); "
+                    f"restart {attempts}/{self.restart_attempts} in "
+                    f"{self.restart_delay_s}s",
+                    file=sys.stderr,
+                )
+                if self._stop.wait(self.restart_delay_s):
+                    return
+                try:
+                    restored = self.backend.restore(self.table)
+                    self.offset = restored if restored is not None else 0
+                except Exception as re:
+                    # a corrupt/missing checkpoint must not kill the
+                    # supervisor thread; continue from the in-memory state
+                    # (at-least-once replay keeps the table convergent)
+                    print(
+                        f"[serve:{self.state_name}] checkpoint restore failed "
+                        f"({re}); continuing from in-memory state at offset "
+                        f"{self.offset}",
+                        file=sys.stderr,
+                    )
+
+    def _consume_loop(self) -> None:
+        last_checkpoint = time.time()
+        while not self._stop.is_set():
+            lines, next_offset = self.journal.read_from(self.offset)
+            for line in lines:
+                if not line:
+                    continue
+                try:
+                    key, value = self.parse_fn(line)
+                except ValueError:
+                    # the reference would fail the task and burn a restart on
+                    # a malformed row; skip-and-count is the deliberate fix
+                    # (SURVEY.md Appendix C decision)
+                    self.parse_errors += 1
+                    continue
+                self.table.put(key, value)
+            self.offset = next_offset
+            now = time.time()
+            if now - last_checkpoint >= self.checkpoint_interval_s:
+                self.backend.snapshot(self.table, self.offset)
+                last_checkpoint = now
+            if not lines:
+                self._stop.wait(self.poll_interval_s)
+
+
+# ---------------------------------------------------------------------------
+# CLIs
+# ---------------------------------------------------------------------------
+
+def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
+    journal = Journal(params.get_required("journalDir"), params.get_required("topic"))
+    backend = make_backend(
+        params.get("stateBackend", "memory"), params.get("checkpointDataUri")
+    )
+    job = ServingJob(
+        journal,
+        state_name,
+        parse_fn,
+        backend,
+        n_shards=params.get_int("shards", 8),
+        checkpoint_interval_ms=params.get_int("checkPointInterval", 60_000),
+        host=params.get("host", "0.0.0.0"),
+        port=params.get_int("port", 6123),
+        job_id=params.get("jobId"),
+    )
+    print(
+        f"[serve] {state_name} serving topic '{journal.topic}' on port "
+        f"{job.port}, jobId={job.job_id}"
+    )
+    return job.start()
+
+
+def als_main(argv=None) -> None:
+    params = Params.from_args(sys.argv[1:] if argv is None else argv)
+    _run_consumer_cli(params, ALS_STATE, parse_als_record).wait()
+
+
+def svm_main(argv=None) -> None:
+    params = Params.from_args(sys.argv[1:] if argv is None else argv)
+    _run_consumer_cli(params, SVM_STATE, parse_svm_record).wait()

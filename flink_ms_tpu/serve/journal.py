@@ -1,0 +1,78 @@
+"""Replayable ingest journal — the TPU-native stand-in for the reference's
+Kafka 0.10 + ZooKeeper model bus (SURVEY.md §2.5).
+
+A topic is an append-only log file under a journal directory.  Producers
+append model rows (``ALSKafkaProducer.java:29-37`` writes with
+``flushOnCheckpoint`` = at-least-once); consumers poll from a byte offset
+and commit that offset in their checkpoints, so replay after failure
+re-delivers rows — duplicates are tolerated by design because the serving
+table is last-writer-wins, exactly like the reference's ``ValueState``
+(``ALSKafkaConsumer.java:85-92``).
+
+The log format is plain text lines, so journals are interoperable with the
+reference's model files and greppable during ops.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Iterable, List, Tuple
+
+
+class Journal:
+    """One topic inside a journal directory."""
+
+    def __init__(self, journal_dir: str, topic: str):
+        if not topic or "/" in topic or topic.startswith("."):
+            raise ValueError(f"invalid topic name: {topic!r}")
+        self.dir = journal_dir
+        self.topic = topic
+        os.makedirs(journal_dir, exist_ok=True)
+        self.path = os.path.join(journal_dir, f"{topic}.log")
+        self._lock = threading.Lock()
+
+    # -- producer side -----------------------------------------------------
+
+    def append(self, lines: Iterable[str], flush: bool = True) -> int:
+        """Append lines; returns the end offset.  ``flush`` fsyncs — the
+        analog of the producer's flushOnCheckpoint (at-least-once)."""
+        with self._lock:
+            with open(self.path, "a") as f:
+                for line in lines:
+                    if "\n" in line:
+                        raise ValueError("journal records are single lines")
+                    f.write(line)
+                    f.write("\n")
+                f.flush()
+                if flush:
+                    os.fsync(f.fileno())
+                return f.tell()
+
+    # -- consumer side -----------------------------------------------------
+
+    def end_offset(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except FileNotFoundError:
+            return 0
+
+    def read_from(self, offset: int, max_bytes: int = 1 << 24) -> Tuple[List[str], int]:
+        """Poll records after `offset`; returns (lines, next_offset).
+
+        Only complete lines are returned; a torn tail (producer mid-append)
+        stays unconsumed until its newline lands.
+        """
+        if not os.path.exists(self.path):
+            return [], offset
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            chunk = f.read(max_bytes)
+        if not chunk:
+            return [], offset
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return [], offset
+        complete = chunk[: last_nl + 1]
+        lines = complete.decode("utf-8").splitlines()
+        return lines, offset + len(complete)

@@ -1,0 +1,50 @@
+"""Model loader — counterpart of ``ALSKafkaProducer`` / ``SVMKafkaProducer``
+(``als-ms/.../qs/ALSKafkaProducer.java``, ``svm-ms/.../qs/SVMKafkaProducer.java``).
+
+Streams model text files (file or nested directory, matching
+``TextInputFormat(nested=true)`` — ALSKafkaProducer.java:24-26) into a
+journal topic with fsync'd appends (at-least-once, the analog of
+``setFlushOnCheckpoint(true)`` — :35-37).
+
+One module serves both ALS and SVM (the reference's two producers are
+copies; SVMKafkaProducer.java:40 even kept the "[ALS]" job name —
+SURVEY.md Appendix C #2).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..core import formats as F
+from ..core.params import Params
+from .journal import Journal
+
+_BATCH = 10_000
+
+
+def run(params: Params, label: str = "ALS") -> int:
+    journal = Journal(
+        params.get_required("journalDir"), params.get_required("topic")
+    )
+    input_path = params.get_required("input")
+    n = 0
+    batch = []
+    for line in F.iter_lines(input_path):
+        batch.append(line)
+        if len(batch) >= _BATCH:
+            journal.append(batch, flush=False)
+            n += len(batch)
+            batch = []
+    if batch:
+        n += len(batch)
+    journal.append(batch, flush=True)  # final fsync = the checkpoint flush
+    print(f"[{label}] model-loading: {n} rows -> topic '{journal.topic}'")
+    return n
+
+
+def als_main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv), label="ALS")
+
+
+def svm_main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv), label="SVM")

@@ -1,0 +1,105 @@
+"""Lookup server — the serving data plane, counterpart of Flink's Netty
+KvState server queried by ``QueryClientHelper.queryState``
+(``QueryClientHelper.java:104-139``).
+
+Line protocol over TCP (persistent connections, thread per client):
+
+    request:  ``GET\\t<state_name>\\t<key>\\n``
+              ``TOPK\\t<state_name>\\t<user_id>\\t<k>\\n``  (device-scored top-k)
+              ``PING\\n``
+    response: ``V\\t<value>\\n``   key found / top-k payload ``item:score;...``
+              ``N\\n``            unknown key (client maps to Optional.empty,
+                                  mirroring UnknownKeyOrNamespace handling)
+              ``E\\t<msg>\\n``    error (unknown state name, bad request)
+              ``PONG\\t<job_id>\\t<state_name>\\n``
+
+A C++ epoll implementation of the same protocol backs the native state
+backend (native/, task: rocksdb-parity mode); this Python server is the
+default and the semantics contract.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Dict, Optional
+
+from .table import ModelTable
+
+
+class LookupServer:
+    def __init__(
+        self,
+        tables: Dict[str, ModelTable],
+        host: str = "0.0.0.0",
+        port: int = 6123,
+        job_id: str = "local",
+        topk_handlers: Optional[Dict[str, object]] = None,
+    ):
+        self.tables = tables
+        self.job_id = job_id
+        self.topk_handlers = topk_handlers or {}
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        line = self.rfile.readline()
+                    except (ConnectionResetError, OSError):
+                        break
+                    if not line:
+                        break
+                    reply = outer._dispatch(line.decode("utf-8").rstrip("\n"))
+                    try:
+                        self.wfile.write(reply.encode("utf-8") + b"\n")
+                    except (BrokenPipeError, OSError):
+                        break
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _dispatch(self, line: str) -> str:
+        parts = line.split("\t")
+        if parts[0] == "PING":
+            return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
+        if parts[0] == "GET" and len(parts) == 3:
+            _, state, key = parts
+            table = self.tables.get(state)
+            if table is None:
+                return f"E\tunknown state: {state}"
+            value = table.get(key)
+            return "N" if value is None else f"V\t{value}"
+        if parts[0] == "TOPK" and len(parts) == 4:
+            _, state, user_id, k_s = parts
+            handler = self.topk_handlers.get(state)
+            if handler is None:
+                return f"E\tno topk index for state: {state}"
+            try:
+                k = int(k_s)
+                if k < 1:
+                    return "E\tk must be >= 1"
+                payload = handler(user_id, k)
+            except Exception as e:
+                return f"E\ttopk failed: {e}"
+            return "N" if payload is None else f"V\t{payload}"
+        return "E\tbad request"
+
+    def start(self) -> "LookupServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="lookup-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

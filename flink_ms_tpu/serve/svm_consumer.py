@@ -1,0 +1,5 @@
+"""CLI entry: SVM serving job (see consumer.py; SVMKafkaConsumer parity)."""
+from .consumer import svm_main
+
+if __name__ == "__main__":
+    svm_main()

@@ -1,0 +1,5 @@
+"""CLI entry: SVM model loader (see producer.py; SVMKafkaProducer parity)."""
+from .producer import svm_main
+
+if __name__ == "__main__":
+    svm_main()

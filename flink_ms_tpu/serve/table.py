@@ -1,0 +1,135 @@
+"""Sharded model table — the TPU-native equivalent of the reference's
+queryable keyed ``ValueState`` (``keyBy(0).asQueryableState("ALS_MODEL", ...)``,
+``ALSKafkaConsumer.java:85-92``).
+
+Keys are strings (``"<id>-U"``, ``"<id>-I"``, feature ids, bucket ids);
+values are the row payloads.  Rows are hash-partitioned into shards exactly
+like Flink routes keys to operator subtasks; last-writer-wins per key.
+
+Snapshots are plain ``key\\tvalue`` TSV per shard plus a JSON manifest with
+the journal offset — the unit of the checkpoint/restore cycle
+(``enableCheckpointing`` + state backend selection,
+``ALSKafkaConsumer.java:44-65``).  The TSV format is deliberately
+language-neutral so the C++ persistent backend can share it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+MANIFEST = "MANIFEST.json"
+
+
+def _fnv1a(s: str) -> int:
+    """Stable 32-bit FNV-1a — shard routing must not depend on Python's
+    per-process hash randomization."""
+    h = 0x811C9DC5
+    for ch in s.encode("utf-8"):
+        h ^= ch
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+class ModelTable:
+    def __init__(self, n_shards: int = 8):
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.n_shards = n_shards
+        self._shards: List[Dict[str, str]] = [dict() for _ in range(n_shards)]
+        self._lock = threading.RLock()
+        self.puts = 0  # ingest counter (observability)
+
+    def shard_of(self, key: str) -> int:
+        return _fnv1a(key) % self.n_shards
+
+    def put(self, key: str, value: str) -> None:
+        with self._lock:
+            self._shards[self.shard_of(key)][key] = value
+            self.puts += 1
+
+    def get(self, key: str) -> Optional[str]:
+        return self._shards[self.shard_of(key)].get(key)
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    def items(self) -> Iterator[Tuple[str, str]]:
+        with self._lock:
+            snap = [dict(s) for s in self._shards]
+        for s in snap:
+            yield from s.items()
+
+    # -- snapshot / restore -------------------------------------------------
+
+    def snapshot(self, checkpoint_dir: str, offset: int) -> str:
+        """Write a consistent (table, journal offset) snapshot; returns the
+        checkpoint path.  Atomic via tmp-dir + rename; a ``latest`` pointer
+        file names the newest complete checkpoint."""
+        with self._lock:
+            shards_copy = [dict(s) for s in self._shards]
+        chk_id = f"chk-{int(time.time() * 1000)}"
+        tmp = os.path.join(checkpoint_dir, f".tmp-{chk_id}")
+        os.makedirs(tmp, exist_ok=True)
+        for idx, shard in enumerate(shards_copy):
+            with open(os.path.join(tmp, f"shard-{idx}.tsv"), "w") as f:
+                for k, v in shard.items():
+                    f.write(f"{k}\t{v}\n")
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(
+                {"offset": offset, "n_shards": self.n_shards, "ts": time.time()}, f
+            )
+        final = os.path.join(checkpoint_dir, chk_id)
+        os.rename(tmp, final)
+        with open(os.path.join(checkpoint_dir, "latest.tmp"), "w") as f:
+            f.write(chk_id)
+        os.replace(
+            os.path.join(checkpoint_dir, "latest.tmp"),
+            os.path.join(checkpoint_dir, "latest"),
+        )
+        self._prune(checkpoint_dir, keep=2)
+        return final
+
+    @staticmethod
+    def _prune(checkpoint_dir: str, keep: int) -> None:
+        chks = sorted(
+            d for d in os.listdir(checkpoint_dir) if d.startswith("chk-")
+        )
+        for old in chks[:-keep]:
+            import shutil
+
+            shutil.rmtree(os.path.join(checkpoint_dir, old), ignore_errors=True)
+
+    def restore(self, checkpoint_dir: str) -> Optional[int]:
+        """Load the latest complete checkpoint; returns the journal offset,
+        or None if no checkpoint exists."""
+        latest_file = os.path.join(checkpoint_dir, "latest")
+        if not os.path.exists(latest_file):
+            return None
+        with open(latest_file) as f:
+            chk_id = f.read().strip()
+        chk = os.path.join(checkpoint_dir, chk_id)
+        with open(os.path.join(chk, MANIFEST)) as f:
+            manifest = json.load(f)
+        if manifest["n_shards"] != self.n_shards:
+            raise ValueError(
+                f"checkpoint has {manifest['n_shards']} shards, table has "
+                f"{self.n_shards}"
+            )
+        with self._lock:
+            for idx in range(self.n_shards):
+                shard: Dict[str, str] = {}
+                path = os.path.join(chk, f"shard-{idx}.tsv")
+                if os.path.exists(path):
+                    with open(path) as f:
+                        for line in f:
+                            line = line.rstrip("\n")
+                            if not line:
+                                continue
+                            k, _, v = line.partition("\t")
+                            shard[k] = v
+                self._shards[idx] = shard
+        return int(manifest["offset"])

@@ -1,0 +1,217 @@
+"""Client tests against a live serving job: REPL predictions, load-harness
+latency CSVs, range-partitioned bucket queries, and device-scored top-k."""
+
+import io
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.client import (
+    als_predict,
+    als_predict_random,
+    range_partition_svm_predict,
+    svm_predict,
+    svm_predict_random,
+)
+from flink_ms_tpu.client.svm_predict import decide
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.core.params import Params
+from flink_ms_tpu.serve.client import QueryClient
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    SVM_STATE,
+    MemoryStateBackend,
+    ServingJob,
+    parse_als_record,
+    parse_svm_record,
+)
+from flink_ms_tpu.serve.journal import Journal
+
+
+def _wait_until(pred, timeout=10.0, interval=0.02):
+    import time
+
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def als_serving(tmp_path, rng):
+    journal = Journal(str(tmp_path / "j"), "als")
+    job = ServingJob(
+        journal, ALS_STATE, parse_als_record, MemoryStateBackend(),
+        poll_interval_s=0.01, host="127.0.0.1", port=0,
+    )
+    job.start()
+    uf = rng.normal(size=(20, 4))
+    itf = rng.normal(size=(15, 4))
+    rows = [F.format_als_row(u, "U", uf[u]) for u in range(20)]
+    rows += [F.format_als_row(i, "I", itf[i]) for i in range(15)]
+    journal.append(rows)
+    assert _wait_until(lambda: len(job.table) == 35)
+    yield job, uf, itf
+    job.stop()
+
+
+@pytest.fixture
+def svm_serving(tmp_path, rng):
+    journal = Journal(str(tmp_path / "j"), "svm")
+    job = ServingJob(
+        journal, SVM_STATE, parse_svm_record, MemoryStateBackend(),
+        poll_interval_s=0.01, host="127.0.0.1", port=0,
+    )
+    job.start()
+    w = rng.normal(size=30)
+    yield job, journal, w
+    job.stop()
+
+
+def test_als_repl_predict(als_serving, capsys):
+    job, uf, itf = als_serving
+    with QueryClient("127.0.0.1", job.port) as c:
+        out = io.StringIO()
+        als_predict.run(c, ["3,7", "999,0", "garbage"], out=out)
+        text = out.getvalue()
+    expected = float(uf[3] @ itf[7])
+    assert f"ALS Prediction =  {expected:f}" in text
+    assert "do not exist" in text
+    assert "Query failed" in text  # garbage line -> exception path
+
+
+def test_als_random_harness_latency_csv(als_serving, tmp_path):
+    job, uf, itf = als_serving
+    out_file = str(tmp_path / "latency.csv")
+    n = als_predict_random.run(
+        Params.from_args(
+            ["--jobId", job.job_id, "--jobManagerHost", "127.0.0.1",
+             "--jobManagerPort", str(job.port), "--numQueries", "25",
+             "--lowerUserId", "0", "--upperUserId", "20",
+             "--lowerItemId", "0", "--upperItemId", "15",
+             "--outputFile", out_file]
+        )
+    )
+    assert n == 25
+    lines = list(F.iter_lines(out_file))
+    assert len(lines) == 25
+    u, i, pred, ms = lines[0].split(",")
+    assert float(pred) == pytest.approx(float(uf[int(u)] @ itf[int(i)]), rel=1e-5)
+    assert int(ms) >= 0
+
+
+def test_als_random_unset_bounds_rejected(als_serving, tmp_path):
+    job, _, _ = als_serving
+    with pytest.raises(ValueError):
+        als_predict_random.run(
+            Params.from_args(
+                ["--jobId", job.job_id, "--jobManagerPort", str(job.port),
+                 "--outputFile", str(tmp_path / "x")]
+            )
+        )
+
+
+def test_svm_repl_flat_model(svm_serving):
+    job, journal, w = svm_serving
+    journal.append(list(F.format_svm_flat_rows(w)))
+    assert _wait_until(lambda: len(job.table) == 30)
+    with QueryClient("127.0.0.1", job.port) as c:
+        out = io.StringIO()
+        # feature ids are 1-based in the flat model
+        svm_predict.run(c, ["1:1.0 2:2.0", "999:1.0"], out=out)
+        text = out.getvalue()
+    raw = w[0] * 1.0 + w[1] * 2.0
+    expected = 1.0 if raw > 0 else -1.0
+    assert f"SVM Prediction =  {expected:f}" in text
+    assert "Could not find the value for feature ID: 999" in text
+    # decision-function mode returns the raw value
+    with QueryClient("127.0.0.1", job.port) as c:
+        out2 = io.StringIO()
+        svm_predict.run(c, ["1:1.0 2:2.0"], output_decision_function=True, out=out2)
+    assert f"{raw:f}" in out2.getvalue()
+
+
+def test_decide_threshold():
+    assert decide(0.5, False, 0.0) == 1.0
+    assert decide(-0.5, False, 0.0) == -1.0
+    assert decide(0.5, False, 0.6) == -1.0
+    assert decide(0.123, True, 0.0) == 0.123
+
+
+def test_svm_random_harness(svm_serving, tmp_path):
+    job, journal, w = svm_serving
+    journal.append(list(F.format_svm_flat_rows(w)))
+    assert _wait_until(lambda: len(job.table) == 30)
+    out_file = str(tmp_path / "svm_latency.csv")
+    n = svm_predict_random.run(
+        Params.from_args(
+            ["--jobId", job.job_id, "--jobManagerPort", str(job.port),
+             "--jobManagerHost", "127.0.0.1", "--numQueries", "10",
+             "--maxNoOfFeatures", "30", "--outputFile", out_file]
+        )
+    )
+    lines = list(F.iter_lines(out_file))
+    assert len(lines) == n == 10
+    qid, nf, pred, ms = lines[3].split(",")
+    assert int(qid) == 3
+    assert float(pred) in (1.0, -1.0)
+
+
+def test_range_partition_harness_matches_flat(svm_serving, tmp_path, rng):
+    """Bucketed serving gives the same predictions as the flat model."""
+    job, journal, w = svm_serving
+    range_ = 8
+    journal.append(list(F.format_svm_range_rows(w, range_)))
+    assert _wait_until(lambda: len(job.table) > 0)
+
+    out_file = str(tmp_path / "range_latency.csv")
+    n = range_partition_svm_predict.run(
+        Params.from_args(
+            ["--jobId", job.job_id, "--jobManagerPort", str(job.port),
+             "--jobManagerHost", "127.0.0.1", "--numQueries", "10",
+             "--maxNoOfFeatures", "30", "--range", str(range_),
+             "--outputFile", out_file, "--outputDecisionFunction", "true"]
+        )
+    )
+    assert n == 10
+    # cross-check one fixed query against the raw weight vector
+    with QueryClient("127.0.0.1", job.port) as c:
+        payload = c.query_state(SVM_STATE, "0")
+        assert payload is not None
+        entries = dict(
+            (int(t.split(":")[0]), float(t.split(":")[1]))
+            for t in payload.split(";")
+        )
+        # bucket 0 holds 1-based indices 1..range_-1 -> w[0..range_-2]
+        for idx1, val in entries.items():
+            assert val == pytest.approx(w[idx1 - 1])
+
+
+def test_topk_against_brute_force(als_serving):
+    job, uf, itf = als_serving
+    with QueryClient("127.0.0.1", job.port) as c:
+        result = c.topk(ALS_STATE, "5", 5)
+        assert result is not None and len(result) == 5
+        scores = uf[5] @ itf.T
+        expect_order = np.argsort(-scores)[:5]
+        got_items = [int(item) for item, _ in result]
+        assert got_items == list(expect_order)
+        for (item, score), ei in zip(result, expect_order):
+            assert score == pytest.approx(float(scores[ei]), rel=1e-5)
+        # unknown user -> None
+        assert c.topk(ALS_STATE, "999", 5) is None
+
+
+def test_topk_sees_online_update(als_serving):
+    job, uf, itf = als_serving
+    # push a new item that dominates all scores for user 0
+    big = 100.0 * np.sign(uf[0])
+    job.journal_append_for_tests = None  # no-op marker
+    with QueryClient("127.0.0.1", job.port) as c:
+        before = c.topk(ALS_STATE, "0", 1)
+        job.table.put("777-I", ";".join(repr(float(v)) for v in big))
+        after = c.topk(ALS_STATE, "0", 1)
+    assert after[0][0] == "777"
+    assert before[0][0] != "777"

@@ -1,0 +1,144 @@
+"""Generators + MSE evaluator tests (flag surfaces and format contracts)."""
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.core.params import Params
+from flink_ms_tpu.eval import mse as mse_mod
+from flink_ms_tpu.gen import als_model_generator, svm_model_generator
+
+
+def test_als_generator_format_and_counts(tmp_path):
+    out = str(tmp_path / "model")
+    als_model_generator.run(
+        Params.from_args(
+            ["--numUsers", "10", "--numItems", "7",
+             "--latentFactors", "4", "--parallelism", "1", "--output", out]
+        )
+    )
+    ids, types, mat = F.read_als_model(out)
+    assert types.count("U") == 10 and types.count("I") == 7
+    assert mat.shape == (17, 4)
+    # reference ids are 1-based
+    assert ids[0] == "1"
+
+
+def test_als_generator_parallel_parts(tmp_path):
+    out = tmp_path / "model_dir"
+    als_model_generator.run(
+        Params.from_args(
+            ["--numUsers", "6", "--numItems", "4",
+             "--latentFactors", "2", "--parallelism", "3", "--output", str(out)]
+        )
+    )
+    assert sorted(p.name for p in out.iterdir()) == ["1", "2", "3"]
+    ids, _, mat = F.read_als_model(str(out))
+    assert mat.shape == (10, 2)
+
+
+def test_svm_generator_buckets(tmp_path):
+    out = str(tmp_path / "svm_model")
+    svm_model_generator.run(
+        Params.from_args(
+            ["--numFeatures", "25", "--range", "10", "--parallelism", "1",
+             "--output", out]
+        )
+    )
+    lines = list(F.iter_lines(out))
+    # buckets 0..numFeatures/range inclusive (SVMModelGenerator.scala:67)
+    assert len(lines) == 3
+    b0, entries = F.parse_svm_range_row(lines[0])
+    assert b0 == 0
+    assert [i for i, _ in entries] == list(range(0, 10))  # 0-based keys
+    # ~50% sparsity
+    all_entries = [w for ln in lines for _, es in [F.parse_svm_range_row(ln)] for _, w in es]
+    zero_frac = np.mean([w == 0 for w in all_entries])
+    assert 0.2 < zero_frac < 0.8
+    assert all(abs(w) < 10 for w in all_entries)
+
+
+def _write_model_and_ratings(tmp_path, rng):
+    k = 3
+    uf = rng.normal(size=(12, k))
+    itf = rng.normal(size=(9, k))
+    u, i = np.nonzero(rng.uniform(size=(12, 9)) < 0.6)
+    r = (uf @ itf.T)[u, i]
+    model_path = str(tmp_path / "model")
+    rows = [F.format_als_row(uu + 1, F.USER, uf[uu]) for uu in range(12)]
+    rows += [F.format_als_row(ii + 1, F.ITEM, itf[ii]) for ii in range(9)]
+    F.write_lines(model_path, rows)
+    ratings_path = str(tmp_path / "ratings.tsv")
+    with open(ratings_path, "w") as f:
+        f.write("user\titem\trating\n")  # MSE always skips first line
+        for uu, ii, rr in zip(u + 1, i + 1, r):
+            f.write(f"{uu}\t{ii}\t{rr}\n")
+    return model_path, ratings_path, (u + 1, i + 1, r)
+
+
+def test_mse_offline_exact_model(tmp_path, rng, capsys):
+    model_path, ratings_path, _ = _write_model_and_ratings(tmp_path, rng)
+    out = mse_mod.run(
+        Params.from_args(["--input", ratings_path, "--model", model_path])
+    )
+    assert out == pytest.approx(0.0, abs=1e-9)
+
+
+def test_mse_offline_skips_missing(tmp_path, rng, capsys):
+    model_path, ratings_path, (u, i, r) = _write_model_and_ratings(tmp_path, rng)
+    # append a rating with an unknown user -> skipped, MSE still ~0
+    with open(ratings_path, "a") as f:
+        f.write("9999\t1\t3.0\n")
+    out = mse_mod.run(
+        Params.from_args(["--input", ratings_path, "--model", model_path])
+    )
+    assert out == pytest.approx(0.0, abs=1e-9)
+    assert "skipped 1 ratings" in capsys.readouterr().err
+
+
+def test_mse_live_lookup_semantics(tmp_path, rng):
+    """compute_mse with a dict-backed lookup reproduces the group-skip rules."""
+    model_path, ratings_path, (u, i, r) = _write_model_and_ratings(tmp_path, rng)
+    table = mse_mod._load_model_tables(model_path)
+    # remove one user entirely and one item
+    victim_user = u[0]
+    victim_item = None
+    for it in i:
+        # pick an item rated by a different, surviving user
+        if any((u != victim_user) & (i == it)):
+            victim_item = it
+            break
+    del table[f"{victim_user}-U"]
+    del table[f"{victim_item}-I"]
+    mse_val, n_scored, n_skipped = mse_mod.compute_mse(
+        u, i, r, lambda key: table.get(key)
+    )
+    expected_skips = int((u == victim_user).sum()) + int(
+        ((i == victim_item) & (u != victim_user)).sum()
+    )
+    assert n_skipped == expected_skips
+    assert n_scored == len(r) - expected_skips
+    assert mse_val == pytest.approx(0.0, abs=1e-9)
+
+
+def test_mse_writes_output_file(tmp_path, rng):
+    model_path, ratings_path, _ = _write_model_and_ratings(tmp_path, rng)
+    out_path = str(tmp_path / "mse_out")
+    mse_mod.run(
+        Params.from_args(
+            ["--input", ratings_path, "--model", model_path, "--output", out_path]
+        )
+    )
+    val = float(list(F.iter_lines(out_path))[0])
+    assert val == pytest.approx(0.0, abs=1e-9)
+
+
+def test_mse_offline_tolerates_mean_rows(tmp_path, rng):
+    # model dumps legitimately contain MEAN cold-start rows
+    model_path, ratings_path, _ = _write_model_and_ratings(tmp_path, rng)
+    with open(model_path, "a") as f:
+        f.write("MEAN,U,0.1;0.1;0.1\nMEAN,I,0.2;0.2;0.2\n")
+    out = mse_mod.run(
+        Params.from_args(["--input", ratings_path, "--model", model_path])
+    )
+    assert out == pytest.approx(0.0, abs=1e-9)
