@@ -1,0 +1,276 @@
+"""Online SGD model updater — counterpart of ``SGD`` (v1) and ``SGDV0``
+(``als-ms/src/main/java/de/tub/it4bi/modelserving/qs/SGD.java``, ``SGDV0.java``).
+
+Streaming job that closes the serve→update loop: ratings stream in from a
+file/directory source (once or continuously — SGD.java:49-64), each rating
+queries the live served factors (falling back to the MEAN cold-start
+vectors — :142-151, :219-234), applies a biased SGD step, and emits updated
+``id,U|I,f;...`` rows back into the model journal, which the serving job
+then folds into the queryable state (the closed loop of SURVEY.md §3.4).
+
+Both reference semantics are implemented behind ``--version``:
+
+- ``v1`` (SGD.java:191-216, default): user and item factor updates are both
+  computed from the OLD vectors; rows are emitted even when they contain
+  NaN (detection is log-only — :230).
+- ``v0`` (SGDV0.java:188-226): in-place sequential update — the item update
+  sees the already-updated user vector — and NaN rows are dropped, not
+  emitted.
+
+Update rule (k factors, learning rate γ, per-side regularization λu/λi):
+
+    err  = r − u·v
+    u'   = u + γ (err · v − λu · u)        [v1: v is old; v0: same]
+    v'   = v + γ (err · u − λi · v)        [v1: u is old; v0: u' (updated)]
+    bias updates are computed but not persisted (reference TODOs at
+    SGD.java:209,232 — preserved as-is for parity).
+
+Quirk fix (SURVEY.md Appendix C #8): a query-transport error in the
+reference leaves an Optional null and NPEs the task; here it falls back to
+the mean vector and logs, keeping the stream alive.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import formats as F
+from ..core.params import Params, field_delimiter_from
+from ..serve.client import QueryClient
+from ..serve.consumer import ALS_STATE
+from ..serve.journal import Journal
+
+
+class SGDStep:
+    def __init__(
+        self,
+        lookup: Callable[[str], Optional[str]],
+        user_mean: str,
+        item_mean: str,
+        learning_rate: float = 0.1,
+        user_reg: float = 0.0,
+        item_reg: float = 0.0,
+        version: str = "v1",
+    ):
+        if version not in ("v1", "v0"):
+            raise ValueError("version must be v1 or v0")
+        self.lookup = lookup
+        self.user_mean = user_mean
+        self.item_mean = item_mean
+        self.lr = learning_rate
+        self.user_reg = user_reg
+        self.item_reg = item_reg
+        self.version = version
+        self.nan_records = 0
+
+    def _factors(self, id_: int, suffix: str, mean: str) -> np.ndarray:
+        key = f"{id_}{suffix}"
+        try:
+            payload = self.lookup(key)
+        except Exception as e:
+            print(f"query failed for {key}: {e}", file=sys.stderr)
+            payload = None
+        if payload is None:
+            payload = mean
+        vec = np.asarray([float(t) for t in payload.split(";") if t])
+        if np.isnan(vec).any():
+            print(f"NaN detected for: {id_}{suffix}")
+        return vec
+
+    def process(self, user: int, item: int, rating: float) -> List[str]:
+        u = self._factors(user, "-U", self.user_mean)
+        v = self._factors(item, "-I", self.item_mean)
+        err = rating - float(u @ v)
+
+        if self.version == "v1":
+            u_new = u + self.lr * (err * v - self.user_reg * u)
+            v_new = v + self.lr * (err * u - self.item_reg * v)
+        else:  # v0: item step sees the already-updated user vector
+            u_new = u + self.lr * (err * v - self.user_reg * u)
+            v_new = v + self.lr * (err * u_new - self.item_reg * v)
+
+        out = []
+        user_row = F.format_als_row(user, F.USER, u_new)
+        item_row = F.format_als_row(item, F.ITEM, v_new)
+        if self.version == "v1":
+            # emit even if NaN (log-only detection, SGD.java:230)
+            out.append(user_row)
+            out.append(item_row)
+        else:
+            if "nan" in user_row.lower():
+                self.nan_records += 1
+                print(f"NaN in userRecord{user_row}")
+            else:
+                out.append(user_row)
+            if "nan" in item_row.lower():
+                self.nan_records += 1
+                print(f"NaN in itemRecord{item_row}")
+            else:
+                out.append(item_row)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# streaming file source (TextInputFormat nested + PROCESS_ONCE/CONTINUOUSLY)
+# ---------------------------------------------------------------------------
+
+def stream_ratings(
+    path: str,
+    mode: str,
+    interval_ms: int,
+    delimiter: str,
+    stop: Optional[Callable[[], bool]] = None,
+) -> Iterator[Tuple[int, int, float]]:
+    """Yield (user, item, rating) from a file/nested-dir source.  ``once``
+    processes the current contents and returns; ``continuous`` re-polls
+    every ``interval_ms``, picking up appended lines and new files."""
+    if mode not in ("continuous", "once"):
+        raise ValueError("Invalid mode. Specify --mode [continuous|once] ")
+    consumed: Dict[str, int] = {}
+    while True:
+        for fp in _files_under(path):
+            pos = consumed.get(fp, 0)
+            try:
+                size = os.path.getsize(fp)
+                if size < pos:  # truncated/rewritten: reprocess from start
+                    pos = 0
+                if size == pos:
+                    continue
+                with open(fp, "r") as f:
+                    f.seek(pos)
+                    chunk = f.read()
+            except OSError:
+                continue
+            if mode == "once":
+                # the file is complete: a missing trailing newline must not
+                # drop the final record (PROCESS_ONCE parity)
+                complete = chunk
+                consumed[fp] = pos + len(chunk.encode("utf-8"))
+            else:
+                # continuous tailing: hold a torn final line until its
+                # newline lands
+                last_nl = chunk.rfind("\n")
+                if last_nl < 0:
+                    continue
+                complete = chunk[: last_nl + 1]
+                consumed[fp] = pos + len(complete.encode("utf-8"))
+            for line in complete.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                toks = line.split(delimiter)
+                yield int(toks[0]), int(toks[1]), float(toks[2])
+        if mode == "once":
+            return
+        if stop is not None and stop():
+            return
+        time.sleep(interval_ms / 1000.0)
+
+
+def _files_under(path: str) -> List[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for name in sorted(files):
+                if not name.startswith(".") and not name.startswith("_"):
+                    out.append(os.path.join(root, name))
+        return sorted(out)
+    return [path] if os.path.exists(path) else []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def run(params: Params, stop: Optional[Callable[[], bool]] = None) -> int:
+    """Returns the number of ratings processed."""
+    mode = params.get_required("mode")
+    output_mode = params.get_required("outputMode")
+    delimiter = field_delimiter_from(params, default="tab")
+
+    client = QueryClient(
+        host=params.get("jobManagerHost", "localhost"),
+        port=params.get_int("jobManagerPort", 6123),
+        timeout_s=params.get_int("queryTimeout", 5),
+        job_id=params.get_required("jobId"),
+    )
+    out_f = None
+    try:
+        def lookup(key: str) -> Optional[str]:
+            return client.query_state(ALS_STATE, key)
+
+        # mean vectors are loaded once at job start (SGD.java:142-151)
+        user_mean = _mean_or_flag(lookup, "MEAN-U", params.get("userMean"))
+        item_mean = _mean_or_flag(lookup, "MEAN-I", params.get("itemMean"))
+        if user_mean is None or item_mean is None:
+            raise RuntimeError("Unable to load User mean or item mean factors.")
+
+        step = SGDStep(
+            lookup,
+            user_mean,
+            item_mean,
+            learning_rate=params.get_float("learningRate", 0.1),
+            user_reg=params.get_float("userRegularization", 0.0),
+            item_reg=params.get_float("itemRegularization", 0.0),
+            version=params.get("version", "v1"),
+        )
+
+        if output_mode in ("kafka", "journal"):
+            journal = Journal(
+                params.get_required("journalDir"), params.get_required("topic")
+            )
+
+            def emit(rows: List[str]) -> None:
+                journal.append(rows)
+
+        elif output_mode == "hdfs":
+            out_path = params.get_required("outputPath")
+            d = os.path.dirname(os.path.abspath(out_path))
+            os.makedirs(d, exist_ok=True)
+            out_f = open(out_path, "w")
+
+            def emit(rows: List[str]) -> None:
+                for row in rows:
+                    out_f.write(row + "\n")
+                out_f.flush()
+
+        else:
+            raise ValueError("outputMode must be kafka|journal|hdfs")
+
+        n = 0
+        for user, item, rating in stream_ratings(
+            params.get_required("input"),
+            mode,
+            params.get_int("interval", 60_000),
+            delimiter,
+            stop=stop,
+        ):
+            emit(step.process(user, item, rating))
+            n += 1
+    finally:
+        client.close()
+        if out_f is not None:
+            out_f.close()
+    print(f"[ALS] online-updates using SGD: processed {n} ratings")
+    return n
+
+
+def _mean_or_flag(lookup, key: str, flag_value: Optional[str]) -> Optional[str]:
+    try:
+        payload = lookup(key)
+    except Exception:
+        payload = None
+    return payload if payload is not None else flag_value
+
+
+def main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""SVM training CLI — TPU-native counterpart of ``SVMImpl``
+(``flink-svm/src/main/scala/de/tub/it4bi/SVMImpl.scala``).
+
+Reference flag surface preserved (SURVEY.md Appendix A), including the
+``--iteration`` singular-form quirk (SVMImpl.scala:26 — Appendix C #1;
+``--iterations`` is also accepted here as an alias): ``--training`` (req),
+``--blocks`` (10), ``--iteration`` (10), ``--partition`` bool, ``--range``
+(1000), ``--output``.  Output rows are 1-based ``featureIndex,weight`` or
+range-partitioned ``bucket,idx:w;...`` (SVMImpl.scala:33-46).
+
+TPU-native extras surface FlinkML's hidden CoCoA knobs [dep]:
+``--localIterations`` (default: one full local pass per round),
+``--regularization`` (1.0), ``--stepsize`` (1.0), ``--seed``, ``--devices``.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ..core import formats as F
+from ..core.params import Params
+from ..ops.svm import SVMConfig, SVMModel, prepare_svm_blocked, svm_fit
+from ..parallel.mesh import make_mesh
+
+
+def run(params: Params) -> SVMModel:
+    training_path = params.get_required("training")
+    data = F.read_libsvm(training_path)
+
+    import jax
+
+    avail = len(jax.devices())
+    blocks = params.get_int("blocks", 10)
+    n_devices = params.get_int("devices")
+    if n_devices is None:
+        n_devices = min(blocks, avail)
+    mesh = make_mesh(n_devices)
+
+    iterations = params.get_int("iteration", params.get_int("iterations", 10))
+    problem = prepare_svm_blocked(
+        data, n_devices, seed=params.get_int("seed", 0)
+    )
+    local_iters = params.get_int("localIterations", problem.rows_per_block)
+    config = SVMConfig(
+        iterations=iterations,
+        local_iterations=local_iters,
+        regularization=params.get_float("regularization", 1.0),
+        stepsize=params.get_float("stepsize", 1.0),
+        seed=params.get_int("seed", 0),
+    )
+
+    t0 = time.time()
+    model = svm_fit(data, config, mesh, problem=problem)
+    train_s = time.time() - t0
+    print(
+        f"[SVM] model-fitting: {data.n_examples} examples x "
+        f"{data.n_features} features, {iterations} rounds x {local_iters} "
+        f"local steps, {mesh.devices.size} device(s), {train_s:.2f}s, "
+        f"hinge+reg objective="
+        f"{model.hinge_loss(data, config.regularization):.6f}"
+    )
+
+    if params.get_bool("partition"):
+        rows = F.format_svm_range_rows(model.weights, params.get_int("range", 1000))
+    else:
+        rows = F.format_svm_flat_rows(model.weights)
+
+    if params.has("output"):
+        F.write_lines(params.get_required("output"), rows)
+    else:
+        print("Printing result to stdout. Use --output to specify output path.")
+        for row in rows:
+            print(row)
+    return model
+
+
+def main(argv=None) -> None:
+    run(Params.from_args(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    main()
