@@ -106,11 +106,19 @@ def make_backend(kind: str, checkpoint_uri: Optional[str]):
     if kind == "rocksdb":
         if not checkpoint_uri:
             raise ValueError("rocksdb state backend requires --checkpointDataUri")
+        from .native_store import StoreLockedError
+
         try:
-            from .native_backend import NativeStateBackend
+            from .native_store import NativeStateBackend
 
             return NativeStateBackend(checkpoint_uri)
-        except Exception as e:  # .so not built yet
+        except StoreLockedError:
+            # another serving job owns this store dir — degrading to fs
+            # snapshots in the SAME dir would silently fork the state
+            raise
+        except Exception as e:
+            # toolchain missing / build failed: fs snapshots still honor the
+            # checkpoint contract
             print(
                 f"[serve] native store unavailable ({e}); rocksdb mode "
                 "falling back to fs snapshots",
@@ -144,7 +152,12 @@ class ServingJob:
         self.state_name = state_name
         self.parse_fn = parse_fn
         self.backend = backend
-        self.table = ModelTable(n_shards)
+        # the native (rocksdb-parity) backend provides its own durable table;
+        # memory/fs back a plain in-RAM sharded table
+        if hasattr(backend, "make_table"):
+            self.table = backend.make_table(n_shards)
+        else:
+            self.table = ModelTable(n_shards)
         self.checkpoint_interval_s = checkpoint_interval_ms / 1000.0
         self.poll_interval_s = poll_interval_s
         self.job_id = job_id or uuid.uuid4().hex
@@ -192,6 +205,18 @@ class ServingJob:
         if self._consumer_thread:
             self._consumer_thread.join(timeout=10)
         self.server.stop()
+        if hasattr(self.backend, "close"):
+            # never free the native store under a still-running consumer
+            # thread (use-after-free); a wedged thread leaks the handle
+            # instead, and the flock dies with the process
+            if self._consumer_thread is None or not self._consumer_thread.is_alive():
+                self.backend.close()
+            else:
+                print(
+                    f"[serve:{self.state_name}] consumer thread still busy; "
+                    "leaving native store open",
+                    file=sys.stderr,
+                )
 
     def wait(self) -> None:
         while not self._stop.is_set():

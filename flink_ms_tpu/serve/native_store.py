@@ -1,0 +1,259 @@
+"""ctypes binding for the C++ persistent KV store (native/store.cpp) — the
+``--stateBackend rocksdb`` parity mode (SURVEY.md §2.4: the reference keeps
+served state in RocksDB through JNI; here a bitcask-style C++ log-structured
+store plays that role, bound through ctypes because pybind11 isn't in the
+image).
+
+Build on demand: if ``native/libtpums.so`` is missing, ``make -C native``
+is invoked once (g++ is baked into the image).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtpums.so"))
+_lib = None
+_lib_lock = threading.Lock()
+
+_ITER_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.POINTER(ctypes.c_char),
+    ctypes.c_uint32,
+    ctypes.POINTER(ctypes.c_char),
+    ctypes.c_uint32,
+    ctypes.c_void_p,
+)
+_KEY_CB = ctypes.CFUNCTYPE(
+    None, ctypes.POINTER(ctypes.c_char), ctypes.c_uint32, ctypes.c_void_p
+)
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.tpums_open.restype = ctypes.c_void_p
+        lib.tpums_open.argtypes = [ctypes.c_char_p]
+        lib.tpums_put.restype = ctypes.c_int
+        lib.tpums_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.tpums_get.restype = ctypes.POINTER(ctypes.c_char)
+        lib.tpums_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.tpums_free_buf.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        lib.tpums_delete.restype = ctypes.c_int
+        lib.tpums_delete.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.tpums_count.restype = ctypes.c_uint64
+        lib.tpums_count.argtypes = [ctypes.c_void_p]
+        lib.tpums_flush.restype = ctypes.c_int
+        lib.tpums_flush.argtypes = [ctypes.c_void_p]
+        lib.tpums_iterate.restype = ctypes.c_int
+        lib.tpums_iterate.argtypes = [ctypes.c_void_p, _ITER_CB, ctypes.c_void_p]
+        lib.tpums_keys.restype = ctypes.c_int
+        lib.tpums_keys.argtypes = [ctypes.c_void_p, _KEY_CB, ctypes.c_void_p]
+        lib.tpums_log_bytes.restype = ctypes.c_uint64
+        lib.tpums_log_bytes.argtypes = [ctypes.c_void_p]
+        lib.tpums_live_bytes.restype = ctypes.c_uint64
+        lib.tpums_live_bytes.argtypes = [ctypes.c_void_p]
+        lib.tpums_compact.restype = ctypes.c_int
+        lib.tpums_compact.argtypes = [ctypes.c_void_p]
+        lib.tpums_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class StoreLockedError(OSError):
+    """Another process holds the store's writer lock."""
+
+
+class NativeStore:
+    """Persistent string->string store backed by the C++ log."""
+
+    def __init__(self, directory: str):
+        self._lib = _load_lib()
+        os.makedirs(directory, exist_ok=True)
+        self._h = self._lib.tpums_open(directory.encode("utf-8"))
+        if not self._h:
+            if self._is_locked(directory):
+                raise StoreLockedError(
+                    f"store {directory} is locked by another writer"
+                )
+            raise OSError(f"tpums_open failed for {directory}")
+        self.directory = directory
+
+    @staticmethod
+    def _is_locked(directory: str) -> bool:
+        import fcntl
+
+        log = os.path.join(directory, "data.log")
+        try:
+            fd = os.open(log, os.O_RDWR)
+        except OSError:
+            return False
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            return False
+        except OSError:
+            return True
+        finally:
+            os.close(fd)
+
+    def put(self, key: str, value: str) -> None:
+        k = key.encode("utf-8")
+        v = value.encode("utf-8")
+        if self._lib.tpums_put(self._h, k, len(k), v, len(v)) != 0:
+            raise OSError("tpums_put failed")
+
+    def get(self, key: str) -> Optional[str]:
+        k = key.encode("utf-8")
+        vlen = ctypes.c_uint32()
+        p = self._lib.tpums_get(self._h, k, len(k), ctypes.byref(vlen))
+        if not p:
+            return None
+        try:
+            return ctypes.string_at(p, vlen.value).decode("utf-8")
+        finally:
+            self._lib.tpums_free_buf(p)
+
+    def delete(self, key: str) -> None:
+        k = key.encode("utf-8")
+        self._lib.tpums_delete(self._h, k, len(k))
+
+    def __len__(self) -> int:
+        return int(self._lib.tpums_count(self._h))
+
+    def flush(self) -> None:
+        if self._lib.tpums_flush(self._h) != 0:
+            raise OSError("tpums_flush failed")
+
+    def keys(self) -> List[str]:
+        """All live keys (keys are small; values stay on disk)."""
+        out: List[str] = []
+
+        def cb(kp, klen, _ctx):
+            out.append(ctypes.string_at(kp, klen).decode("utf-8"))
+
+        cb_ref = _KEY_CB(cb)
+        if self._lib.tpums_keys(self._h, cb_ref, None) != 0:
+            raise OSError("tpums_keys failed")
+        return out
+
+    def items(self) -> Iterator[Tuple[str, str]]:
+        """Stream (key, value) pairs: the key set is snapshotted under the
+        store lock, values are fetched lazily — a larger-than-RAM store is
+        never materialized at once.  Keys deleted mid-iteration are skipped."""
+        for k in self.keys():
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    @property
+    def log_bytes(self) -> int:
+        return int(self._lib.tpums_log_bytes(self._h))
+
+    @property
+    def live_bytes(self) -> int:
+        return int(self._lib.tpums_live_bytes(self._h))
+
+    def compact(self) -> None:
+        if self._lib.tpums_compact(self._h) != 0:
+            raise OSError("tpums_compact failed")
+
+    def maybe_compact(self, min_bytes: int = 16 << 20) -> bool:
+        if self.log_bytes > min_bytes and self.live_bytes * 2 < self.log_bytes:
+            self.compact()
+            return True
+        return False
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tpums_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativeModelTable:
+    """ModelTable-compatible surface backed by the persistent store: state
+    lives on disk incrementally (RocksDB semantics), so checkpoints are a
+    flush + offset marker rather than a full snapshot, and the served model
+    can exceed RAM."""
+
+    OFFSET_KEY = "\x01__journal_offset__"
+
+    def __init__(self, store: NativeStore):
+        self.store = store
+        self._lock = threading.RLock()
+        self.puts = 0
+
+    def put(self, key: str, value: str) -> None:
+        with self._lock:
+            self.store.put(key, value)
+            self.puts += 1
+
+    def get(self, key: str) -> Optional[str]:
+        return self.store.get(key)
+
+    def __len__(self) -> int:
+        n = len(self.store)
+        return n - (1 if self.store.get(self.OFFSET_KEY) is not None else 0)
+
+    def items(self) -> Iterator[Tuple[str, str]]:
+        for k, v in self.store.items():
+            if not k.startswith("\x01"):
+                yield k, v
+
+
+class NativeStateBackend:
+    """State backend for ServingJob: the table IS the durable store.
+
+    ``snapshot`` = fsync + journal-offset marker (cheap, incremental);
+    ``restore`` = reopen + read marker; compaction happens opportunistically
+    at checkpoint time.
+    """
+
+    kind = "rocksdb"
+
+    def __init__(self, checkpoint_uri: str):
+        self.store = NativeStore(checkpoint_uri)
+
+    def make_table(self, n_shards: int = 8) -> NativeModelTable:
+        del n_shards  # single log; key routing is the hash index itself
+        return NativeModelTable(self.store)
+
+    def snapshot(self, table, offset: int) -> None:
+        self.store.put(NativeModelTable.OFFSET_KEY, str(offset))
+        self.store.flush()
+        self.store.maybe_compact()
+
+    def restore(self, table) -> Optional[int]:
+        payload = self.store.get(NativeModelTable.OFFSET_KEY)
+        return int(payload) if payload is not None else None
+
+    def close(self) -> None:
+        self.store.close()

@@ -1,0 +1,344 @@
+// tpums persistent KV store — the native state backend behind the serving
+// layer's `--stateBackend rocksdb` mode (the reference keeps served model
+// state in RocksDB via JNI — als-ms/pom.xml:120-123, selected at
+// ALSKafkaConsumer.java:55-56; SURVEY.md §2.4 calls for a C++ equivalent).
+//
+// Design: log-structured (bitcask-style). One append-only data log on disk,
+// an in-memory hash index of key -> (offset, length) of the latest value.
+// - put: append [klen][vlen][key][value] record, update index
+// - get: pread the value at the indexed offset (no seek state, thread-safe)
+// - open: sequential scan rebuilds the index; a torn tail (crash mid-append)
+//   is truncated — recovery is last-complete-record
+// - flush: fsync (the checkpoint barrier)
+// - compact: rewrite live records to a fresh log when garbage accumulates
+//
+// Values can exceed RAM in aggregate; only keys + 12 bytes live in memory.
+// Exposed as a C ABI for the Python ctypes binding (no pybind11 in image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+struct Entry {
+  uint64_t offset;  // offset of the value bytes in the log
+  uint32_t length;
+};
+
+struct Store {
+  std::string dir;
+  std::string log_path;
+  int fd = -1;
+  uint64_t end = 0;        // append position
+  uint64_t live_bytes = 0; // bytes of records still referenced
+  std::unordered_map<std::string, Entry> index;
+  std::mutex mu;
+};
+
+constexpr uint32_t kTombstone = 0xFFFFFFFFu;
+
+bool read_exact(int fd, void* buf, size_t n, uint64_t off) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = pread(fd, p, n, off);
+    if (r <= 0) return false;
+    p += r;
+    off += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+// Scan the log, rebuilding the index; returns the offset of the first
+// incomplete record (the recovery truncation point).
+uint64_t rebuild_index(Store* s) {
+  struct stat st;
+  if (fstat(s->fd, &st) != 0) return 0;
+  uint64_t size = static_cast<uint64_t>(st.st_size);
+  uint64_t pos = 0;
+  std::string key;
+  while (pos + 8 <= size) {
+    uint32_t hdr[2];
+    if (!read_exact(s->fd, hdr, 8, pos)) break;
+    uint32_t klen = hdr[0], vlen = hdr[1];
+    uint64_t vbytes = (vlen == kTombstone) ? 0 : vlen;
+    if (klen > (1u << 20) || (vlen != kTombstone && vlen > (1u << 28)))
+      break;  // corrupt header
+    if (pos + 8 + klen + vbytes > size) break;  // torn tail
+    key.resize(klen);
+    if (klen && !read_exact(s->fd, &key[0], klen, pos + 8)) break;
+    auto it = s->index.find(key);
+    if (it != s->index.end()) {
+      s->live_bytes -= 8 + key.size() + it->second.length;
+      s->index.erase(it);
+    }
+    if (vlen != kTombstone) {
+      s->index[key] = Entry{pos + 8 + klen, vlen};
+      s->live_bytes += 8 + klen + vlen;
+    }
+    pos += 8 + klen + vbytes;
+  }
+  return pos;
+}
+
+constexpr uint32_t kMaxKeyLen = 1u << 20;    // matched by rebuild_index's
+constexpr uint32_t kMaxValueLen = 1u << 28;  // corruption heuristics
+
+int append_record(Store* s, const char* k, uint32_t klen, const char* v,
+                  uint32_t vlen) {
+  // enforce the same limits the recovery scan treats as corruption —
+  // otherwise an oversized record would truncate itself and everything
+  // after it on the next reopen
+  if (klen > kMaxKeyLen || (vlen != kTombstone && vlen > kMaxValueLen))
+    return -1;
+  uint32_t hdr[2] = {klen, vlen};
+  uint64_t vbytes = (vlen == kTombstone) ? 0 : vlen;
+  if (!write_all(s->fd, hdr, 8)) return -1;
+  if (klen && !write_all(s->fd, k, klen)) return -1;
+  if (vbytes && !write_all(s->fd, v, vbytes)) return -1;
+  std::string key(k, klen);
+  auto it = s->index.find(key);
+  if (it != s->index.end()) {
+    s->live_bytes -= 8 + key.size() + it->second.length;
+    s->index.erase(it);
+  }
+  if (vlen != kTombstone) {
+    s->index[std::move(key)] = Entry{s->end + 8 + klen, vlen};
+    s->live_bytes += 8 + klen + vlen;
+  }
+  s->end += 8 + klen + vbytes;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tpums_open(const char* dir) {
+  Store* s = new Store();
+  s->dir = dir;
+  ::mkdir(dir, 0777);  // best effort; open below reports real failures
+  s->log_path = s->dir + "/data.log";
+  s->fd = ::open(s->log_path.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+  if (s->fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  // single-writer guard: a second process (or a leaked handle) opening the
+  // same store would interleave appends and corrupt the log
+  if (flock(s->fd, LOCK_EX | LOCK_NB) != 0) {
+    close(s->fd);
+    delete s;
+    return nullptr;
+  }
+  uint64_t valid = rebuild_index(s);
+  struct stat st;
+  fstat(s->fd, &st);
+  if (valid < static_cast<uint64_t>(st.st_size)) {
+    // torn tail from a crash mid-append: truncate to last complete record
+    if (ftruncate(s->fd, static_cast<off_t>(valid)) != 0) {
+      close(s->fd);
+      delete s;
+      return nullptr;
+    }
+  }
+  s->end = valid;
+  return s;
+}
+
+int tpums_put(void* h, const char* k, uint32_t klen, const char* v,
+              uint32_t vlen) {
+  if (!h || vlen == kTombstone) return -1;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return append_record(s, k, klen, v, vlen);
+}
+
+int tpums_delete(void* h, const char* k, uint32_t klen) {
+  if (!h) return -1;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return append_record(s, k, klen, nullptr, kTombstone);
+}
+
+// Returns a malloc'd value buffer (caller frees via tpums_free_buf) or null.
+char* tpums_get(void* h, const char* k, uint32_t klen, uint32_t* vlen_out) {
+  if (!h) return nullptr;
+  Store* s = static_cast<Store*>(h);
+  // the pread must stay under the lock: compaction closes/reopens the fd
+  // and relocates every offset, so a lock-free read could hit a stale
+  // offset in the rewritten log (or a dead fd)
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->index.find(std::string(k, klen));
+  if (it == s->index.end()) return nullptr;
+  uint64_t off = it->second.offset;
+  uint32_t len = it->second.length;
+  char* buf = static_cast<char*>(malloc(len ? len : 1));
+  if (!buf) return nullptr;
+  if (len && !read_exact(s->fd, buf, len, off)) {
+    free(buf);
+    return nullptr;
+  }
+  *vlen_out = len;
+  return buf;
+}
+
+void tpums_free_buf(char* p) { free(p); }
+
+uint64_t tpums_count(void* h) {
+  if (!h) return 0;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->index.size();
+}
+
+int tpums_flush(void* h) {
+  if (!h) return -1;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return fsync(s->fd) == 0 ? 0 : -1;
+}
+
+// Iterate all live keys: calls cb(key, klen, value, vlen, ctx) under the
+// store lock.  Used by snapshot export and the top-k index builder.
+typedef void (*tpums_iter_cb)(const char*, uint32_t, const char*, uint32_t,
+                              void*);
+int tpums_iterate(void* h, tpums_iter_cb cb, void* ctx) {
+  if (!h) return -1;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::vector<char> buf;
+  for (const auto& kv : s->index) {
+    buf.resize(kv.second.length ? kv.second.length : 1);
+    if (kv.second.length &&
+        !read_exact(s->fd, buf.data(), kv.second.length, kv.second.offset))
+      return -1;
+    cb(kv.first.data(), static_cast<uint32_t>(kv.first.size()), buf.data(),
+       kv.second.length, ctx);
+  }
+  return 0;
+}
+
+// Iterate keys only (no value reads) — lets bindings stream large stores:
+// collect the (small) key set under the lock, then fetch values lazily.
+typedef void (*tpums_key_cb)(const char*, uint32_t, void*);
+int tpums_keys(void* h, tpums_key_cb cb, void* ctx) {
+  if (!h) return -1;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  for (const auto& kv : s->index)
+    cb(kv.first.data(), static_cast<uint32_t>(kv.first.size()), ctx);
+  return 0;
+}
+
+uint64_t tpums_log_bytes(void* h) {
+  if (!h) return 0;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->end;
+}
+
+uint64_t tpums_live_bytes(void* h) {
+  if (!h) return 0;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->live_bytes;
+}
+
+// Rewrite only live records into a fresh log (atomic rename), reclaiming
+// space from overwritten rows.  Called by the backend when garbage > 50%.
+int tpums_compact(void* h) {
+  if (!h) return -1;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string tmp_path = s->log_path + ".compact";
+  int out = ::open(tmp_path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (out < 0) return -1;
+  std::unordered_map<std::string, Entry> new_index;
+  uint64_t new_end = 0;
+  std::vector<char> buf;
+  for (const auto& kv : s->index) {
+    uint32_t klen = static_cast<uint32_t>(kv.first.size());
+    uint32_t vlen = kv.second.length;
+    buf.resize(vlen ? vlen : 1);
+    if (vlen && !read_exact(s->fd, buf.data(), vlen, kv.second.offset)) {
+      close(out);
+      unlink(tmp_path.c_str());
+      return -1;
+    }
+    uint32_t hdr[2] = {klen, vlen};
+    if (!write_all(out, hdr, 8) || !write_all(out, kv.first.data(), klen) ||
+        (vlen && !write_all(out, buf.data(), vlen))) {
+      close(out);
+      unlink(tmp_path.c_str());
+      return -1;
+    }
+    new_index[kv.first] = Entry{new_end + 8 + klen, vlen};
+    new_end += 8 + klen + vlen;
+  }
+  if (fsync(out) != 0 || rename(tmp_path.c_str(), s->log_path.c_str()) != 0) {
+    close(out);
+    unlink(tmp_path.c_str());
+    return -1;
+  }
+  close(s->fd);
+  // reopen in append mode so subsequent puts land at the end, and re-take
+  // the writer lock: rename() replaced the locked inode, so without this a
+  // second process could open the fresh log and interleave appends
+  s->fd = ::open(s->log_path.c_str(), O_RDWR | O_APPEND, 0644);
+  if (s->fd < 0) {
+    close(out);
+    return -1;
+  }
+  if (flock(s->fd, LOCK_EX | LOCK_NB) != 0) {
+    close(out);
+    return -1;
+  }
+  close(out);
+  s->index = std::move(new_index);
+  s->end = new_end;
+  s->live_bytes = new_end;
+  return 0;
+}
+
+void tpums_close(void* h) {
+  if (!h) return;
+  Store* s = static_cast<Store*>(h);
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (s->fd >= 0) {
+      fsync(s->fd);
+      close(s->fd);
+    }
+  }
+  delete s;
+}
+
+}  // extern "C"

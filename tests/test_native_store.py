@@ -1,0 +1,221 @@
+"""C++ persistent store tests: durability, crash recovery (torn tail),
+compaction, and the rocksdb-parity serving path end-to-end."""
+
+import os
+import struct
+import time
+
+import pytest
+
+pytest.importorskip("ctypes")
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.serve.client import QueryClient
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    ServingJob,
+    make_backend,
+    parse_als_record,
+)
+from flink_ms_tpu.serve.journal import Journal
+from flink_ms_tpu.serve.native_store import (
+    NativeStateBackend,
+    NativeStore,
+    StoreLockedError,
+)
+
+
+def _wait_until(pred, timeout=10.0, interval=0.02):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_put_get_overwrite_delete(tmp_path):
+    with NativeStore(str(tmp_path / "db")) as s:
+        s.put("a", "1")
+        s.put("b", "2")
+        s.put("a", "updated")
+        assert s.get("a") == "updated"
+        assert s.get("b") == "2"
+        assert s.get("missing") is None
+        assert len(s) == 2
+        s.delete("b")
+        assert s.get("b") is None
+        assert len(s) == 1
+
+
+def test_unicode_and_large_values(tmp_path):
+    with NativeStore(str(tmp_path / "db")) as s:
+        s.put("ключ-Ü", "значение-ß")
+        big = "x" * 1_000_000
+        s.put("big", big)
+        assert s.get("ключ-Ü") == "значение-ß"
+        assert s.get("big") == big
+
+
+def test_durability_across_reopen(tmp_path):
+    d = str(tmp_path / "db")
+    s = NativeStore(d)
+    for i in range(500):
+        s.put(f"k{i}", f"v{i}")
+    s.flush()
+    s.close()
+    with NativeStore(d) as s2:
+        assert len(s2) == 500
+        assert s2.get("k499") == "v499"
+
+
+def test_torn_tail_recovery(tmp_path):
+    d = str(tmp_path / "db")
+    s = NativeStore(d)
+    s.put("good", "value")
+    s.flush()
+    s.close()
+    # simulate crash mid-append: garbage partial record at the tail
+    with open(os.path.join(d, "data.log"), "ab") as f:
+        f.write(struct.pack("<II", 4, 100))  # header promises 100-byte value
+        f.write(b"keyX")
+        f.write(b"only-ten")  # but only 8 bytes arrive
+    with NativeStore(d) as s2:
+        assert s2.get("good") == "value"
+        assert s2.get("keyX") is None
+        assert len(s2) == 1
+        # the torn record was truncated; new appends land cleanly
+        s2.put("after", "crash")
+        assert s2.get("after") == "crash"
+    with NativeStore(d) as s3:
+        assert s3.get("after") == "crash"
+
+
+def test_compaction_reclaims_space(tmp_path):
+    d = str(tmp_path / "db")
+    with NativeStore(d) as s:
+        for _ in range(50):
+            s.put("hot", "y" * 1000)  # 50 versions of one key
+        before = s.log_bytes
+        assert s.live_bytes < before
+        s.compact()
+        assert s.log_bytes < before
+        assert s.get("hot") == "y" * 1000
+        s.put("post", "compact")
+        assert s.get("post") == "compact"
+    with NativeStore(d) as s2:
+        assert s2.get("hot") == "y" * 1000
+        assert s2.get("post") == "compact"
+
+
+def test_items_iteration(tmp_path):
+    with NativeStore(str(tmp_path / "db")) as s:
+        s.put("a", "1")
+        s.put("b", "2")
+        assert dict(s.items()) == {"a": "1", "b": "2"}
+
+
+def test_make_backend_rocksdb_returns_native(tmp_path):
+    b = make_backend("rocksdb", str(tmp_path / "chk"))
+    assert isinstance(b, NativeStateBackend)
+    t = b.make_table()
+    t.put("1-U", "0.5;0.5")
+    assert t.get("1-U") == "0.5;0.5"
+    assert len(t) == 1
+    b.snapshot(t, offset=777)
+    assert b.restore(t) == 777
+    # offset marker hidden from iteration/len
+    assert dict(t.items()) == {"1-U": "0.5;0.5"}
+
+
+def test_rocksdb_serving_survives_process_state_loss(tmp_path):
+    """End-to-end rocksdb-parity: rows ingested through the journal live in
+    the C++ store; a fresh ServingJob over the same store dir serves them
+    from disk without journal replay."""
+    jdir = str(tmp_path / "j")
+    chk = str(tmp_path / "store")
+    journal = Journal(jdir, "t")
+    job = ServingJob(
+        journal, ALS_STATE, parse_als_record, make_backend("rocksdb", chk),
+        poll_interval_s=0.01, checkpoint_interval_ms=50,
+        host="127.0.0.1", port=0,
+    )
+    job.start()
+    try:
+        journal.append([F.format_als_row(i, "U", [float(i)]) for i in range(30)])
+        assert _wait_until(lambda: len(job.table) == 30)
+        assert _wait_until(
+            lambda: NativeStateBackend(chk + "-probe") is not None
+        )  # trivial, keeps timing honest
+        # wait for a checkpoint (offset marker) to land
+        assert _wait_until(
+            lambda: job.backend.restore(job.table) is not None, timeout=5
+        )
+        offset_at_chk = job.backend.restore(job.table)
+    finally:
+        job.stop()
+
+    # "new process": fresh backend over the same store dir
+    backend2 = make_backend("rocksdb", chk)
+    job2 = ServingJob(
+        Journal(jdir, "t"), ALS_STATE, parse_als_record, backend2,
+        poll_interval_s=0.01, host="127.0.0.1", port=0,
+    )
+    job2.start()
+    try:
+        assert len(job2.table) == 30  # served straight from the C++ store
+        assert job2.offset == offset_at_chk
+        with QueryClient("127.0.0.1", job2.port) as c:
+            assert c.query_state(ALS_STATE, "29-U") == "29.0"
+            # topk over the native table (items() path)
+            journal2 = Journal(jdir, "t")
+            journal2.append([F.format_als_row(5, "I", [2.0])])
+            assert _wait_until(lambda: job2.table.get("5-I") == "2.0")
+            res = c.topk(ALS_STATE, "3", 1)
+            assert res and res[0][0] == "5"
+    finally:
+        job2.stop()
+
+
+def test_second_writer_rejected(tmp_path):
+    d = str(tmp_path / "db")
+    s1 = NativeStore(d)
+    s1.put("k", "v")
+    with pytest.raises(OSError):
+        NativeStore(d)  # writer lock held
+    s1.close()
+    with NativeStore(d) as s2:  # released after close
+        assert s2.get("k") == "v"
+
+
+def test_second_writer_raises_locked_error(tmp_path):
+    d = str(tmp_path / "db")
+    s1 = NativeStore(d)
+    with pytest.raises(StoreLockedError):
+        NativeStore(d)
+    # rocksdb backend on a locked dir must raise, not silently degrade to fs
+    with pytest.raises(StoreLockedError):
+        make_backend("rocksdb", d)
+    s1.close()
+
+
+def test_writer_lock_survives_compaction(tmp_path):
+    d = str(tmp_path / "db")
+    s1 = NativeStore(d)
+    for _ in range(10):
+        s1.put("k", "v" * 100)
+    s1.compact()
+    with pytest.raises(StoreLockedError):
+        NativeStore(d)  # lock must follow the new inode
+    s1.put("post", "ok")
+    s1.close()
+    with NativeStore(d) as s2:
+        assert s2.get("post") == "ok"
+
+
+def test_oversized_record_rejected_at_write(tmp_path):
+    with NativeStore(str(tmp_path / "db")) as s:
+        s.put("fits", "x")
+        with pytest.raises(OSError):
+            s.put("k" * ((1 << 20) + 1), "v")  # key > 1MiB
+        assert s.get("fits") == "x"
