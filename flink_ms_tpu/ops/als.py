@@ -29,6 +29,10 @@ Everything under ``jit`` is static-shaped; the iteration loop is a
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
+import re
 from functools import partial
 from typing import Optional, Tuple
 
@@ -323,6 +327,98 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
 
 
 # ---------------------------------------------------------------------------
+# iteration-boundary staging (the reference's setTemporaryPath,
+# ALSImpl.scala:42-44: materialize loop intermediates to disk instead of one
+# fused plan — here it doubles as training checkpoint/resume, SURVEY.md §5)
+# ---------------------------------------------------------------------------
+
+_STAGE_RE = re.compile(r"^iter_(\d+)\.npz$")
+
+
+def _staging_meta(problem: "BlockedProblem", config: "ALSConfig",
+                  init) -> dict:
+    """Identity of a training run; a snapshot from a different dataset,
+    problem, config, dtype, or starting point must not be resumed."""
+    if init is None:
+        init_id = "seed"
+    else:
+        h = hashlib.sha1()
+        h.update(np.ascontiguousarray(init[0]).tobytes())
+        h.update(np.ascontiguousarray(init[1]).tobytes())
+        init_id = h.hexdigest()
+    # the actual rating data matters too: same-shaped re-exports of fresh
+    # data must retrain, not resume (CSR arrays cover ids, values, layout)
+    hd = hashlib.sha1()
+    for a in (problem.u_item_idx, problem.u_rating, problem.u_seg,
+              problem.user_ids, problem.item_ids):
+        hd.update(np.ascontiguousarray(a).tobytes())
+    return {
+        "data": hd.hexdigest(),
+        "num_factors": config.num_factors,
+        "lambda": config.lambda_,
+        "implicit": config.implicit,
+        "alpha": config.alpha,
+        "weighted_reg": config.weighted_reg,
+        "seed": config.seed,
+        "dtype": str(np.dtype(config.dtype)),
+        "init": init_id,
+        "n_users": problem.n_users,
+        "n_items": problem.n_items,
+        "nnz": problem.nnz,
+        "n_blocks": problem.n_blocks,
+    }
+
+
+def save_staged(path: str, iteration: int, uf: np.ndarray, itf: np.ndarray,
+                meta: dict, keep: int = 2) -> str:
+    """Atomically write one iteration snapshot under `path`.
+
+    The staging dir is scratch space for the *current* run (the reference's
+    temporaryPath semantics), so everything outside the trailing `keep`
+    window ending at `iteration` is pruned — including stale higher-numbered
+    snapshots left by a previous longer run."""
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"iter_{iteration:05d}.npz")
+    tmp = out + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, user_factors=uf, item_factors=itf,
+                 meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8))
+    os.replace(tmp, out)
+    for m in (_STAGE_RE.match(n) for n in os.listdir(path)):
+        if m and not (iteration - keep < int(m.group(1)) <= iteration):
+            try:
+                os.remove(os.path.join(path, m.string))
+            except OSError:
+                pass
+    return out
+
+
+def load_staged(path: str, meta: dict, max_iteration: Optional[int] = None):
+    """Latest matching snapshot -> (iteration, uf, itf), else None.
+    Corrupt or mismatching snapshots are skipped (newest first); snapshots
+    beyond `max_iteration` are ignored so re-running with fewer iterations
+    does not return an over-trained model."""
+    if not os.path.isdir(path):
+        return None
+    snaps = sorted(
+        (int(m.group(1)), m.string) for m in
+        (_STAGE_RE.match(n) for n in os.listdir(path)) if m
+    )
+    for iteration, name in reversed(snaps):
+        if max_iteration is not None and iteration > max_iteration:
+            continue
+        try:
+            with np.load(os.path.join(path, name)) as z:
+                saved = json.loads(bytes(z["meta"]).decode())
+                if saved != meta:
+                    continue
+                return iteration, z["user_factors"], z["item_factors"]
+        except Exception:
+            continue
+    return None
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
@@ -350,6 +446,22 @@ def init_factors(n_pad: int, k: int, key, dtype) -> jnp.ndarray:
     )
 
 
+def _pad_factors(problem: BlockedProblem, D: int, k: int, dtype,
+                 uf_raw: np.ndarray, itf_raw: np.ndarray):
+    """Dense-id (n_users, k)/(n_items, k) factors -> block-shaped padded
+    device layout (D, per_block, k)."""
+    n_users_pad = problem.users_per_block * D
+    n_items_pad = problem.items_per_block * D
+    uf0 = np.zeros((n_users_pad, k), dtype=dtype)
+    uf0[: problem.n_users] = uf_raw
+    itf0 = np.zeros((n_items_pad, k), dtype=dtype)
+    itf0[: problem.n_items] = itf_raw
+    return (
+        jnp.asarray(uf0).reshape(D, problem.users_per_block, k),
+        jnp.asarray(itf0).reshape(D, problem.items_per_block, k),
+    )
+
+
 def als_fit(
     users: np.ndarray,
     items: np.ndarray,
@@ -358,12 +470,18 @@ def als_fit(
     mesh: Mesh,
     problem: Optional[BlockedProblem] = None,
     init: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    temporary_path: Optional[str] = None,
 ) -> ALSModel:
     """Train ALS factors for the given rating triples on the mesh.
 
     `init`, when given, is (user_factors (n_users, k), item_factors
     (n_items, k)) in dense-id order — used by tests to pin the starting
     point so different block counts are exactly comparable.
+
+    `temporary_path` (the reference's setTemporaryPath, ALSImpl.scala:42-44):
+    run iterations one at a time, materializing the factors to disk at every
+    iteration boundary, and resume from the latest matching snapshot if one
+    exists.  Without it the whole loop is one fused XLA program.
     """
     D = num_blocks(mesh)
     if problem is None:
@@ -374,13 +492,7 @@ def als_fit(
     n_users_pad = problem.users_per_block * D
     n_items_pad = problem.items_per_block * D
     if init is not None:
-        uf_raw, itf_raw = init
-        uf0 = np.zeros((n_users_pad, k), dtype=dtype)
-        uf0[: problem.n_users] = uf_raw
-        itf0 = np.zeros((n_items_pad, k), dtype=dtype)
-        itf0[: problem.n_items] = itf_raw
-        uf0 = jnp.asarray(uf0).reshape(D, problem.users_per_block, k)
-        itf0 = jnp.asarray(itf0).reshape(D, problem.items_per_block, k)
+        uf0, itf0 = _pad_factors(problem, D, k, dtype, init[0], init[1])
     else:
         key_u, key_i = jax.random.split(jax.random.PRNGKey(config.seed))
         # zero the padding rows: implicit mode's psum'd Gramian (and any
@@ -414,9 +526,34 @@ def als_fit(
     ]
 
     fit_fn = _cached_sweep(problem, config, mesh)
-    uf, itf = fit_fn(jnp.asarray(config.iterations, jnp.int32), *dev_args)
-    uf = np.asarray(uf).reshape(n_users_pad, k)[: problem.n_users]
-    itf = np.asarray(itf).reshape(n_items_pad, k)[: problem.n_items]
+
+    def to_dense(uf_d, itf_d):
+        u = np.asarray(uf_d).reshape(n_users_pad, k)[: problem.n_users]
+        i = np.asarray(itf_d).reshape(n_items_pad, k)[: problem.n_items]
+        return u, i
+
+    if temporary_path is None:
+        uf, itf = fit_fn(jnp.asarray(config.iterations, jnp.int32), *dev_args)
+        uf, itf = to_dense(uf, itf)
+    else:
+        meta = _staging_meta(problem, config, init)
+        start = 0
+        snap = load_staged(temporary_path, meta,
+                           max_iteration=config.iterations)
+        if snap is not None:
+            start, uf_raw, itf_raw = snap
+            uf_d, itf_d = _pad_factors(problem, D, k, dtype, uf_raw, itf_raw)
+            shard3 = block_sharding(mesh, rank=3)
+            dev_args[0] = jax.device_put(uf_d, shard3)
+            dev_args[1] = jax.device_put(itf_d, shard3)
+        one = jnp.asarray(1, jnp.int32)
+        uf_d, itf_d = dev_args[0], dev_args[1]
+        for it in range(start, config.iterations):
+            uf_d, itf_d = fit_fn(one, uf_d, itf_d, *dev_args[2:])
+            uf, itf = to_dense(uf_d, itf_d)
+            save_staged(temporary_path, it + 1, uf, itf, meta)
+        if start == config.iterations:  # fully-resumed: nothing left to run
+            uf, itf = to_dense(uf_d, itf_d)
     return ALSModel(
         user_ids=problem.user_ids,
         item_ids=problem.item_ids,

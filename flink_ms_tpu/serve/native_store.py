@@ -21,14 +21,6 @@ _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtpums.so"))
 _lib = None
 _lib_lock = threading.Lock()
 
-_ITER_CB = ctypes.CFUNCTYPE(
-    None,
-    ctypes.POINTER(ctypes.c_char),
-    ctypes.c_uint32,
-    ctypes.POINTER(ctypes.c_char),
-    ctypes.c_uint32,
-    ctypes.c_void_p,
-)
 _KEY_CB = ctypes.CFUNCTYPE(
     None, ctypes.POINTER(ctypes.c_char), ctypes.c_uint32, ctypes.c_void_p
 )
@@ -40,11 +32,17 @@ def _load_lib():
         if _lib is not None:
             return _lib
         if not os.path.exists(_SO_PATH):
-            subprocess.run(
+            proc = subprocess.run(
                 ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                check=True,
                 capture_output=True,
+                text=True,
             )
+            if proc.returncode != 0:
+                # surface the compiler output, not just the exit status
+                raise RuntimeError(
+                    f"building native store failed (exit {proc.returncode}):\n"
+                    f"{proc.stdout}\n{proc.stderr}"
+                )
         lib = ctypes.CDLL(_SO_PATH)
         lib.tpums_open.restype = ctypes.c_void_p
         lib.tpums_open.argtypes = [ctypes.c_char_p]
@@ -56,7 +54,7 @@ def _load_lib():
         lib.tpums_get.restype = ctypes.POINTER(ctypes.c_char)
         lib.tpums_get.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
-            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int),
         ]
         lib.tpums_free_buf.argtypes = [ctypes.POINTER(ctypes.c_char)]
         lib.tpums_delete.restype = ctypes.c_int
@@ -67,8 +65,6 @@ def _load_lib():
         lib.tpums_count.argtypes = [ctypes.c_void_p]
         lib.tpums_flush.restype = ctypes.c_int
         lib.tpums_flush.argtypes = [ctypes.c_void_p]
-        lib.tpums_iterate.restype = ctypes.c_int
-        lib.tpums_iterate.argtypes = [ctypes.c_void_p, _ITER_CB, ctypes.c_void_p]
         lib.tpums_keys.restype = ctypes.c_int
         lib.tpums_keys.argtypes = [ctypes.c_void_p, _KEY_CB, ctypes.c_void_p]
         lib.tpums_log_bytes.restype = ctypes.c_uint64
@@ -128,8 +124,15 @@ class NativeStore:
     def get(self, key: str) -> Optional[str]:
         k = key.encode("utf-8")
         vlen = ctypes.c_uint32()
-        p = self._lib.tpums_get(self._h, k, len(k), ctypes.byref(vlen))
+        err = ctypes.c_int()
+        p = self._lib.tpums_get(
+            self._h, k, len(k), ctypes.byref(vlen), ctypes.byref(err)
+        )
         if not p:
+            if err.value:
+                # the key exists but its value could not be read — an I/O
+                # failure must not masquerade as "key not found"
+                raise OSError(f"tpums_get I/O failure for key {key!r}")
             return None
         try:
             return ctypes.string_at(p, vlen.value).decode("utf-8")

@@ -11,11 +11,14 @@ Flags beyond the reference (TPU-native surface):
   --devices N          mesh size (defaults to all visible devices; the
                        reference's --blocks maps to Flink's internal blocking
                        and is accepted — blocking here always equals the mesh)
+  --profileDir DIR     write an XLA profiler trace of the fit (TensorBoard)
 
 ``--temporaryPath`` (reference: stage loop intermediates to disk,
-ALSImpl.scala:42-44) is accepted and stages a copy of the final factors
-under that path; the training loop itself is one fused XLA program, so
-there are no per-iteration host-side intermediates to spill.
+ALSImpl.scala:42-44) switches the training loop from one fused XLA program
+to per-iteration steps with the factors materialized to disk at every
+iteration boundary — and resumes from the latest snapshot on restart
+(training checkpoint/resume, SURVEY.md §5).  A copy of the final factors is
+also staged under that path.
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ from ..core import formats as F
 from ..core.params import Params, field_delimiter_from
 from ..ops.als import ALSConfig, ALSModel, als_fit, rmse
 from ..parallel.mesh import make_mesh
+from ..utils import profiling
 
 
 def run(params: Params) -> ALSModel | None:
@@ -63,8 +67,14 @@ def run(params: Params) -> ALSModel | None:
         n_devices = min(blocks, avail) if blocks is not None else avail
     mesh = make_mesh(n_devices)
 
+    # get_required raises loudly on a present-but-valueless flag
+    tmp = params.get_required("temporaryPath") if params.has("temporaryPath") else None
     t0 = time.time()
-    model = als_fit(users, items, ratings, config, mesh)
+    with profiling.trace(params.get("profileDir")):
+        model = als_fit(
+            users, items, ratings, config, mesh,
+            temporary_path=tmp.rstrip("/") if tmp else None,
+        )
     train_s = time.time() - t0
     print(
         f"[ALS] model-training: {len(users)} ratings, "
@@ -75,8 +85,8 @@ def run(params: Params) -> ALSModel | None:
         f"train RMSE={rmse(model, users, items, ratings):.4f}"
     )
 
-    if params.has("temporaryPath"):
-        tmp = params.get_required("temporaryPath").rstrip("/")
+    if tmp:
+        tmp = tmp.rstrip("/")
         F.write_als_model(f"{tmp}/userFactors", model.user_ids, F.USER, model.user_factors)
         F.write_als_model(f"{tmp}/itemFactors", model.item_ids, F.ITEM, model.item_factors)
 

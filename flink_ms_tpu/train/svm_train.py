@@ -10,7 +10,8 @@ range-partitioned ``bucket,idx:w;...`` (SVMImpl.scala:33-46).
 
 TPU-native extras surface FlinkML's hidden CoCoA knobs [dep]:
 ``--localIterations`` (default: one full local pass per round),
-``--regularization`` (1.0), ``--stepsize`` (1.0), ``--seed``, ``--devices``.
+``--regularization`` (1.0), ``--stepsize`` (1.0), ``--seed``, ``--devices``,
+``--profileDir`` (XLA profiler trace of the fit).
 """
 
 from __future__ import annotations
@@ -22,6 +23,7 @@ from ..core import formats as F
 from ..core.params import Params
 from ..ops.svm import SVMConfig, SVMModel, prepare_svm_blocked, svm_fit
 from ..parallel.mesh import make_mesh
+from ..utils import profiling
 
 
 def run(params: Params) -> SVMModel:
@@ -51,7 +53,8 @@ def run(params: Params) -> SVMModel:
     )
 
     t0 = time.time()
-    model = svm_fit(data, config, mesh, problem=problem)
+    with profiling.trace(params.get("profileDir")):
+        model = svm_fit(data, config, mesh, problem=problem)
     train_s = time.time() - t0
     print(
         f"[SVM] model-fitting: {data.n_examples} examples x "

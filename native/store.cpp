@@ -44,6 +44,7 @@ struct Store {
   int fd = -1;
   uint64_t end = 0;        // append position
   uint64_t live_bytes = 0; // bytes of records still referenced
+  bool wedged = false;     // unrecoverable write failure: reads-only mode
   std::unordered_map<std::string, Entry> index;
   std::mutex mu;
 };
@@ -76,11 +77,15 @@ bool write_all(int fd, const void* buf, size_t n) {
   return true;
 }
 
+constexpr uint64_t kScanFailed = ~0ull;
+
 // Scan the log, rebuilding the index; returns the offset of the first
-// incomplete record (the recovery truncation point).
+// incomplete record (the recovery truncation point), or kScanFailed when
+// the log length cannot even be determined (distinct from "empty log" —
+// returning 0 there would let the caller truncate a healthy store).
 uint64_t rebuild_index(Store* s) {
   struct stat st;
-  if (fstat(s->fd, &st) != 0) return 0;
+  if (fstat(s->fd, &st) != 0) return kScanFailed;
   uint64_t size = static_cast<uint64_t>(st.st_size);
   uint64_t pos = 0;
   std::string key;
@@ -118,11 +123,21 @@ int append_record(Store* s, const char* k, uint32_t klen, const char* v,
   // after it on the next reopen
   if (klen > kMaxKeyLen || (vlen != kTombstone && vlen > kMaxValueLen))
     return -1;
+  if (s->wedged) return -1;
   uint32_t hdr[2] = {klen, vlen};
   uint64_t vbytes = (vlen == kTombstone) ? 0 : vlen;
-  if (!write_all(s->fd, hdr, 8)) return -1;
-  if (klen && !write_all(s->fd, k, klen)) return -1;
-  if (vbytes && !write_all(s->fd, v, vbytes)) return -1;
+  if (!write_all(s->fd, hdr, 8) || (klen && !write_all(s->fd, k, klen)) ||
+      (vbytes && !write_all(s->fd, v, vbytes))) {
+    // partial append (ENOSPC/EIO): roll the file back to the last complete
+    // record, otherwise every later record's indexed offset is shifted
+    // (the fd is O_APPEND, so retries would land past the partial bytes)
+    if (ftruncate(s->fd, static_cast<off_t>(s->end)) != 0) {
+      // can't restore the invariant offset==end: refuse further writes,
+      // keep serving reads from the already-indexed prefix
+      s->wedged = true;
+    }
+    return -1;
+  }
   std::string key(k, klen);
   auto it = s->index.find(key);
   if (it != s->index.end()) {
@@ -160,7 +175,13 @@ void* tpums_open(const char* dir) {
   }
   uint64_t valid = rebuild_index(s);
   struct stat st;
-  fstat(s->fd, &st);
+  if (valid == kScanFailed || fstat(s->fd, &st) != 0) {
+    // can't tell log length: refuse to open rather than risk truncating a
+    // healthy log against garbage st_size
+    close(s->fd);
+    delete s;
+    return nullptr;
+  }
   if (valid < static_cast<uint64_t>(st.st_size)) {
     // torn tail from a crash mid-append: truncate to last complete record
     if (ftruncate(s->fd, static_cast<off_t>(valid)) != 0) {
@@ -189,7 +210,11 @@ int tpums_delete(void* h, const char* k, uint32_t klen) {
 }
 
 // Returns a malloc'd value buffer (caller frees via tpums_free_buf) or null.
-char* tpums_get(void* h, const char* k, uint32_t klen, uint32_t* vlen_out) {
+// A null return with *err_out != 0 is an I/O failure on an EXISTING key —
+// callers must surface it as an error, not as "key not found".
+char* tpums_get(void* h, const char* k, uint32_t klen, uint32_t* vlen_out,
+                int* err_out) {
+  if (err_out) *err_out = 0;
   if (!h) return nullptr;
   Store* s = static_cast<Store*>(h);
   // the pread must stay under the lock: compaction closes/reopens the fd
@@ -201,9 +226,13 @@ char* tpums_get(void* h, const char* k, uint32_t klen, uint32_t* vlen_out) {
   uint64_t off = it->second.offset;
   uint32_t len = it->second.length;
   char* buf = static_cast<char*>(malloc(len ? len : 1));
-  if (!buf) return nullptr;
+  if (!buf) {
+    if (err_out) *err_out = 1;
+    return nullptr;
+  }
   if (len && !read_exact(s->fd, buf, len, off)) {
     free(buf);
+    if (err_out) *err_out = 1;
     return nullptr;
   }
   *vlen_out = len;
@@ -224,26 +253,6 @@ int tpums_flush(void* h) {
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return fsync(s->fd) == 0 ? 0 : -1;
-}
-
-// Iterate all live keys: calls cb(key, klen, value, vlen, ctx) under the
-// store lock.  Used by snapshot export and the top-k index builder.
-typedef void (*tpums_iter_cb)(const char*, uint32_t, const char*, uint32_t,
-                              void*);
-int tpums_iterate(void* h, tpums_iter_cb cb, void* ctx) {
-  if (!h) return -1;
-  Store* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> lock(s->mu);
-  std::vector<char> buf;
-  for (const auto& kv : s->index) {
-    buf.resize(kv.second.length ? kv.second.length : 1);
-    if (kv.second.length &&
-        !read_exact(s->fd, buf.data(), kv.second.length, kv.second.offset))
-      return -1;
-    cb(kv.first.data(), static_cast<uint32_t>(kv.first.size()), buf.data(),
-       kv.second.length, ctx);
-  }
-  return 0;
 }
 
 // Iterate keys only (no value reads) — lets bindings stream large stores:
@@ -303,25 +312,20 @@ int tpums_compact(void* h) {
     new_index[kv.first] = Entry{new_end + 8 + klen, vlen};
     new_end += 8 + klen + vlen;
   }
-  if (fsync(out) != 0 || rename(tmp_path.c_str(), s->log_path.c_str()) != 0) {
+  // Lock the compacted inode and switch it to append mode BEFORE rename
+  // makes it visible at log_path: every failure path still leaves the old
+  // locked log fully intact, and after rename the store's own `out` fd
+  // already holds the writer lock — no window for a second process, and no
+  // post-rename failure can desynchronize the in-memory index.
+  if (fsync(out) != 0 || flock(out, LOCK_EX | LOCK_NB) != 0 ||
+      fcntl(out, F_SETFL, O_APPEND) != 0 ||
+      rename(tmp_path.c_str(), s->log_path.c_str()) != 0) {
     close(out);
     unlink(tmp_path.c_str());
     return -1;
   }
-  close(s->fd);
-  // reopen in append mode so subsequent puts land at the end, and re-take
-  // the writer lock: rename() replaced the locked inode, so without this a
-  // second process could open the fresh log and interleave appends
-  s->fd = ::open(s->log_path.c_str(), O_RDWR | O_APPEND, 0644);
-  if (s->fd < 0) {
-    close(out);
-    return -1;
-  }
-  if (flock(s->fd, LOCK_EX | LOCK_NB) != 0) {
-    close(out);
-    return -1;
-  }
-  close(out);
+  close(s->fd);  // releases the old inode's lock
+  s->fd = out;   // file offset sits at new_end, O_APPEND set: puts append
   s->index = std::move(new_index);
   s->end = new_end;
   s->live_bytes = new_end;

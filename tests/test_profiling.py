@@ -1,0 +1,50 @@
+"""Profiling utilities: step timing statistics and the XLA trace context
+(SURVEY.md §5 — the reference has only ad-hoc latency CSVs; the TPU-native
+framework adds profiler traces + per-step timing)."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ms_tpu.utils.profiling import StepTimer, trace
+
+
+def test_step_timer_stats(tmp_path):
+    t = StepTimer("unit")
+    for _ in range(10):
+        with t:
+            time.sleep(0.001)
+    s = t.stats()
+    assert s["steps"] == 10
+    assert s["total_s"] >= 0.01
+    assert s["p50_s"] <= s["p99_s"] <= s["total_s"]
+    assert "unit" in t.summary() and "p99" in t.summary()
+    out = str(tmp_path / "timing.json")
+    t.write_json(out)
+    assert json.load(open(out))["steps"] == 10
+
+
+def test_step_timer_empty():
+    t = StepTimer("empty")
+    assert np.isnan(t.stats()["mean_s"])
+    assert np.isnan(t.percentile(50))
+
+
+def test_trace_none_is_noop():
+    with trace(None):
+        pass
+    with trace(""):
+        pass
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with trace(d):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    # the profiler lays out plugins/profile/<run>/..., just require non-empty
+    found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert found, "profiler trace produced no files"
