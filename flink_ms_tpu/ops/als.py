@@ -28,6 +28,7 @@ Everything under ``jit`` is static-shaped; the iteration loop is a
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import json
@@ -384,12 +385,16 @@ def save_staged(path: str, iteration: int, uf: np.ndarray, itf: np.ndarray,
         np.savez(f, user_factors=uf, item_factors=itf,
                  meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8))
     os.replace(tmp, out)
-    for m in (_STAGE_RE.match(n) for n in os.listdir(path)):
-        if m and not (iteration - keep < int(m.group(1)) <= iteration):
-            try:
-                os.remove(os.path.join(path, m.string))
-            except OSError:
-                pass
+    for name in os.listdir(path):
+        m = _STAGE_RE.match(name)
+        if m and iteration - keep < int(m.group(1)) <= iteration:
+            continue
+        if not (m or name.endswith(".npz.tmp")):  # orphans of a mid-write kill
+            continue
+        try:
+            os.remove(os.path.join(path, name))
+        except OSError:
+            pass
     return out
 
 
@@ -471,6 +476,7 @@ def als_fit(
     problem: Optional[BlockedProblem] = None,
     init: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     temporary_path: Optional[str] = None,
+    step_timer=None,
 ) -> ALSModel:
     """Train ALS factors for the given rating triples on the mesh.
 
@@ -482,6 +488,9 @@ def als_fit(
     run iterations one at a time, materializing the factors to disk at every
     iteration boundary, and resume from the latest matching snapshot if one
     exists.  Without it the whole loop is one fused XLA program.
+
+    `step_timer`: optional ``utils.profiling.StepTimer``; in staged mode each
+    iteration (device step + snapshot write) is timed as one step.
     """
     D = num_blocks(mesh)
     if problem is None:
@@ -542,16 +551,20 @@ def als_fit(
                            max_iteration=config.iterations)
         if snap is not None:
             start, uf_raw, itf_raw = snap
-            uf_d, itf_d = _pad_factors(problem, D, k, dtype, uf_raw, itf_raw)
-            shard3 = block_sharding(mesh, rank=3)
-            dev_args[0] = jax.device_put(uf_d, shard3)
-            dev_args[1] = jax.device_put(itf_d, shard3)
+            uf_s, itf_s = _pad_factors(problem, D, k, dtype, uf_raw, itf_raw)
+            dev_args[0] = jax.device_put(uf_s, shard3)
+            dev_args[1] = jax.device_put(itf_s, shard3)
         one = jnp.asarray(1, jnp.int32)
         uf_d, itf_d = dev_args[0], dev_args[1]
+        # the loop carries its own factor buffers from here on — drop the
+        # list's references so the initial copies don't pin HBM all run long
+        dev_args[0] = dev_args[1] = None
+        timer = step_timer if step_timer is not None else contextlib.nullcontext()
         for it in range(start, config.iterations):
-            uf_d, itf_d = fit_fn(one, uf_d, itf_d, *dev_args[2:])
-            uf, itf = to_dense(uf_d, itf_d)
-            save_staged(temporary_path, it + 1, uf, itf, meta)
+            with timer:
+                uf_d, itf_d = fit_fn(one, uf_d, itf_d, *dev_args[2:])
+                uf, itf = to_dense(uf_d, itf_d)
+                save_staged(temporary_path, it + 1, uf, itf, meta)
         if start == config.iterations:  # fully-resumed: nothing left to run
             uf, itf = to_dense(uf_d, itf_d)
     return ALSModel(

@@ -11,6 +11,7 @@ is invoked once (g++ is baked into the image).
 from __future__ import annotations
 
 import ctypes
+import fcntl
 import os
 import subprocess
 import threading
@@ -31,18 +32,41 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
-            proc = subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                capture_output=True,
-                text=True,
-            )
-            if proc.returncode != 0:
-                # surface the compiler output, not just the exit status
-                raise RuntimeError(
-                    f"building native store failed (exit {proc.returncode}):\n"
-                    f"{proc.stdout}\n{proc.stderr}"
+        # rebuild when the .so is missing or older than its sources: a stale
+        # prebuilt .so under newer declared argtypes would corrupt the ABI
+        # silently, while an up-to-date .so must keep loading on machines
+        # with no toolchain at all
+        native_dir = os.path.abspath(_NATIVE_DIR)
+        sources = [os.path.join(native_dir, n) for n in ("store.cpp", "Makefile")]
+        stale = not os.path.exists(_SO_PATH) or any(
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+            for src in sources
+        )
+        if stale:
+            # cross-process build lock: _lib_lock is per-process only, and
+            # two concurrent `make` runs would race on the link output
+            lock_path = os.path.join(native_dir, ".build.lock")
+            with open(lock_path, "w") as lock_f:
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+                still_stale = not os.path.exists(_SO_PATH) or any(
+                    os.path.exists(src)
+                    and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+                    for src in sources
                 )
+                if still_stale:
+                    proc = subprocess.run(
+                        ["make", "-C", native_dir],
+                        capture_output=True,
+                        text=True,
+                    )
+                    if proc.returncode != 0:
+                        # surface the compiler output, not just the exit status
+                        raise RuntimeError(
+                            "building native store failed "
+                            f"(exit {proc.returncode}):\n"
+                            f"{proc.stdout}\n{proc.stderr}"
+                        )
         lib = ctypes.CDLL(_SO_PATH)
         lib.tpums_open.restype = ctypes.c_void_p
         lib.tpums_open.argtypes = [ctypes.c_char_p]

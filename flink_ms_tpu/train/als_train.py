@@ -68,14 +68,24 @@ def run(params: Params) -> ALSModel | None:
     mesh = make_mesh(n_devices)
 
     # get_required raises loudly on a present-but-valueless flag
-    tmp = params.get_required("temporaryPath") if params.has("temporaryPath") else None
+    tmp = (
+        params.get_required("temporaryPath").rstrip("/")
+        if params.has("temporaryPath")
+        else None
+    )
+    if tmp == "":  # "--temporaryPath /" (or all slashes) is not a usable dir
+        raise ValueError("--temporaryPath must name a directory, got a bare '/'")
     t0 = time.time()
+    step_timer = profiling.StepTimer("als-iteration") if tmp else None
     with profiling.trace(params.get("profileDir")):
         model = als_fit(
             users, items, ratings, config, mesh,
-            temporary_path=tmp.rstrip("/") if tmp else None,
+            temporary_path=tmp,
+            step_timer=step_timer,
         )
     train_s = time.time() - t0
+    if step_timer is not None and step_timer.durations_s:
+        print(step_timer.summary())
     print(
         f"[ALS] model-training: {len(users)} ratings, "
         f"{len(model.user_ids)} users x {len(model.item_ids)} items, "
@@ -86,7 +96,6 @@ def run(params: Params) -> ALSModel | None:
     )
 
     if tmp:
-        tmp = tmp.rstrip("/")
         F.write_als_model(f"{tmp}/userFactors", model.user_ids, F.USER, model.user_factors)
         F.write_als_model(f"{tmp}/itemFactors", model.item_ids, F.ITEM, model.item_factors)
 

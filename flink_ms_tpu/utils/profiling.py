@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import time
 from typing import Dict, List, Optional
 
@@ -59,8 +60,9 @@ class StepTimer:
         if not self.durations_s:
             return float("nan")
         xs = sorted(self.durations_s)
-        idx = min(int(q / 100.0 * len(xs)), len(xs) - 1)
-        return xs[idx]
+        # nearest-rank: smallest value with cumulative share >= q
+        idx = max(math.ceil(q / 100.0 * len(xs)) - 1, 0)
+        return xs[min(idx, len(xs) - 1)]
 
     def stats(self) -> Dict[str, float]:
         n = len(self.durations_s)

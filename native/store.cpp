@@ -91,14 +91,17 @@ uint64_t rebuild_index(Store* s) {
   std::string key;
   while (pos + 8 <= size) {
     uint32_t hdr[2];
-    if (!read_exact(s->fd, hdr, 8, pos)) break;
+    // both reads below are fully inside [0, size): a failure is a real I/O
+    // error (EIO, concurrent truncation), NOT a torn tail — refusing to open
+    // beats truncating away committed records after the failure point
+    if (!read_exact(s->fd, hdr, 8, pos)) return kScanFailed;
     uint32_t klen = hdr[0], vlen = hdr[1];
     uint64_t vbytes = (vlen == kTombstone) ? 0 : vlen;
     if (klen > (1u << 20) || (vlen != kTombstone && vlen > (1u << 28)))
       break;  // corrupt header
     if (pos + 8 + klen + vbytes > size) break;  // torn tail
     key.resize(klen);
-    if (klen && !read_exact(s->fd, &key[0], klen, pos + 8)) break;
+    if (klen && !read_exact(s->fd, &key[0], klen, pos + 8)) return kScanFailed;
     auto it = s->index.find(key);
     if (it != s->index.end()) {
       s->live_bytes -= 8 + key.size() + it->second.length;
@@ -329,6 +332,7 @@ int tpums_compact(void* h) {
   s->index = std::move(new_index);
   s->end = new_end;
   s->live_bytes = new_end;
+  s->wedged = false;  // fresh fd at new_end: the offset invariant holds again
   return 0;
 }
 

@@ -220,6 +220,28 @@ def test_staged_fit_matches_fused(rng, tmp_path):
     assert snaps == ["iter_00002.npz", "iter_00003.npz"]
 
 
+def test_staged_prunes_orphan_tmp_and_times_steps(rng, tmp_path):
+    """A mid-write kill leaves iter_*.npz.tmp orphans; the next staged run
+    must clean them up.  A passed StepTimer records one entry per staged
+    iteration."""
+    import os
+
+    from flink_ms_tpu.utils.profiling import StepTimer
+
+    u, i, r = _synthetic(rng)
+    mesh = make_mesh(1)
+    staged_dir = tmp_path / "stage"
+    staged_dir.mkdir()
+    (staged_dir / "iter_00009.npz.tmp").write_bytes(b"partial")
+    cfg = A.ALSConfig(num_factors=3, iterations=2, lambda_=0.1)
+    timer = StepTimer("als-iteration")
+    A.als_fit(u, i, r, cfg, mesh, temporary_path=str(staged_dir),
+              step_timer=timer)
+    names = os.listdir(staged_dir)
+    assert not any(n.endswith(".tmp") for n in names)
+    assert len(timer.durations_s) == 2
+
+
 def test_staged_rerun_with_fewer_iterations_not_overtrained(rng, tmp_path):
     """Re-running with a smaller --iterations must not return the later
     (over-trained) snapshot from a previous longer run."""

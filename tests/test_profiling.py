@@ -28,6 +28,17 @@ def test_step_timer_stats(tmp_path):
     assert json.load(open(out))["steps"] == 10
 
 
+def test_percentile_nearest_rank():
+    t = StepTimer("ranks")
+    t.durations_s.extend(float(i) for i in range(1, 11))  # 1..10
+    assert t.percentile(50) == 5.0   # smallest value covering >= 50%
+    assert t.percentile(10) == 1.0
+    assert t.percentile(100) == 10.0
+    t2 = StepTimer("two")
+    t2.durations_s.extend([1.0, 9.0])
+    assert t2.percentile(50) == 1.0  # not the max
+
+
 def test_step_timer_empty():
     t = StepTimer("empty")
     assert np.isnan(t.stats()["mean_s"])
