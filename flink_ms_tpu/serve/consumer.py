@@ -147,6 +147,7 @@ class ServingJob:
         job_id: Optional[str] = None,
         restart_attempts: int = 3,
         restart_delay_s: float = 10.0,
+        native_server: bool = False,
     ):
         self.journal = journal
         self.state_name = state_name
@@ -167,19 +168,39 @@ class ServingJob:
         self.parse_errors = 0
         self._stop = threading.Event()
         self._consumer_thread: Optional[threading.Thread] = None
-        topk_handlers = {}
-        if state_name == ALS_STATE:
-            # device-scored top-k over the live item factors (serve/topk.py)
-            from .topk import make_als_topk_handler
+        if native_server:
+            # C++ epoll data plane reading the persistent store directly —
+            # requires the native (rocksdb) backend, which owns the store
+            from .native_store import NativeLookupServer
 
-            topk_handlers[state_name] = make_als_topk_handler(self.table)
-        self.server = LookupServer(
-            {state_name: self.table},
-            host=host,
-            port=port,
-            job_id=self.job_id,
-            topk_handlers=topk_handlers,
-        )
+            if not hasattr(backend, "store"):
+                # either the wrong backend kind was requested, or rocksdb WAS
+                # requested but degraded to fs because the native build is
+                # unavailable (make_backend printed the cause)
+                raise ValueError(
+                    "--nativeServer needs the native (rocksdb) store, but the "
+                    f"active backend is '{backend.kind}' — pass --stateBackend "
+                    "rocksdb, and if you did, the native store failed to load "
+                    "(see the warning above for the build error)"
+                )
+            self.server = NativeLookupServer(
+                backend.store, state_name, job_id=self.job_id,
+                host=host, port=port,
+            )
+        else:
+            topk_handlers = {}
+            if state_name == ALS_STATE:
+                # device-scored top-k over the live item factors (serve/topk.py)
+                from .topk import make_als_topk_handler
+
+                topk_handlers[state_name] = make_als_topk_handler(self.table)
+            self.server = LookupServer(
+                {state_name: self.table},
+                host=host,
+                port=port,
+                job_id=self.job_id,
+                topk_handlers=topk_handlers,
+            )
         self.port = self.server.port
 
     # -- lifecycle ---------------------------------------------------------
@@ -306,6 +327,7 @@ def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
         host=params.get("host", "0.0.0.0"),
         port=params.get_int("port", 6123),
         job_id=params.get("jobId"),
+        native_server=params.get_bool("nativeServer", False),
     )
     print(
         f"[serve] {state_name} serving topic '{journal.topic}' on port "

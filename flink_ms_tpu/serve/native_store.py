@@ -37,7 +37,10 @@ def _load_lib():
         # silently, while an up-to-date .so must keep loading on machines
         # with no toolchain at all
         native_dir = os.path.abspath(_NATIVE_DIR)
-        sources = [os.path.join(native_dir, n) for n in ("store.cpp", "Makefile")]
+        sources = [
+            os.path.join(native_dir, n)
+            for n in ("store.cpp", "lookup_server.cpp", "tpums.h", "Makefile")
+        ]
         stale = not os.path.exists(_SO_PATH) or any(
             os.path.exists(src)
             and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
@@ -98,6 +101,16 @@ def _load_lib():
         lib.tpums_compact.restype = ctypes.c_int
         lib.tpums_compact.argtypes = [ctypes.c_void_p]
         lib.tpums_close.argtypes = [ctypes.c_void_p]
+        lib.tpums_server_start.restype = ctypes.c_void_p
+        lib.tpums_server_start.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.tpums_server_port.restype = ctypes.c_int
+        lib.tpums_server_port.argtypes = [ctypes.c_void_p]
+        lib.tpums_server_requests.restype = ctypes.c_uint64
+        lib.tpums_server_requests.argtypes = [ctypes.c_void_p]
+        lib.tpums_server_stop.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -254,6 +267,52 @@ class NativeModelTable:
         for k, v in self.store.items():
             if not k.startswith("\x01"):
                 yield k, v
+
+
+class NativeLookupServer:
+    """C++ epoll lookup server (native/lookup_server.cpp) serving point GETs
+    straight from an open NativeStore — the Netty-KvState-parity data plane
+    with no Python on the hot path.  Same line protocol as
+    ``serve.server.LookupServer``; TOPK answers with an error (device-scored
+    top-k stays on the Python server).
+    """
+
+    def __init__(self, store: NativeStore, state_name: str,
+                 job_id: str = "local", host: str = "0.0.0.0", port: int = 0):
+        self._lib = store._lib
+        self._store = store  # keep the store alive while the server reads it
+        self._h = self._lib.tpums_server_start(
+            store._h,
+            state_name.encode("utf-8"),
+            job_id.encode("utf-8"),
+            host.encode("utf-8"),
+            port,
+        )
+        if not self._h:
+            raise OSError(
+                f"tpums_server_start failed on {host}:{port} (port in use?)"
+            )
+        self.state_name = state_name
+        self.job_id = job_id
+        self.port = int(self._lib.tpums_server_port(self._h))
+
+    @property
+    def requests(self) -> int:
+        return int(self._lib.tpums_server_requests(self._h)) if self._h else 0
+
+    def start(self) -> "NativeLookupServer":
+        return self  # started in __init__; method mirrors LookupServer's API
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.tpums_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
 
 
 class NativeStateBackend:

@@ -13,9 +13,12 @@ Line protocol over TCP (persistent connections, thread per client):
               ``E\\t<msg>\\n``    error (unknown state name, bad request)
               ``PONG\\t<job_id>\\t<state_name>\\n``
 
-A C++ epoll implementation of the same protocol backs the native state
-backend (native/, task: rocksdb-parity mode); this Python server is the
-default and the semantics contract.
+A C++ epoll implementation of the same protocol
+(``native/lookup_server.cpp``, wrapped by
+``native_store.NativeLookupServer``, enabled with ``--nativeServer true`` on
+the rocksdb backend) serves point GETs straight from the persistent store;
+this Python server is the default and the semantics contract, and the only
+one that answers TOPK.
 """
 
 from __future__ import annotations

@@ -1,0 +1,326 @@
+// Epoll TCP lookup server — the native serving data plane.
+//
+// TPU-native counterpart of the Flink queryable-state (Netty KvState) server
+// answering QueryClientHelper.queryState (QueryClientHelper.java:104-139).
+// Speaks the exact line protocol of flink_ms_tpu/serve/server.py so the
+// Python query clients work unchanged:
+//
+//   GET\t<state>\t<key>\n   ->  V\t<value>\n | N\n | E\t<msg>\n
+//   PING\n                  ->  PONG\t<job_id>\t<state>\n
+//   TOPK\t...\n             ->  E\tno topk index for state: <state>\n
+//                               (device-scored top-k stays on the Python
+//                               server — this is the point-lookup hot path)
+//
+// One epoll thread, level-triggered, nonblocking sockets; per-connection
+// in/out buffers; EPOLLOUT armed only while a response is partially written.
+// Store reads go through the public tpums_get API (internally mutex'd), so
+// the journal-consumer thread can keep writing while this thread serves.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "tpums.h"
+
+namespace {
+
+constexpr size_t kMaxLine = 1u << 20;  // 1 MB request line cap
+constexpr size_t kReadChunk = 64 * 1024;
+
+struct Conn {
+  int fd = -1;
+  std::string in;   // bytes read, not yet parsed into complete lines
+  std::string out;  // response bytes not yet written
+  bool writable_armed = false;
+  bool eof = false;  // client half-closed: answer what's buffered, then close
+};
+
+struct ServerState {
+  void* store = nullptr;
+  std::string state_name;
+  std::string job_id;
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;  // eventfd: poked by tpums_server_stop
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> requests{0};
+  std::thread loop;
+  std::unordered_map<int, Conn> conns;
+};
+
+bool set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+// Split `line` on '\t' into at most `max_parts` pieces (last piece keeps any
+// remaining tabs, matching Python's str.split("\t") when the counts line up
+// because keys/payloads never contain tabs).
+int split_tabs(const std::string& line, std::string* parts, int max_parts) {
+  int n = 0;
+  size_t start = 0;
+  while (n < max_parts - 1) {
+    size_t tab = line.find('\t', start);
+    if (tab == std::string::npos) break;
+    parts[n++] = line.substr(start, tab - start);
+    start = tab + 1;
+  }
+  parts[n++] = line.substr(start);
+  return n;
+}
+
+std::string handle_line(ServerState* s, const std::string& line) {
+  s->requests.fetch_add(1, std::memory_order_relaxed);
+  // 5 slots: one more than the widest verb, so an over-long request is
+  // distinguishable from an exact TOPK (Python splits unbounded; parity
+  // demands "TOPK\ta\tb\tc\td" be a bad request, not a TOPK)
+  std::string parts[5];
+  int n = split_tabs(line, parts, 5);
+  if (parts[0] == "PING") {  // Python matches on parts[0] alone
+    return "PONG\t" + s->job_id + "\t" + s->state_name + "\n";
+  }
+  if (parts[0] == "GET" && n == 3) {
+    if (parts[1] != s->state_name) {
+      return "E\tunknown state: " + parts[1] + "\n";
+    }
+    uint32_t vlen = 0;
+    int err = 0;
+    char* buf = tpums_get(s->store, parts[2].data(),
+                          static_cast<uint32_t>(parts[2].size()), &vlen, &err);
+    if (!buf) {
+      return err ? "E\tstore read failed\n" : "N\n";
+    }
+    std::string reply;
+    reply.reserve(vlen + 3);
+    reply.append("V\t").append(buf, vlen).push_back('\n');
+    tpums_free_buf(buf);
+    return reply;
+  }
+  if (parts[0] == "TOPK" && n == 4) {
+    // parity with a Python LookupServer that has no registered handler
+    return "E\tno topk index for state: " + parts[1] + "\n";
+  }
+  return "E\tbad request\n";
+}
+
+void arm_writable(ServerState* s, Conn* c, bool want) {
+  if (c->writable_armed == want) return;
+  epoll_event ev{};
+  ev.events = EPOLLIN | (want ? EPOLLOUT : 0u);
+  ev.data.fd = c->fd;
+  if (epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev) == 0) {
+    c->writable_armed = want;
+  }
+}
+
+void close_conn(ServerState* s, int fd) {
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+  s->conns.erase(fd);
+}
+
+// Drain as much of c->out as the socket accepts; false = close the conn.
+bool flush_out(ServerState* s, Conn* c) {
+  while (!c->out.empty()) {
+    ssize_t w = send(c->fd, c->out.data(), c->out.size(), MSG_NOSIGNAL);
+    if (w > 0) {
+      c->out.erase(0, static_cast<size_t>(w));
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      arm_writable(s, c, true);
+      return true;
+    }
+    return false;  // peer gone
+  }
+  arm_writable(s, c, false);
+  return true;
+}
+
+// Read available bytes, answer every complete line; false = close the conn.
+bool on_readable(ServerState* s, Conn* c) {
+  char chunk[kReadChunk];
+  while (true) {
+    ssize_t r = recv(c->fd, chunk, sizeof(chunk), 0);
+    if (r > 0) {
+      c->in.append(chunk, static_cast<size_t>(r));
+      if (c->in.size() > kMaxLine) return false;  // oversized request
+      continue;
+    }
+    if (r == 0) {  // orderly half-close: still answer the buffered requests
+      c->eof = true;
+      break;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+    return false;
+  }
+  size_t start = 0;
+  while (true) {
+    size_t nl = c->in.find('\n', start);
+    if (nl == std::string::npos) break;
+    c->out += handle_line(s, c->in.substr(start, nl - start));
+    start = nl + 1;
+  }
+  c->in.erase(0, start);
+  return flush_out(s, c);
+}
+
+void event_loop(ServerState* s) {
+  epoll_event events[64];
+  while (!s->stop.load(std::memory_order_acquire)) {
+    int n = epoll_wait(s->epoll_fd, events, 64, -1);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; ++i) {
+      int fd = events[i].data.fd;
+      uint32_t ev = events[i].events;
+      if (fd == s->wake_fd) {
+        uint64_t tok;
+        ssize_t rd = read(s->wake_fd, &tok, 8);
+        (void)rd;
+        continue;  // stop flag is checked at the top of the loop
+      }
+      if (fd == s->listen_fd) {
+        while (true) {
+          int cfd = accept(s->listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;  // EAGAIN or transient error: try next wakeup
+          if (!set_nonblocking(cfd)) {
+            close(cfd);
+            continue;
+          }
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          epoll_event cev{};
+          cev.events = EPOLLIN;
+          cev.data.fd = cfd;
+          if (epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, cfd, &cev) != 0) {
+            close(cfd);
+            continue;
+          }
+          s->conns[cfd].fd = cfd;
+        }
+        continue;
+      }
+      auto it = s->conns.find(fd);
+      if (it == s->conns.end()) continue;
+      Conn* c = &it->second;
+      bool ok = true;
+      if (ev & EPOLLERR) ok = false;
+      if (ok && (ev & EPOLLIN)) ok = on_readable(s, c);
+      if (ok && (ev & EPOLLOUT)) ok = flush_out(s, c);
+      // half-closed and fully answered (EPOLLHUP arrives with EPOLLIN on a
+      // shutdown(WR) peer — the buffered requests must still be served)
+      if (ok && c->eof && c->out.empty()) ok = false;
+      if (!ok) close_conn(s, fd);
+    }
+  }
+  for (auto& kv : s->conns) close(kv.first);
+  s->conns.clear();
+}
+
+void destroy(ServerState* s) {
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  if (s->wake_fd >= 0) close(s->wake_fd);
+  if (s->epoll_fd >= 0) close(s->epoll_fd);
+  delete s;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tpums_server_start(void* store, const char* state_name,
+                         const char* job_id, const char* host, int port) {
+  if (!store || !state_name) return nullptr;
+  auto* s = new ServerState();
+  s->store = store;
+  s->state_name = state_name;
+  s->job_id = job_id ? job_id : "local";
+
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    destroy(s);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (!host || !*host || strcmp(host, "0.0.0.0") == 0) {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    destroy(s);
+    return nullptr;
+  }
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+          0 ||
+      listen(s->listen_fd, 128) != 0 || !set_nonblocking(s->listen_fd)) {
+    destroy(s);
+    return nullptr;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  if (getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen) !=
+      0) {
+    destroy(s);
+    return nullptr;
+  }
+  s->port = ntohs(bound.sin_port);
+
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->epoll_fd < 0 || s->wake_fd < 0) {
+    destroy(s);
+    return nullptr;
+  }
+  epoll_event lev{};
+  lev.events = EPOLLIN;
+  lev.data.fd = s->listen_fd;
+  epoll_event wev{};
+  wev.events = EPOLLIN;
+  wev.data.fd = s->wake_fd;
+  if (epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &lev) != 0 ||
+      epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &wev) != 0) {
+    destroy(s);
+    return nullptr;
+  }
+  s->loop = std::thread(event_loop, s);
+  return s;
+}
+
+int tpums_server_port(void* srv) {
+  return srv ? static_cast<ServerState*>(srv)->port : -1;
+}
+
+uint64_t tpums_server_requests(void* srv) {
+  return srv ? static_cast<ServerState*>(srv)->requests.load() : 0;
+}
+
+void tpums_server_stop(void* srv) {
+  if (!srv) return;
+  auto* s = static_cast<ServerState*>(srv);
+  s->stop.store(true, std::memory_order_release);
+  uint64_t one = 1;
+  ssize_t wr = write(s->wake_fd, &one, 8);
+  (void)wr;
+  if (s->loop.joinable()) s->loop.join();
+  destroy(s);
+}
+
+}  // extern "C"

@@ -31,6 +31,8 @@
 #include <sys/types.h>
 #include <unistd.h>
 
+#include "tpums.h"  // signature check against the shared public API
+
 namespace {
 
 struct Entry {

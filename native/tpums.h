@@ -1,0 +1,49 @@
+// Public C API of the native serving components (libtpums.so).
+//
+// Two units link into the one .so consumed over ctypes by
+// flink_ms_tpu/serve/native_store.py:
+//   store.cpp         — persistent KV store (rocksdb-parity state backend)
+//   lookup_server.cpp — epoll TCP lookup server (Netty-KvState-parity data
+//                       plane, QueryClientHelper.java:104-139) serving GETs
+//                       straight from the store, no Python on the hot path.
+#ifndef TPUMS_H_
+#define TPUMS_H_
+
+#include <stdint.h>
+
+extern "C" {
+
+// -- store (store.cpp) ------------------------------------------------------
+void* tpums_open(const char* dir);
+int tpums_put(void* h, const char* k, uint32_t klen, const char* v,
+              uint32_t vlen);
+// Returns a malloc'd value buffer (caller frees via tpums_free_buf) or
+// nullptr; *err_out is set non-zero on I/O failure (vs. key-not-found).
+char* tpums_get(void* h, const char* k, uint32_t klen, uint32_t* vlen_out,
+                int* err_out);
+void tpums_free_buf(char* p);
+int tpums_delete(void* h, const char* k, uint32_t klen);
+uint64_t tpums_count(void* h);
+int tpums_flush(void* h);
+typedef void (*tpums_key_cb)(const char* key, uint32_t klen, void* ctx);
+int tpums_keys(void* h, tpums_key_cb cb, void* ctx);
+uint64_t tpums_log_bytes(void* h);
+uint64_t tpums_live_bytes(void* h);
+int tpums_compact(void* h);
+void tpums_close(void* h);
+
+// -- lookup server (lookup_server.cpp) --------------------------------------
+// Starts an epoll event loop on its own thread, serving the line protocol of
+// flink_ms_tpu/serve/server.py (GET/PING; TOPK answers E — device-scored
+// top-k stays on the Python server) from the given open store handle.
+// `port` 0 picks an ephemeral port. Returns a server handle or nullptr.
+void* tpums_server_start(void* store, const char* state_name,
+                         const char* job_id, const char* host, int port);
+int tpums_server_port(void* srv);
+uint64_t tpums_server_requests(void* srv);
+// Stops the loop, closes all connections, joins the thread, frees the handle.
+void tpums_server_stop(void* srv);
+
+}  // extern "C"
+
+#endif  // TPUMS_H_
