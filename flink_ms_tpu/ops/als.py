@@ -467,34 +467,18 @@ def _pad_factors(problem: BlockedProblem, D: int, k: int, dtype,
     )
 
 
-def als_fit(
-    users: np.ndarray,
-    items: np.ndarray,
-    ratings: np.ndarray,
+def compile_fit(
+    problem: BlockedProblem,
     config: ALSConfig,
     mesh: Mesh,
-    problem: Optional[BlockedProblem] = None,
     init: Optional[Tuple[np.ndarray, np.ndarray]] = None,
-    temporary_path: Optional[str] = None,
-    step_timer=None,
-) -> ALSModel:
-    """Train ALS factors for the given rating triples on the mesh.
-
-    `init`, when given, is (user_factors (n_users, k), item_factors
-    (n_items, k)) in dense-id order — used by tests to pin the starting
-    point so different block counts are exactly comparable.
-
-    `temporary_path` (the reference's setTemporaryPath, ALSImpl.scala:42-44):
-    run iterations one at a time, materializing the factors to disk at every
-    iteration boundary, and resume from the latest matching snapshot if one
-    exists.  Without it the whole loop is one fused XLA program.
-
-    `step_timer`: optional ``utils.profiling.StepTimer``; in staged mode each
-    iteration (device step + snapshot write) is timed as one step.
-    """
+):
+    """-> (fit_fn, dev_args): the compiled blocked-ALS sweep plus its
+    device-resident, block-sharded inputs.  ``fit_fn(iterations, *dev_args)``
+    returns the factor shards as device arrays.  ``als_fit`` drives this;
+    benchmarks call ``fit_fn`` directly so host<->device transfer stays out
+    of the timed region."""
     D = num_blocks(mesh)
-    if problem is None:
-        problem = prepare_blocked(users, items, ratings, D)
     k = config.num_factors
     dtype = config.dtype
 
@@ -533,8 +517,43 @@ def als_fit(
             problem.i_count.astype(dtype),
         )
     ]
+    return _cached_sweep(problem, config, mesh), dev_args
 
-    fit_fn = _cached_sweep(problem, config, mesh)
+
+def als_fit(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    config: ALSConfig,
+    mesh: Mesh,
+    problem: Optional[BlockedProblem] = None,
+    init: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    temporary_path: Optional[str] = None,
+    step_timer=None,
+) -> ALSModel:
+    """Train ALS factors for the given rating triples on the mesh.
+
+    `init`, when given, is (user_factors (n_users, k), item_factors
+    (n_items, k)) in dense-id order — used by tests to pin the starting
+    point so different block counts are exactly comparable.
+
+    `temporary_path` (the reference's setTemporaryPath, ALSImpl.scala:42-44):
+    run iterations one at a time, materializing the factors to disk at every
+    iteration boundary, and resume from the latest matching snapshot if one
+    exists.  Without it the whole loop is one fused XLA program.
+
+    `step_timer`: optional ``utils.profiling.StepTimer``; in staged mode each
+    iteration (device step + snapshot write) is timed as one step.
+    """
+    D = num_blocks(mesh)
+    if problem is None:
+        problem = prepare_blocked(users, items, ratings, D)
+    k = config.num_factors
+    dtype = config.dtype
+    shard3 = block_sharding(mesh, rank=3)
+    fit_fn, dev_args = compile_fit(problem, config, mesh, init=init)
+    n_users_pad = problem.users_per_block * D
+    n_items_pad = problem.items_per_block * D
 
     def to_dense(uf_d, itf_d):
         u = np.asarray(uf_d).reshape(n_users_pad, k)[: problem.n_users]

@@ -11,6 +11,7 @@ here — the mesh simply spans more devices.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -18,6 +19,31 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 BLOCK_AXIS = "blocks"
+
+
+def honor_platform_env() -> None:
+    """Apply an explicitly-set ``JAX_PLATFORMS`` before backend init.
+
+    Some deployments pre-import jax and pin ``jax_platforms`` from site
+    hooks, which silently overrides the env var JAX normally honors.  A
+    user who runs a CLI with ``JAX_PLATFORMS=cpu`` (local testing, CI,
+    TPU tunnel down) expects it to stick, so re-apply the env value when
+    its *primary* platform differs from the pinned one.  When the primary
+    already matches (e.g. env ``axon`` vs pin ``axon,cpu``) the pin is
+    kept: replacing it would unregister the CPU fallback that
+    ``jax.devices("cpu")`` callers (benchmark baselines, host-side eval)
+    rely on.  No-op once the backend is initialized.
+    """
+    val = os.environ.get("JAX_PLATFORMS", "")
+    if not val:
+        return
+    cur = str(getattr(jax.config, "jax_platforms", None) or "")
+    if cur.split(",")[0] == val.split(",")[0]:
+        return
+    try:
+        jax.config.update("jax_platforms", val)
+    except Exception:
+        pass  # backend already live — too late to switch, keep going
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -28,6 +54,7 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = Non
     by stacking multiple logical blocks per device.
     """
     if devices is None:
+        honor_platform_env()
         devices = jax.devices()
     if n_devices is not None:
         if n_devices > len(devices):

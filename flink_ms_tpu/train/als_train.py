@@ -31,7 +31,7 @@ import numpy as np
 from ..core import formats as F
 from ..core.params import Params, field_delimiter_from
 from ..ops.als import ALSConfig, ALSModel, als_fit, rmse
-from ..parallel.mesh import make_mesh
+from ..parallel.mesh import honor_platform_env, make_mesh
 from ..utils import profiling
 
 
@@ -60,6 +60,7 @@ def run(params: Params) -> ALSModel | None:
     blocks = params.get_int("blocks")
     import jax
 
+    honor_platform_env()
     avail = len(jax.devices())
     if n_devices is None:
         # --blocks larger than the device count is legal in the reference

@@ -22,7 +22,7 @@ import time
 from ..core import formats as F
 from ..core.params import Params
 from ..ops.svm import SVMConfig, SVMModel, prepare_svm_blocked, svm_fit
-from ..parallel.mesh import make_mesh
+from ..parallel.mesh import honor_platform_env, make_mesh
 from ..utils import profiling
 
 
@@ -32,6 +32,7 @@ def run(params: Params) -> SVMModel:
 
     import jax
 
+    honor_platform_env()
     avail = len(jax.devices())
     blocks = params.get_int("blocks", 10)
     n_devices = params.get_int("devices")

@@ -150,6 +150,18 @@ bool flush_out(ServerState* s, Conn* c) {
   return true;
 }
 
+// Answer every complete line buffered in c->in, leaving the partial tail.
+void drain_lines(ServerState* s, Conn* c) {
+  size_t start = 0;
+  while (true) {
+    size_t nl = c->in.find('\n', start);
+    if (nl == std::string::npos) break;
+    c->out += handle_line(s, c->in.substr(start, nl - start));
+    start = nl + 1;
+  }
+  c->in.erase(0, start);
+}
+
 // Read available bytes, answer every complete line; false = close the conn.
 bool on_readable(ServerState* s, Conn* c) {
   char chunk[kReadChunk];
@@ -157,7 +169,10 @@ bool on_readable(ServerState* s, Conn* c) {
     ssize_t r = recv(c->fd, chunk, sizeof(chunk), 0);
     if (r > 0) {
       c->in.append(chunk, static_cast<size_t>(r));
-      if (c->in.size() > kMaxLine) return false;  // oversized request
+      // parse as we go so the cap bounds ONE request line, not a burst of
+      // pipelined small requests
+      drain_lines(s, c);
+      if (c->in.size() > kMaxLine) return false;  // oversized request line
       continue;
     }
     if (r == 0) {  // orderly half-close: still answer the buffered requests
@@ -167,14 +182,12 @@ bool on_readable(ServerState* s, Conn* c) {
     if (errno == EAGAIN || errno == EWOULDBLOCK) break;
     return false;
   }
-  size_t start = 0;
-  while (true) {
-    size_t nl = c->in.find('\n', start);
-    if (nl == std::string::npos) break;
-    c->out += handle_line(s, c->in.substr(start, nl - start));
-    start = nl + 1;
+  drain_lines(s, c);
+  if (c->eof && !c->in.empty()) {
+    // final line without '\n': readline()-at-EOF answers it, so we do too
+    c->out += handle_line(s, c->in);
+    c->in.clear();
   }
-  c->in.erase(0, start);
   return flush_out(s, c);
 }
 

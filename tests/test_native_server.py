@@ -99,6 +99,35 @@ def test_pipelined_and_split_requests(server):
         assert f.readline() == b"V\t2.0;-1.0\n"
 
 
+def test_final_line_without_newline_is_answered(server):
+    # readline()-at-EOF parity: the Python server answers a trailing
+    # partial line on half-close, so the native server must too
+    assert _raw(server.port, b"PING") == b"PONG\tjid\tALS_MODEL\n"
+    assert _raw(server.port, b"PING\nGET\tALS_MODEL\t1-U") == (
+        b"PONG\tjid\tALS_MODEL\nV\t0.5;1.5\n"
+    )
+
+
+def test_large_pipelined_burst_is_answered(server):
+    # >1 MB of small valid requests in one burst: the request-line cap must
+    # bound a single line, not the whole unparsed buffer
+    n = 80_000
+    burst = b"GET\tALS_MODEL\t1-U\n" * n
+    assert len(burst) > (1 << 20)
+    out = _raw(server.port, burst)
+    assert out == b"V\t0.5;1.5\n" * n
+
+
+def test_oversized_single_line_closes_connection(server):
+    # the server drops the connection mid-send; depending on timing the
+    # client sees either a clean EOF with no payload or a reset
+    try:
+        out = _raw(server.port, b"GET\tALS_MODEL\t" + b"x" * (2 << 20) + b"\n")
+    except (ConnectionResetError, BrokenPipeError):
+        return
+    assert out == b""
+
+
 def test_concurrent_clients(server):
     errors = []
 
