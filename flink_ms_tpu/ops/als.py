@@ -217,8 +217,15 @@ def _assemble_normal_eqs(y_all, idx, rating, seg, n_seg, k, implicit, alpha, dty
             t = cr.astype(dtype)
         yw = y * w[:, None]
         outer = yw[:, :, None] * y[:, None, :]               # (C, k, k)
-        A = A + jax.ops.segment_sum(outer, cs, num_segments=n_seg + 1)
-        b = b + jax.ops.segment_sum(y * t[:, None], cs, num_segments=n_seg + 1)
+        # per-block CSR is sorted by local row (prepare_blocked), and both
+        # chunking and padding preserve the order — let XLA use the cheaper
+        # sorted-scatter lowering
+        A = A + jax.ops.segment_sum(
+            outer, cs, num_segments=n_seg + 1, indices_are_sorted=True
+        )
+        b = b + jax.ops.segment_sum(
+            y * t[:, None], cs, num_segments=n_seg + 1, indices_are_sorted=True
+        )
         return (A, b), None
 
     A0 = jnp.zeros((n_seg + 1, k, k), dtype=dtype)
@@ -556,20 +563,58 @@ def als_fit(
     n_items_pad = problem.items_per_block * D
 
     def to_dense(uf_d, itf_d):
-        u = np.asarray(uf_d).reshape(n_users_pad, k)[: problem.n_users]
-        i = np.asarray(itf_d).reshape(n_items_pad, k)[: problem.n_items]
+        # multi-process runs: factor shards live on remote hosts too, so
+        # materialization is a cross-host allgather (plain copy locally)
+        from ..parallel.distributed import to_host_array
+
+        u = to_host_array(uf_d).reshape(n_users_pad, k)[: problem.n_users]
+        i = to_host_array(itf_d).reshape(n_items_pad, k)[: problem.n_items]
         return u, i
 
     if temporary_path is None:
         uf, itf = fit_fn(jnp.asarray(config.iterations, jnp.int32), *dev_args)
         uf, itf = to_dense(uf, itf)
     else:
+        from ..parallel.distributed import is_primary
+
         meta = _staging_meta(problem, config, init)
-        start = 0
-        snap = load_staged(temporary_path, meta,
-                           max_iteration=config.iterations)
-        if snap is not None:
-            start, uf_raw, itf_raw = snap
+        multi = jax.process_count() > 1
+        # multi-process: exactly one writer, and process 0's snapshot is
+        # authoritative for the resume point — local scans could disagree
+        # (per-host disks, partially replicated shared storage) and a
+        # divergent `start` would desynchronize the collective steps below
+        snap = (
+            load_staged(temporary_path, meta, max_iteration=config.iterations)
+            if (not multi or is_primary())
+            else None
+        )
+        start = 0 if snap is None else snap[0]
+        if multi:
+            from jax.experimental import multihost_utils
+
+            start = int(
+                multihost_utils.broadcast_one_to_all(
+                    np.asarray(start, np.int32)
+                )
+            )
+            if start > 0:
+                uf_raw = (
+                    snap[1] if snap is not None
+                    else np.zeros((problem.n_users, k), dtype)
+                )
+                itf_raw = (
+                    snap[2] if snap is not None
+                    else np.zeros((problem.n_items, k), dtype)
+                )
+                uf_raw = multihost_utils.broadcast_one_to_all(
+                    uf_raw.astype(dtype)
+                )
+                itf_raw = multihost_utils.broadcast_one_to_all(
+                    itf_raw.astype(dtype)
+                )
+                snap = (start, np.asarray(uf_raw), np.asarray(itf_raw))
+        if start > 0:
+            _, uf_raw, itf_raw = snap
             uf_s, itf_s = _pad_factors(problem, D, k, dtype, uf_raw, itf_raw)
             dev_args[0] = jax.device_put(uf_s, shard3)
             dev_args[1] = jax.device_put(itf_s, shard3)
@@ -583,7 +628,8 @@ def als_fit(
             with timer:
                 uf_d, itf_d = fit_fn(one, uf_d, itf_d, *dev_args[2:])
                 uf, itf = to_dense(uf_d, itf_d)
-                save_staged(temporary_path, it + 1, uf, itf, meta)
+                if not multi or is_primary():
+                    save_staged(temporary_path, it + 1, uf, itf, meta)
         if start == config.iterations:  # fully-resumed: nothing left to run
             uf, itf = to_dense(uf_d, itf_d)
     return ALSModel(

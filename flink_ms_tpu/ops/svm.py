@@ -237,4 +237,6 @@ def svm_fit(
     )
     fit = _make_fit(problem, config, mesh)
     w, _alpha = fit(*args)
-    return SVMModel(weights=np.asarray(w, dtype=np.float64))
+    from ..parallel.distributed import to_host_array
+
+    return SVMModel(weights=to_host_array(w).astype(np.float64))

@@ -20,6 +20,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 BLOCK_AXIS = "blocks"
 
+# Platform names whose presence in JAX_PLATFORMS counts as ambient launcher
+# default rather than user intent (see honor_platform_env).  Deployment
+# config: override with FLINK_MS_TPU_AMBIENT_PLATFORMS (comma-separated).
+_AMBIENT_ACCEL_PLATFORMS = tuple(
+    os.environ.get("FLINK_MS_TPU_AMBIENT_PLATFORMS", "axon").split(",")
+)
+
 
 def honor_platform_env() -> None:
     """Apply an explicitly-set ``JAX_PLATFORMS`` before backend init.
@@ -27,23 +34,24 @@ def honor_platform_env() -> None:
     Some deployments pre-import jax and pin ``jax_platforms`` from site
     hooks, which silently overrides the env var JAX normally honors.  A
     user who runs a CLI with ``JAX_PLATFORMS=cpu`` (local testing, CI,
-    TPU tunnel down) expects it to stick, so re-apply the env value when
-    its *primary* platform differs from the pinned one.  When the primary
-    already matches (e.g. env ``axon`` vs pin ``axon,cpu``) the pin is
-    kept: replacing it would unregister the CPU fallback that
-    ``jax.devices("cpu")`` callers (benchmark baselines, host-side eval)
-    rely on.  No-op once the backend is initialized.
+    TPU tunnel down) expects it to stick, so re-apply it.
+
+    An env value naming an ambient accelerator platform
+    (``_AMBIENT_ACCEL_PLATFORMS``) is NOT re-applied, for two reasons.
+    First, the launcher exports that value into every process's
+    environment, so its presence is ambient default rather than user
+    intent — and it must not override an explicit in-process pin such as
+    the test harness's ``jax.config.update("jax_platforms", "cpu")``.
+    Second, the site pin is ``<accel>,cpu``; narrowing it to ``<accel>``
+    would unregister the CPU fallback that ``jax.devices("cpu")`` callers
+    (benchmark baselines, host-side eval) rely on.
     """
     val = os.environ.get("JAX_PLATFORMS", "")
-    if not val:
-        return
-    cur = str(getattr(jax.config, "jax_platforms", None) or "")
-    if cur.split(",")[0] == val.split(",")[0]:
-        return
-    try:
-        jax.config.update("jax_platforms", val)
-    except Exception:
-        pass  # backend already live — too late to switch, keep going
+    if val and not any(p in val.split(",") for p in _AMBIENT_ACCEL_PLATFORMS):
+        try:
+            jax.config.update("jax_platforms", val)
+        except Exception:
+            pass  # backend already live — too late to switch, keep going
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:

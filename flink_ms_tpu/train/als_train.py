@@ -31,6 +31,7 @@ import numpy as np
 from ..core import formats as F
 from ..core.params import Params, field_delimiter_from
 from ..ops.als import ALSConfig, ALSModel, als_fit, rmse
+from ..parallel.distributed import is_primary, maybe_init_distributed
 from ..parallel.mesh import honor_platform_env, make_mesh
 from ..utils import profiling
 
@@ -61,6 +62,7 @@ def run(params: Params) -> ALSModel | None:
     import jax
 
     honor_platform_env()
+    maybe_init_distributed(params)
     avail = len(jax.devices())
     if n_devices is None:
         # --blocks larger than the device count is legal in the reference
@@ -95,6 +97,9 @@ def run(params: Params) -> ALSModel | None:
         f"({train_s / max(config.iterations, 1):.3f} s/iter), "
         f"train RMSE={rmse(model, users, items, ratings):.4f}"
     )
+
+    if not is_primary():  # one process materializes job output
+        return model
 
     if tmp:
         F.write_als_model(f"{tmp}/userFactors", model.user_ids, F.USER, model.user_factors)

@@ -22,6 +22,7 @@ import time
 from ..core import formats as F
 from ..core.params import Params
 from ..ops.svm import SVMConfig, SVMModel, prepare_svm_blocked, svm_fit
+from ..parallel.distributed import is_primary, maybe_init_distributed
 from ..parallel.mesh import honor_platform_env, make_mesh
 from ..utils import profiling
 
@@ -33,6 +34,7 @@ def run(params: Params) -> SVMModel:
     import jax
 
     honor_platform_env()
+    maybe_init_distributed(params)
     avail = len(jax.devices())
     blocks = params.get_int("blocks", 10)
     n_devices = params.get_int("devices")
@@ -64,6 +66,9 @@ def run(params: Params) -> SVMModel:
         f"hinge+reg objective="
         f"{model.hinge_loss(data, config.regularization):.6f}"
     )
+
+    if not is_primary():  # one process materializes job output
+        return model
 
     if params.get_bool("partition"):
         rows = F.format_svm_range_rows(model.weights, params.get_int("range", 1000))

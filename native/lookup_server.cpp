@@ -36,8 +36,14 @@
 
 namespace {
 
-constexpr size_t kMaxLine = 1u << 20;  // 1 MB request line cap
+constexpr size_t kMaxLine = 1u << 20;   // 1 MB request line cap
 constexpr size_t kReadChunk = 64 * 1024;
+// Slow-reader protection: a client that pipelines requests without draining
+// responses gets disconnected once this much response data is buffered.
+constexpr size_t kMaxOutBuffer = 16u << 20;
+// Fairness on the single epoll thread: after this many chunks the handler
+// returns; level-triggered epoll re-delivers EPOLLIN for the remainder.
+constexpr int kMaxChunksPerEvent = 16;
 
 struct Conn {
   int fd = -1;
@@ -165,14 +171,15 @@ void drain_lines(ServerState* s, Conn* c) {
 // Read available bytes, answer every complete line; false = close the conn.
 bool on_readable(ServerState* s, Conn* c) {
   char chunk[kReadChunk];
-  while (true) {
+  for (int chunks = 0; chunks < kMaxChunksPerEvent; ++chunks) {
     ssize_t r = recv(c->fd, chunk, sizeof(chunk), 0);
     if (r > 0) {
       c->in.append(chunk, static_cast<size_t>(r));
       // parse as we go so the cap bounds ONE request line, not a burst of
       // pipelined small requests
       drain_lines(s, c);
-      if (c->in.size() > kMaxLine) return false;  // oversized request line
+      if (c->in.size() > kMaxLine) return false;   // oversized request line
+      if (c->out.size() > kMaxOutBuffer) return false;  // slow reader
       continue;
     }
     if (r == 0) {  // orderly half-close: still answer the buffered requests

@@ -26,6 +26,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / large-compile tests"
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,120 @@
+"""Multi-host (DCN) path: ``jax.distributed`` bootstrap over the CLI flags,
+real two-process run with cross-process collectives, and single-writer
+output semantics (SURVEY.md §2.5 — the reference's JobManager/TaskManager
+control plane becomes coordinator + N processes)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.core.params import Params
+from flink_ms_tpu.parallel.distributed import maybe_init_distributed
+
+
+def test_flags_require_rank_info():
+    with pytest.raises(ValueError, match="numProcesses"):
+        maybe_init_distributed(
+            Params.from_args(["--coordinatorAddress", "127.0.0.1:1"])
+        )
+
+
+def test_no_flags_is_single_process():
+    assert maybe_init_distributed(Params.from_args([])) is False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_two_processes(tmp_path, iterations: int, out_tag: str):
+    """Launch als_train on a 2-process x 2-device global mesh; per-process
+    temporaryPath dirs (stage0 / stage1) model per-host local disks."""
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = []
+    for pid in (0, 1):
+        out = tmp_path / f"{out_tag}{pid}"
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "flink_ms_tpu.train.als_train",
+                    "--input", str(tmp_path / "ratings.csv"),
+                    "--ignoreFirstLine", "false",
+                    "--iterations", str(iterations),
+                    "--numFactors", "4",
+                    "--coordinatorAddress", f"127.0.0.1:{port}",
+                    "--numProcesses", "2",
+                    "--processId", str(pid),
+                    # staged mode: exercises single-writer snapshot gating
+                    # and (on rerun) process-0-authoritative resume
+                    "--temporaryPath", str(tmp_path / f"stage{pid}"),
+                    "--userFactors", str(out / "uf"),
+                    "--itemFactors", str(out / "itf"),
+                ],
+                env=env_base,
+                cwd="/root/repo",
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outputs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o
+
+
+def _assert_matches_local(tmp_path, out_dir, users, items, ratings, iterations):
+    from flink_ms_tpu.ops.als import ALSConfig, als_fit
+    from flink_ms_tpu.parallel.mesh import make_mesh
+
+    cfg = ALSConfig(num_factors=4, iterations=iterations)
+    local = als_fit(users, items, ratings, cfg, make_mesh(4))
+    ids, kinds, rows = F.read_als_model(str(out_dir / "uf"))
+    got = {int(i): r for i, k, r in zip(ids, kinds, rows)}
+    for uid, row in zip(local.user_ids, local.user_factors):
+        np.testing.assert_allclose(got[int(uid)], row, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_als_train_matches_single_process(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 400
+    users = rng.integers(0, 30, n)
+    items = rng.integers(0, 20, n)
+    ratings = rng.uniform(1.0, 5.0, n)
+    F.write_ratings(str(tmp_path / "ratings.csv"), users, items, ratings)
+
+    _run_two_processes(tmp_path, iterations=2, out_tag="out")
+
+    # single-writer: only process 0 materializes model files and snapshots
+    assert (tmp_path / "out0" / "uf").exists()
+    assert not (tmp_path / "out1" / "uf").exists()
+    assert any((tmp_path / "stage0").glob("iter_*.npz"))
+    stage1 = tmp_path / "stage1"
+    assert not (stage1.exists() and any(stage1.glob("iter_*.npz")))
+
+    # the 2-proc x 2-device global mesh must equal a 4-device local mesh
+    _assert_matches_local(
+        tmp_path, tmp_path / "out0", users, items, ratings, iterations=2
+    )
+
+    # resume: process 0 holds an iter-2 snapshot, process 1 holds nothing —
+    # the resume point must come from process 0 (broadcast), both processes
+    # must run the SAME remaining step count, and the result must equal a
+    # fresh 3-iteration fit
+    _run_two_processes(tmp_path, iterations=3, out_tag="res")
+    assert (tmp_path / "res0" / "uf").exists()
+    _assert_matches_local(
+        tmp_path, tmp_path / "res0", users, items, ratings, iterations=3
+    )

@@ -118,12 +118,35 @@ def test_large_pipelined_burst_is_answered(server):
     assert out == b"V\t0.5;1.5\n" * n
 
 
+def test_slow_reader_is_disconnected(server):
+    # a client that pipelines forever without reading responses must be
+    # dropped once the buffered-response cap is hit, not OOM the server
+    line = b"GET\tALS_MODEL\t1-U\n"
+    with socket.create_connection(("127.0.0.1", server.port), timeout=10) as s:
+        try:
+            # 32 MB of requests -> ~18 MB of buffered responses > 16 MB cap
+            for _ in range(2048):
+                s.sendall(line * 1024)
+        except (ConnectionResetError, BrokenPipeError):
+            return  # server dropped us: expected
+        # server may also close gracefully after we stop sending
+        s.shutdown(socket.SHUT_WR)
+        total = 0
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            total += len(chunk)
+        assert total < (32 << 20)
+
+
 def test_oversized_single_line_closes_connection(server):
     # the server drops the connection mid-send; depending on timing the
-    # client sees either a clean EOF with no payload or a reset
+    # client sees a clean EOF with no payload, a reset, or a failed
+    # shutdown on the already-closed socket (ENOTCONN)
     try:
         out = _raw(server.port, b"GET\tALS_MODEL\t" + b"x" * (2 << 20) + b"\n")
-    except (ConnectionResetError, BrokenPipeError):
+    except OSError:
         return
     assert out == b""
 
