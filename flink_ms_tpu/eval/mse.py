@@ -156,7 +156,7 @@ def run(params: Params, lookup=None) -> Optional[float]:
         print("No predictions could be made (empty model?)", file=sys.stderr)
         return None
     if params.has("output"):
-        F.write_lines(params.get_required("output"), [repr(mse)])
+        F.write_lines(params.get_required("output"), [repr(float(mse))])
     else:
         print("Printing result to stdout. Use --output to specify output path.")
         print(mse)

@@ -129,9 +129,10 @@ def test_closed_loop_improves_served_model(tmp_path, rng):
     """The headline behavior: SGD updates flow through the journal back into
     serving, and repeated passes reduce prediction error on the served model."""
     journal = Journal(str(tmp_path / "j"), "als_models")
+    # tight poll so the fold-in lag is short relative to per-rating latency
     job = ServingJob(
         journal, ALS_STATE, parse_als_record, MemoryStateBackend(),
-        poll_interval_s=0.01, host="127.0.0.1", port=0,
+        poll_interval_s=0.002, host="127.0.0.1", port=0,
     )
     job.start()
     try:
@@ -148,9 +149,16 @@ def test_closed_loop_improves_served_model(tmp_path, rng):
         journal.append(rows)
         assert _wait_until(lambda: len(job.table) == 13)
 
-        # true ratings to learn from, streamed from a file
+        # true ratings to learn from, streamed from a file.  Shuffled: with
+        # a user-major stream a user's ratings arrive back-to-back, so the
+        # ingest roundtrip can't fold an update in before the same user's
+        # next rating and last-writer-wins swallows the intermediate steps;
+        # interleaving users gives the loop time to close between updates
+        # (the reference's Kafka pipeline has the same property).
         u_idx, i_idx = np.meshgrid(np.arange(6), np.arange(5), indexing="ij")
         u_idx, i_idx = u_idx.ravel(), i_idx.ravel()
+        perm = rng.permutation(len(u_idx))
+        u_idx, i_idx = u_idx[perm], i_idx[perm]
         r = (uf_true @ itf_true.T)[u_idx, i_idx]
         ratings_path = tmp_path / "stream.tsv"
         with open(ratings_path, "w") as f:
@@ -193,6 +201,47 @@ def test_closed_loop_improves_served_model(tmp_path, rng):
             if after < before * 0.5:
                 break
         assert after < before * 0.5
+    finally:
+        job.stop()
+
+
+def test_kafka_sink_appends_to_journal(tmp_path, rng):
+    """The journal sink (reference outputMode=kafka) re-enters the serving
+    topic: one pass over n ratings appends 2n updated rows."""
+    journal = Journal(str(tmp_path / "j"), "als_models")
+    job = ServingJob(
+        journal, ALS_STATE, parse_als_record, MemoryStateBackend(),
+        poll_interval_s=0.01, host="127.0.0.1", port=0,
+    )
+    job.start()
+    try:
+        k = 4
+        rows = [F.format_als_row(u, "U", rng.normal(size=k)) for u in range(3)]
+        rows += [F.format_als_row(i, "I", rng.normal(size=k)) for i in range(2)]
+        rows.append(F.format_mean_row("U", np.zeros(k)))
+        rows.append(F.format_mean_row("I", np.zeros(k)))
+        journal.append(rows)
+        assert _wait_until(lambda: len(job.table) == 7)
+        offset_before = journal.end_offset()
+
+        ratings_path = tmp_path / "stream.tsv"
+        with open(ratings_path, "w") as f:
+            for a, b in [(0, 0), (1, 1), (2, 0)]:
+                f.write(f"{a}\t{b}\t3.5\n")
+        n = sgd_mod.run(
+            Params.from_args(
+                ["--input", str(ratings_path), "--mode", "once",
+                 "--outputMode", "kafka", "--topic", "als_models",
+                 "--journalDir", str(tmp_path / "j"),
+                 "--jobId", job.job_id, "--jobManagerHost", "127.0.0.1",
+                 "--jobManagerPort", str(job.port)]
+            )
+        )
+        assert n == 3
+        appended, _ = journal.read_from(offset_before)
+        assert len(appended) == 2 * n  # one updated U row + I row per rating
+        # and the serving job folds the appended rows back into the state
+        assert _wait_until(lambda: job.table.puts >= 7 + 2 * n)
     finally:
         job.stop()
 
