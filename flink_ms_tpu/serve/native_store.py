@@ -32,6 +32,13 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None:
             return _lib
+        # TPUMS_NATIVE_LIB overrides the library path without the rebuild
+        # logic — used by the sanitizer gates (tests/test_native_sanitizers)
+        # to load the tsan/asan-instrumented builds
+        override = os.environ.get("TPUMS_NATIVE_LIB")
+        if override:
+            _lib = _declare_abi(ctypes.CDLL(override))
+            return _lib
         # rebuild when the .so is missing or older than its sources: a stale
         # prebuilt .so under newer declared argtypes would corrupt the ABI
         # silently, while an up-to-date .so must keep loading on machines
@@ -70,49 +77,52 @@ def _load_lib():
                             f"(exit {proc.returncode}):\n"
                             f"{proc.stdout}\n{proc.stderr}"
                         )
-        lib = ctypes.CDLL(_SO_PATH)
-        lib.tpums_open.restype = ctypes.c_void_p
-        lib.tpums_open.argtypes = [ctypes.c_char_p]
-        lib.tpums_put.restype = ctypes.c_int
-        lib.tpums_put.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
-            ctypes.c_char_p, ctypes.c_uint32,
-        ]
-        lib.tpums_get.restype = ctypes.POINTER(ctypes.c_char)
-        lib.tpums_get.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
-            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int),
-        ]
-        lib.tpums_free_buf.argtypes = [ctypes.POINTER(ctypes.c_char)]
-        lib.tpums_delete.restype = ctypes.c_int
-        lib.tpums_delete.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
-        ]
-        lib.tpums_count.restype = ctypes.c_uint64
-        lib.tpums_count.argtypes = [ctypes.c_void_p]
-        lib.tpums_flush.restype = ctypes.c_int
-        lib.tpums_flush.argtypes = [ctypes.c_void_p]
-        lib.tpums_keys.restype = ctypes.c_int
-        lib.tpums_keys.argtypes = [ctypes.c_void_p, _KEY_CB, ctypes.c_void_p]
-        lib.tpums_log_bytes.restype = ctypes.c_uint64
-        lib.tpums_log_bytes.argtypes = [ctypes.c_void_p]
-        lib.tpums_live_bytes.restype = ctypes.c_uint64
-        lib.tpums_live_bytes.argtypes = [ctypes.c_void_p]
-        lib.tpums_compact.restype = ctypes.c_int
-        lib.tpums_compact.argtypes = [ctypes.c_void_p]
-        lib.tpums_close.argtypes = [ctypes.c_void_p]
-        lib.tpums_server_start.restype = ctypes.c_void_p
-        lib.tpums_server_start.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.tpums_server_port.restype = ctypes.c_int
-        lib.tpums_server_port.argtypes = [ctypes.c_void_p]
-        lib.tpums_server_requests.restype = ctypes.c_uint64
-        lib.tpums_server_requests.argtypes = [ctypes.c_void_p]
-        lib.tpums_server_stop.argtypes = [ctypes.c_void_p]
-        _lib = lib
+        _lib = _declare_abi(ctypes.CDLL(_SO_PATH))
         return _lib
+
+
+def _declare_abi(lib):
+    lib.tpums_open.restype = ctypes.c_void_p
+    lib.tpums_open.argtypes = [ctypes.c_char_p]
+    lib.tpums_put.restype = ctypes.c_int
+    lib.tpums_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.tpums_get.restype = ctypes.POINTER(ctypes.c_char)
+    lib.tpums_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.tpums_free_buf.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.tpums_delete.restype = ctypes.c_int
+    lib.tpums_delete.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.tpums_count.restype = ctypes.c_uint64
+    lib.tpums_count.argtypes = [ctypes.c_void_p]
+    lib.tpums_flush.restype = ctypes.c_int
+    lib.tpums_flush.argtypes = [ctypes.c_void_p]
+    lib.tpums_keys.restype = ctypes.c_int
+    lib.tpums_keys.argtypes = [ctypes.c_void_p, _KEY_CB, ctypes.c_void_p]
+    lib.tpums_log_bytes.restype = ctypes.c_uint64
+    lib.tpums_log_bytes.argtypes = [ctypes.c_void_p]
+    lib.tpums_live_bytes.restype = ctypes.c_uint64
+    lib.tpums_live_bytes.argtypes = [ctypes.c_void_p]
+    lib.tpums_compact.restype = ctypes.c_int
+    lib.tpums_compact.argtypes = [ctypes.c_void_p]
+    lib.tpums_close.argtypes = [ctypes.c_void_p]
+    lib.tpums_server_start.restype = ctypes.c_void_p
+    lib.tpums_server_start.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.tpums_server_port.restype = ctypes.c_int
+    lib.tpums_server_port.argtypes = [ctypes.c_void_p]
+    lib.tpums_server_requests.restype = ctypes.c_uint64
+    lib.tpums_server_requests.argtypes = [ctypes.c_void_p]
+    lib.tpums_server_stop.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 class StoreLockedError(OSError):
