@@ -1,0 +1,165 @@
+"""Fused top-k scoring as a Pallas TPU kernel.
+
+Serving hot op behind the lookup server's TOPK verb (``serve/topk.py``,
+the BASELINE.md "top-k serving from ALS factors" config).  The XLA path
+(``matrix @ q`` then ``lax.top_k``) materializes the full ``(n_items,)``
+score vector in HBM and re-reads it for the selection pass; this kernel
+streams item tiles HBM->VMEM once, scores each tile on the VPU, and merges
+a running top-k held in VMEM scratch across the (sequential) TPU grid —
+one pass over the catalog, no score materialization.
+
+Layout: the item-factor matrix is stored TRANSPOSED, ``(k, n_items_pad)``
+with ``n_items_pad`` a lane multiple, so the long axis sits on the 128-wide
+lane dimension and ``k`` (8..64) on sublanes.  The query is broadcast
+against the sublane axis; the selection loop uses only dense max/where
+reductions (no sort, no scatter), which lower on TPU for any k.
+
+Runs in interpreter mode off-TPU, so the numerics are testable on CPU; the
+serving layer picks the engine (``serve/topk.py``, TPUMS_TOPK_ENGINE).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas is part of jax.experimental; keep the module importable
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover - pallas ships with jax in this image
+    HAVE_PALLAS = False
+
+TILE = 1024    # items scored per grid step (lane-dim multiple of 128)
+_LANE = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _topk_kernel(mt_ref, q_ref, s_out, i_out, best_s, best_i,
+                 *, k_top, k_pad, n_real, tile, n_tiles):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        best_s[:] = jnp.full((1, k_pad), -jnp.inf, jnp.float32)
+        best_i[:] = jnp.zeros((1, k_pad), jnp.int32)
+
+    # matvec for this tile: sum over the k sublanes of factors * query
+    mt = mt_ref[:]                      # (k, tile)
+    q = q_ref[:]                        # (k, 1) broadcast over lanes
+    scores = jnp.sum(mt * q, axis=0, keepdims=True)          # (1, tile)
+
+    lanes_t = jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+    gidx = t * tile + lanes_t
+    scores = jnp.where(gidx < n_real, scores, -jnp.inf)      # mask padding
+
+    # merge tile scores into the running best: k_top rounds of masked max
+    cand_s = jnp.concatenate([best_s[:], scores], axis=1)    # (1, k_pad+tile)
+    cand_i = jnp.concatenate([best_i[:], gidx], axis=1)
+    lanes_c = jax.lax.broadcasted_iota(jnp.int32, cand_s.shape, 1)
+    lanes_k = jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
+
+    def select(j, carry):
+        cs, ci, bs, bi = carry
+        m = jnp.max(cs)
+        # lane of (the last) max occurrence, then its index payload
+        am = jnp.max(jnp.where(cs == m, lanes_c, -1))
+        sel = jnp.max(jnp.where(lanes_c == am, ci, jnp.int32(-2147483648)))
+        bs = jnp.where(lanes_k == j, m, bs)
+        bi = jnp.where(lanes_k == j, sel, bi)
+        cs = jnp.where(lanes_c == am, -jnp.inf, cs)
+        return cs, ci, bs, bi
+
+    _, _, bs, bi = jax.lax.fori_loop(
+        0, k_top, select, (cand_s, cand_i, best_s[:], best_i[:])
+    )
+    best_s[:] = bs
+    best_i[:] = bi
+
+    @pl.when(t == n_tiles - 1)
+    def _emit():
+        s_out[:] = best_s[:]
+        i_out[:] = best_i[:]
+
+
+@partial(jax.jit, static_argnames=("k_top", "n_real", "interpret"))
+def _topk_call(matrix_t, query_col, *, k_top, n_real, interpret):
+    k, n_pad = matrix_t.shape
+    tile = min(TILE, n_pad)
+    if n_pad % tile:
+        raise ValueError(
+            f"matrix_t lane dim {n_pad} not a multiple of tile {tile}; "
+            "build it with pack_index"
+        )
+    n_tiles = n_pad // tile
+    k_pad = _round_up(max(k_top, 1), _LANE)
+    kernel = partial(
+        _topk_kernel,
+        k_top=k_top, k_pad=k_pad, n_real=n_real,
+        tile=tile, n_tiles=n_tiles,
+    )
+    s, i = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((k, tile), lambda t: (0, t),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, 1), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k_pad), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, k_pad), jnp.float32),
+            pltpu.VMEM((1, k_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(matrix_t, query_col)
+    return s[0, :k_top], i[0, :k_top]
+
+
+def pack_index(matrix: np.ndarray) -> jax.Array:
+    """(n_items, k) host factors -> transposed lane-padded (k, n_pad) device
+    array (pad columns are masked inside the kernel, their content is moot)."""
+    n, k = matrix.shape
+    # small catalogs: one lane-aligned tile; large: a whole number of TILEs
+    n_pad = (
+        _round_up(max(n, _LANE), _LANE) if n <= TILE else _round_up(n, TILE)
+    )
+    mt = np.zeros((k, n_pad), dtype=np.float32)
+    mt[:, :n] = np.asarray(matrix, dtype=np.float32).T
+    return jnp.asarray(mt)
+
+
+def topk_scores(matrix_t, query, k_top: int, n_real: int,
+                interpret=None):
+    """Top-k of ``matrix[:n_real] @ query`` in one fused pass.
+
+    matrix_t: (k, n_pad) from :func:`pack_index`; query: (k,).
+    Returns (scores (k_top,), indices (k_top,)) sorted descending.
+    ``interpret=None`` auto-selects interpreter mode off-TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k_top = min(k_top, n_real)
+    if k_top <= 0:
+        return jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.int32)
+    q_col = jnp.asarray(query, jnp.float32).reshape(-1, 1)
+    return _topk_call(
+        matrix_t, q_col, k_top=k_top, n_real=n_real, interpret=interpret
+    )

@@ -14,6 +14,7 @@ within one rebuild).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import List, Optional, Tuple
 
@@ -22,14 +23,24 @@ import numpy as np
 from .table import ModelTable
 
 
+def _default_engine() -> str:
+    """TPUMS_TOPK_ENGINE=xla|pallas; default xla (pallas is the fused
+    single-pass kernel in ops/topk_pallas.py — opt-in until profiled on the
+    target chip, interpreter-mode correctness is covered by tests)."""
+    return os.environ.get("TPUMS_TOPK_ENGINE", "xla")
+
+
 class DeviceFactorIndex:
-    def __init__(self, table: ModelTable, factor_suffix: str = "-I"):
+    def __init__(self, table: ModelTable, factor_suffix: str = "-I",
+                 engine: Optional[str] = None):
         self.table = table
         self.suffix = factor_suffix
+        self.engine = engine or _default_engine()
         self._lock = threading.Lock()
         self._built_at = -1
         self._ids: List[str] = []
-        self._matrix = None  # jax device array (n, k)
+        self._matrix = None  # (n, k) device array, or (k, n_pad) for pallas
+        self._n_real = 0
         self._topk_fn = None
 
     def _build(self) -> None:
@@ -50,10 +61,15 @@ class DeviceFactorIndex:
             ids.append(key[: -len(self.suffix)])
             rows.append(vec)
         self._ids = ids
-        if rows:
-            self._matrix = jnp.asarray(np.asarray(rows, dtype=np.float32))
-        else:
+        self._n_real = len(ids)
+        if not rows:
             self._matrix = None
+        elif self.engine == "pallas":
+            from ..ops.topk_pallas import pack_index
+
+            self._matrix = pack_index(np.asarray(rows, dtype=np.float32))
+        else:
+            self._matrix = jnp.asarray(np.asarray(rows, dtype=np.float32))
         if self._topk_fn is None:
             from functools import partial
 
@@ -75,15 +91,23 @@ class DeviceFactorIndex:
                 self._built_at = built_at
             if self._matrix is None:
                 return []
-            n = self._matrix.shape[0]
+            n = self._n_real
             k_eff = min(k, n)
             q = np.asarray(user_factors, dtype=np.float32)
-            if q.shape[0] != self._matrix.shape[1]:
+            n_fac = (
+                self._matrix.shape[0] if self.engine == "pallas"
+                else self._matrix.shape[1]
+            )
+            if q.shape[0] != n_fac:
                 raise ValueError(
-                    f"query has {q.shape[0]} factors, index has "
-                    f"{self._matrix.shape[1]}"
+                    f"query has {q.shape[0]} factors, index has {n_fac}"
                 )
-            scores, idx = self._topk_fn(self._matrix, q, k_eff)
+            if self.engine == "pallas":
+                from ..ops.topk_pallas import topk_scores
+
+                scores, idx = topk_scores(self._matrix, q, k_eff, n_real=n)
+            else:
+                scores, idx = self._topk_fn(self._matrix, q, k_eff)
             return [
                 (self._ids[int(i)], float(s))
                 for i, s in zip(np.asarray(idx), np.asarray(scores))
