@@ -11,7 +11,6 @@ Package layout
 core/      flags (ParameterTool-parity parser), text-format contracts, IO
 parallel/  device mesh bootstrap, sharding helpers
 ops/       numerical kernels: blocked ALS, CoCoA/SDCA SVM, online SGD math
-models/    model containers (factor models, linear models)
 train/     training CLIs (als_train, svm_train) — parity with ALSImpl/SVMImpl
 serve/     sharded model table, ingest journal, state backends, lookup server
 online/    streaming online-SGD updater (closes the loop into serving)

@@ -133,16 +133,24 @@ def _topk_call(matrix_t, query_col, *, k_top, n_real, interpret):
     return s[0, :k_top], i[0, :k_top]
 
 
+_SUBLANE = 8  # float32 sublane multiple — Mosaic tiles (8, 128) for f32
+
+
 def pack_index(matrix: np.ndarray) -> jax.Array:
-    """(n_items, k) host factors -> transposed lane-padded (k, n_pad) device
-    array (pad columns are masked inside the kernel, their content is moot)."""
+    """(n_items, k) host factors -> transposed padded (k_pad, n_pad) device
+    array.  Both axes are padded to hardware multiples: the lane (item) axis
+    to 128/TILE, and the sublane (factor) axis to 8 — realistic numFactors
+    values (10, 20, 50) are not sublane multiples and would otherwise
+    mis-tile in Mosaic.  Pad rows are zero, which is harmless to the dot
+    product; pad columns are masked inside the kernel."""
     n, k = matrix.shape
     # small catalogs: one lane-aligned tile; large: a whole number of TILEs
     n_pad = (
         _round_up(max(n, _LANE), _LANE) if n <= TILE else _round_up(n, TILE)
     )
-    mt = np.zeros((k, n_pad), dtype=np.float32)
-    mt[:, :n] = np.asarray(matrix, dtype=np.float32).T
+    k_pad = _round_up(max(k, 1), _SUBLANE)
+    mt = np.zeros((k_pad, n_pad), dtype=np.float32)
+    mt[:k, :n] = np.asarray(matrix, dtype=np.float32).T
     return jnp.asarray(mt)
 
 
@@ -160,6 +168,13 @@ def topk_scores(matrix_t, query, k_top: int, n_real: int,
     if k_top <= 0:
         return jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.int32)
     q_col = jnp.asarray(query, jnp.float32).reshape(-1, 1)
+    k_rows = matrix_t.shape[0]
+    if q_col.shape[0] > k_rows:
+        raise ValueError(
+            f"query has {q_col.shape[0]} factors, packed index has {k_rows}"
+        )
+    if q_col.shape[0] < k_rows:  # sublane padding added by pack_index
+        q_col = jnp.pad(q_col, ((0, k_rows - q_col.shape[0]), (0, 0)))
     return _topk_call(
         matrix_t, q_col, k_top=k_top, n_real=n_real, interpret=interpret
     )

@@ -21,11 +21,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 BLOCK_AXIS = "blocks"
 
 # Platform names whose presence in JAX_PLATFORMS counts as ambient launcher
-# default rather than user intent (see honor_platform_env).  Deployment
-# config: override with FLINK_MS_TPU_AMBIENT_PLATFORMS (comma-separated).
-_AMBIENT_ACCEL_PLATFORMS = tuple(
-    os.environ.get("FLINK_MS_TPU_AMBIENT_PLATFORMS", "axon").split(",")
-)
+# default rather than user intent (see honor_platform_env).  Common
+# accelerator names are included so a launcher exporting JAX_PLATFORMS=tpu
+# is treated the same as the axon tunnel's export.  Deployment config:
+# override with FLINK_MS_TPU_AMBIENT_PLATFORMS (comma-separated), read at
+# call time so tests/launchers can adjust it after import.
+_DEFAULT_AMBIENT = "axon,tpu,cuda,rocm"
+
+
+def _ambient_accel_platforms() -> tuple:
+    return tuple(
+        os.environ.get(
+            "FLINK_MS_TPU_AMBIENT_PLATFORMS", _DEFAULT_AMBIENT
+        ).split(",")
+    )
 
 
 def honor_platform_env() -> None:
@@ -37,7 +46,7 @@ def honor_platform_env() -> None:
     TPU tunnel down) expects it to stick, so re-apply it.
 
     An env value naming an ambient accelerator platform
-    (``_AMBIENT_ACCEL_PLATFORMS``) is NOT re-applied, for two reasons.
+    (``_ambient_accel_platforms()``) is NOT re-applied, for two reasons.
     First, the launcher exports that value into every process's
     environment, so its presence is ambient default rather than user
     intent — and it must not override an explicit in-process pin such as
@@ -47,7 +56,7 @@ def honor_platform_env() -> None:
     (benchmark baselines, host-side eval) rely on.
     """
     val = os.environ.get("JAX_PLATFORMS", "")
-    if val and not any(p in val.split(",") for p in _AMBIENT_ACCEL_PLATFORMS):
+    if val and not any(p in val.split(",") for p in _ambient_accel_platforms()):
         try:
             jax.config.update("jax_platforms", val)
         except Exception:

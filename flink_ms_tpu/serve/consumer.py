@@ -27,7 +27,9 @@ Key derivation:
 
 from __future__ import annotations
 
+import os
 import sys
+import tempfile
 import threading
 import time
 import uuid
@@ -312,8 +314,45 @@ class ServingJob:
 # CLIs
 # ---------------------------------------------------------------------------
 
+def _resolve_journal_dir(params: Params) -> str:
+    """Accept both the native ``--journalDir`` and the reference's Kafka
+    connection flags (``--bootstrap.servers``, ``--zookeeper.connect``,
+    ``--group.id`` — ALSKafkaConsumer.java:30-35) so a reference-shaped
+    invocation runs unchanged.  ``bootstrap.servers`` naming a filesystem
+    path maps to the journal dir (the journal IS the broker here); a
+    ``host:port`` value is acknowledged and ignored with a note."""
+    if params.has("journalDir"):
+        return params.get_required("journalDir")
+    bootstrap = params.get("bootstrap.servers")
+    if bootstrap and ("/" in bootstrap or os.path.isdir(bootstrap)):
+        print(
+            f"[serve] mapping --bootstrap.servers {bootstrap} to the local "
+            "journal directory",
+            file=sys.stderr,
+        )
+        return bootstrap
+    fallback = os.environ.get(
+        "TPUMS_JOURNAL_DIR",
+        os.path.join(tempfile.gettempdir(), "flink_ms_tpu_journal"),
+    )
+    if bootstrap:
+        print(
+            f"[serve] --bootstrap.servers {bootstrap} names a broker, not a "
+            f"path; there is no Kafka here — journal dir: {fallback} "
+            "(override with --journalDir or TPUMS_JOURNAL_DIR)",
+            file=sys.stderr,
+        )
+        return fallback
+    return params.get_required("journalDir")  # raises the canonical error
+
+
 def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
-    journal = Journal(params.get_required("journalDir"), params.get_required("topic"))
+    for ignored in ("zookeeper.connect", "group.id"):
+        if params.has(ignored):
+            # accepted for drop-in CLI parity; journal offsets replace
+            # ZooKeeper coordination and consumer-group bookkeeping
+            print(f"[serve] --{ignored} accepted and ignored", file=sys.stderr)
+    journal = Journal(_resolve_journal_dir(params), params.get_required("topic"))
     backend = make_backend(
         params.get("stateBackend", "memory"), params.get("checkpointDataUri")
     )

@@ -39,11 +39,17 @@ class DeviceFactorIndex:
         self._lock = threading.Lock()
         self._built_at = -1
         self._ids: List[str] = []
-        self._matrix = None  # (n, k) device array, or (k, n_pad) for pallas
+        self._matrix = None  # (n, k) device array, or (k_pad, n_pad) for pallas
         self._n_real = 0
+        self._k_real = 0  # real factor width (pallas pads the device array)
         self._topk_fn = None
 
     def _build(self) -> None:
+        from ..parallel.mesh import honor_platform_env
+
+        honor_platform_env()  # an explicit JAX_PLATFORMS pin (cpu fallback,
+        # tunnel down) must reach the device path here too, not be silently
+        # overridden by the site hook's platform pin
         import jax
         import jax.numpy as jnp
 
@@ -62,6 +68,7 @@ class DeviceFactorIndex:
             rows.append(vec)
         self._ids = ids
         self._n_real = len(ids)
+        self._k_real = width
         if not rows:
             self._matrix = None
         elif self.engine == "pallas":
@@ -94,10 +101,9 @@ class DeviceFactorIndex:
             n = self._n_real
             k_eff = min(k, n)
             q = np.asarray(user_factors, dtype=np.float32)
-            n_fac = (
-                self._matrix.shape[0] if self.engine == "pallas"
-                else self._matrix.shape[1]
-            )
+            # pallas packs with sublane padding, so validate against the
+            # real factor width captured at build time, not the array shape
+            n_fac = self._k_real
             if q.shape[0] != n_fac:
                 raise ValueError(
                     f"query has {q.shape[0]} factors, index has {n_fac}"

@@ -93,10 +93,29 @@ def _run_gate(variant: str, runtime_so: str, extra_env: dict) -> None:
     report = proc.stdout + proc.stderr
     assert "WORKLOAD-OK" in report, report
     # only reports that implicate our code fail the gate; the uninstrumented
-    # interpreter can trip unrelated interceptor noise
+    # interpreter can trip unrelated interceptor noise.  Scan whole report
+    # stanzas, not just the SUMMARY line: tsan/asan summaries show a single
+    # top frame, which can resolve to tpums.h, an inlined frame, or a libc
+    # interceptor even when the race is ours.
+    for stanza in _report_stanzas(report):
+        if any(m in stanza for m in ("store.cpp", "lookup_server", "tpums")):
+            raise AssertionError(stanza + "\n--- full report ---\n" + report)
+
+
+def _report_stanzas(report: str):
+    """Split sanitizer output into per-report blocks (WARNING/ERROR header
+    through the matching SUMMARY line)."""
+    stanza = None
     for line in report.splitlines():
-        if "SUMMARY:" in line and ("store.cpp" in line or "lookup_server" in line):
-            raise AssertionError(report)
+        if "WARNING: ThreadSanitizer" in line or "ERROR: AddressSanitizer" in line:
+            stanza = [line]
+        elif stanza is not None:
+            stanza.append(line)
+            if "SUMMARY:" in line:
+                yield "\n".join(stanza)
+                stanza = None
+    if stanza is not None:  # truncated report still counts
+        yield "\n".join(stanza)
 
 
 @pytest.mark.slow

@@ -266,3 +266,40 @@ def test_mse_live_against_serving(als_job, rng):
         )
     )
     assert out == pytest.approx(0.0, abs=1e-9)
+
+
+def test_consumer_accepts_reference_kafka_flags(tmp_path):
+    """A reference-shaped invocation (the exact flag set of
+    ALSKafkaConsumer.java:30-35, no --journalDir) must run: bootstrap.servers
+    naming a path maps to the journal dir, zookeeper.connect/group.id are
+    accepted and ignored."""
+    from flink_ms_tpu.serve.consumer import _run_consumer_cli
+
+    journal = Journal(str(tmp_path / "bus"), "models")
+    journal.append([F.format_als_row(7, "U", [1.0, 2.0])])
+    params = Params.from_args(
+        ["--topic", "models",
+         "--bootstrap.servers", str(tmp_path / "bus"),
+         "--zookeeper.connect", "localhost:2181",
+         "--group.id", "als-serving",
+         "--checkpointDataUri", str(tmp_path / "chk"),
+         "--stateBackend", "fs",
+         "--port", "0"]
+    )
+    job = _run_consumer_cli(params, ALS_STATE, parse_als_record)
+    try:
+        assert _wait_until(lambda: job.table.get("7-U") == "1.0;2.0")
+    finally:
+        job.stop()
+
+
+def test_consumer_broker_bootstrap_falls_back_to_env_journal(tmp_path, monkeypatch):
+    """host:port bootstrap.servers (a real broker address) can't be a journal
+    path; TPUMS_JOURNAL_DIR provides the location."""
+    from flink_ms_tpu.serve.consumer import _resolve_journal_dir
+
+    monkeypatch.setenv("TPUMS_JOURNAL_DIR", str(tmp_path / "env-bus"))
+    params = Params.from_args(
+        ["--topic", "models", "--bootstrap.servers", "broker-1:9092"]
+    )
+    assert _resolve_journal_dir(params) == str(tmp_path / "env-bus")
