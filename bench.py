@@ -47,29 +47,58 @@ def _log(msg: str) -> None:
 def acquire_devices():
     """-> (devices, platform, backend_error|None).
 
-    Retries accelerator init on UNAVAILABLE (transient tunnel/backend
-    hiccups), then degrades to the CPU backend with the error captured for
-    the JSON artifact."""
+    The accelerator backend is probed in a SUBPROCESS with a hard timeout
+    first: a hung init (tunnel down — observed to block jax.devices()
+    indefinitely rather than raise) must not hang the benchmark.  Probe
+    failures retry with backoff on UNAVAILABLE; on final failure the
+    benchmark degrades to the CPU backend with the error captured for the
+    JSON artifact.  Only after a successful probe does the in-process
+    backend initialize."""
+    import subprocess
+
     import jax
 
     attempts = int(os.environ.get("BENCH_INIT_ATTEMPTS", 4))
     backoff = float(os.environ.get("BENCH_INIT_BACKOFF_S", 10))
+    probe_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", 240))
     last_err = None
     for i in range(attempts):
         try:
-            devs = jax.devices()
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "from flink_ms_tpu.parallel.mesh import honor_platform_env;"
+                 "honor_platform_env();"  # the probe must respect an explicit
+                 # JAX_PLATFORMS pin exactly like the in-process path will
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=probe_timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"backend init hung >{probe_timeout:.0f}s"
+            _log(f"[bench] init attempt {i + 1}/{attempts}: {last_err}")
+            continue  # a hang is transient by assumption: tunnel may recover
+        if probe.returncode == 0:
+            # healthy backend: in-process init should take the same fast
+            # path — but the tunnel can still drop in the gap, so failures
+            # here fall through to the retry/degrade policy too
+            try:
+                devs = jax.devices()
+            except RuntimeError as e:
+                last_err = f"{type(e).__name__}: {e}"
+                _log(f"[bench] in-process init failed after probe: {e}")
+                continue
             accel = [d for d in devs if d.platform != "cpu"]
             if accel:
                 return accel, accel[0].platform, None
             return devs, "cpu", None
-        except RuntimeError as e:
-            last_err = f"{type(e).__name__}: {e}"
-            transient = "UNAVAILABLE" in str(e) or "Unable to initialize" in str(e)
-            _log(f"[bench] backend init attempt {i + 1}/{attempts} failed: {e}")
-            if not transient:
-                break
-            if i + 1 < attempts:
-                time.sleep(backoff * (1.5 ** i))
+        tail = (probe.stderr or "").strip().splitlines()
+        last_err = tail[-1] if tail else f"probe rc={probe.returncode}"
+        transient = "UNAVAILABLE" in last_err or "Unable to initialize" in last_err
+        _log(f"[bench] backend init attempt {i + 1}/{attempts} failed: {last_err}")
+        if not transient:
+            break
+        if i + 1 < attempts:
+            time.sleep(backoff * (1.5 ** i))
     # degrade: the CPU backend registers independently of the accelerator
     # plugin, so it survives an accelerator init failure — but only if no
     # JAX_PLATFORMS pin excludes it (the ambient launcher export is exactly
@@ -108,6 +137,12 @@ def peak_flops_per_device(device) -> float:
     if env:
         return float(env)
     kind = getattr(device, "device_kind", "").lower()
+    if device.platform != "cpu" and not any(
+        sub in kind for sub, _ in _PEAK_FLOPS_BY_KIND
+    ):
+        # tunneled devices may not report a standard TPU kind string; the
+        # launcher exports the generation separately
+        kind = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     for sub, peak in _PEAK_FLOPS_BY_KIND:
         if sub in kind:
             return peak

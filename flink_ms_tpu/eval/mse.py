@@ -45,27 +45,40 @@ def compute_mse(
     items: np.ndarray,
     ratings: np.ndarray,
     lookup,
+    lookup_many=None,
 ) -> Tuple[Optional[float], int, int]:
     """Reference group/skip semantics over an arbitrary key->factors lookup.
+
+    ``lookup_many`` (optional): batched variant taking a list of keys and
+    returning payload-or-None per key.  When given, each user group costs
+    ONE round trip (user + all its items in a single MGET) vs the
+    reference's one-per-group plus one-per-rating (MSE.java:129-158).
+    Skip semantics are unchanged: a missing user still drops the whole
+    group, a missing item just its rating.
 
     Returns (mse | None if nothing scored, n_scored, n_skipped).
     """
     sq_sum = 0.0
     n_scored = 0
     n_skipped = 0
-    user_cache: Dict[int, Optional[np.ndarray]] = {}
     for u in np.unique(users):
-        uf = user_cache.get(u)
-        if u not in user_cache:
-            uf = lookup(f"{u}-U")
-            user_cache[u] = uf
         sel = users == u
+        group_items = items[sel]
+        group_ratings = ratings[sel]
+        if lookup_many is not None:
+            keys = [f"{u}-U"] + [f"{it}-I" for it in group_items]
+            payloads = lookup_many(keys)
+            uf = payloads[0]
+            item_payloads = payloads[1:]
+        else:
+            uf = lookup(f"{u}-U")
+            item_payloads = None
         if uf is None:
             print(f"No record found for the user ID: {u}-U", file=sys.stderr)
             n_skipped += int(sel.sum())
             continue
-        for it, r in zip(items[sel], ratings[sel]):
-            itf = lookup(f"{it}-I")
+        for j, (it, r) in enumerate(zip(group_items, group_ratings)):
+            itf = item_payloads[j] if item_payloads is not None else lookup(f"{it}-I")
             if itf is None:
                 print(
                     f"No record found for the itemID query: {it}-I", file=sys.stderr
@@ -83,6 +96,9 @@ def _compute_mse_offline_batched(
 ) -> Tuple[Optional[float], int, int]:
     """Same semantics as compute_mse, but predictions in one device op."""
     from ..ops.als import ALSModel, predict
+    from ..parallel.mesh import honor_platform_env
+
+    honor_platform_env()  # explicit JAX_PLATFORMS pin must reach the device op
 
     def numeric_ids(suffix: str):
         out = set()
@@ -132,6 +148,7 @@ def run(params: Params, lookup=None) -> Optional[float]:
             users, items, ratings, table
         )
     else:
+        lookup_many = None
         if lookup is None:
             from ..serve.client import QueryClient
 
@@ -141,14 +158,26 @@ def run(params: Params, lookup=None) -> Optional[float]:
                 timeout_s=params.get_int("queryTimeout", 5),
             )
 
-            def lookup(key: str):
-                payload = client.query_state("ALS_MODEL", key)
+            def _parse(payload):
                 if payload is None:
                     return None
                 # serving values are the factor payload "f1;f2;..."
                 return np.asarray([float(t) for t in payload.split(";") if t])
 
-        mse, n_scored, n_skipped = compute_mse(users, items, ratings, lookup)
+            def lookup(key: str):
+                return _parse(client.query_state("ALS_MODEL", key))
+
+            if params.get_bool("batchedLookups", True):
+                # one MGET round trip per user group (vs one per rating)
+                def lookup_many(keys):
+                    return [
+                        _parse(p)
+                        for p in client.query_states("ALS_MODEL", keys)
+                    ]
+
+        mse, n_scored, n_skipped = compute_mse(
+            users, items, ratings, lookup, lookup_many=lookup_many
+        )
 
     if n_skipped:
         print(f"skipped {n_skipped} ratings with missing keys", file=sys.stderr)

@@ -43,6 +43,10 @@ def generate_rows(
 ) -> Iterator[str]:
     """Rows ``id,U|I,f1;...`` for ids 1..n (reference ids are 1-based —
     ALSModelGenerator.scala:47-53)."""
+    from ..parallel.mesh import honor_platform_env
+
+    honor_platform_env()  # an explicit JAX_PLATFORMS pin (cpu fallback,
+    # accelerator tunnel down) must reach the device RNG here too
     key = jax.random.PRNGKey(seed)
     done = 0
     while done < n:

@@ -56,10 +56,14 @@ class SGDStep:
         user_reg: float = 0.0,
         item_reg: float = 0.0,
         version: str = "v1",
+        lookup_many: Optional[Callable[[List[str]], List[Optional[str]]]] = None,
     ):
         if version not in ("v1", "v0"):
             raise ValueError("version must be v1 or v0")
         self.lookup = lookup
+        # batched lookup (the MGET verb): both factor queries of a rating in
+        # ONE round trip, vs the reference's two hops (SGD.java:172-173)
+        self.lookup_many = lookup_many
         self.user_mean = user_mean
         self.item_mean = item_mean
         self.lr = learning_rate
@@ -68,13 +72,8 @@ class SGDStep:
         self.version = version
         self.nan_records = 0
 
-    def _factors(self, id_: int, suffix: str, mean: str) -> np.ndarray:
-        key = f"{id_}{suffix}"
-        try:
-            payload = self.lookup(key)
-        except Exception as e:
-            print(f"query failed for {key}: {e}", file=sys.stderr)
-            payload = None
+    def _vec(self, id_: int, suffix: str, payload: Optional[str],
+             mean: str) -> np.ndarray:
         if payload is None:
             payload = mean
         vec = np.asarray([float(t) for t in payload.split(";") if t])
@@ -82,9 +81,28 @@ class SGDStep:
             print(f"NaN detected for: {id_}{suffix}")
         return vec
 
+    def _factors(self, id_: int, suffix: str, mean: str) -> np.ndarray:
+        key = f"{id_}{suffix}"
+        try:
+            payload = self.lookup(key)
+        except Exception as e:
+            print(f"query failed for {key}: {e}", file=sys.stderr)
+            payload = None
+        return self._vec(id_, suffix, payload, mean)
+
     def process(self, user: int, item: int, rating: float) -> List[str]:
-        u = self._factors(user, "-U", self.user_mean)
-        v = self._factors(item, "-I", self.item_mean)
+        if self.lookup_many is not None:
+            keys = [f"{user}-U", f"{item}-I"]
+            try:
+                pu, pi = self.lookup_many(keys)
+            except Exception as e:
+                print(f"query failed for {keys}: {e}", file=sys.stderr)
+                pu = pi = None
+            u = self._vec(user, "-U", pu, self.user_mean)
+            v = self._vec(item, "-I", pi, self.item_mean)
+        else:
+            u = self._factors(user, "-U", self.user_mean)
+            v = self._factors(item, "-I", self.item_mean)
         err = rating - float(u @ v)
 
         if self.version == "v1":
@@ -204,6 +222,9 @@ def run(params: Params, stop: Optional[Callable[[], bool]] = None) -> int:
         def lookup(key: str) -> Optional[str]:
             return client.query_state(ALS_STATE, key)
 
+        def lookup_many(keys: List[str]) -> List[Optional[str]]:
+            return client.query_states(ALS_STATE, keys)
+
         # mean vectors are loaded once at job start (SGD.java:142-151)
         user_mean = _mean_or_flag(lookup, "MEAN-U", params.get("userMean"))
         item_mean = _mean_or_flag(lookup, "MEAN-I", params.get("itemMean"))
@@ -218,6 +239,11 @@ def run(params: Params, stop: Optional[Callable[[], bool]] = None) -> int:
             user_reg=params.get_float("userRegularization", 0.0),
             item_reg=params.get_float("itemRegularization", 0.0),
             version=params.get("version", "v1"),
+            # one MGET round trip per rating unless explicitly disabled
+            # (--batchedLookups false restores strict per-key parity mode)
+            lookup_many=(
+                lookup_many if params.get_bool("batchedLookups", True) else None
+            ),
         )
 
         if output_mode in ("kafka", "journal"):

@@ -64,12 +64,7 @@ def honor_platform_env() -> None:
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
-    """1-D mesh over the first `n_devices` devices (default: all).
-
-    The reference's `--blocks`/`--parallelism` flags map to the mesh size;
-    a block count larger than the device count is handled inside the kernels
-    by stacking multiple logical blocks per device.
-    """
+    """1-D mesh over the first `n_devices` devices (default: all)."""
     if devices is None:
         honor_platform_env()
         devices = jax.devices()
@@ -80,6 +75,31 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = Non
             )
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (BLOCK_AXIS,))
+
+
+def mesh_for_blocks(
+    blocks: Optional[int], n_devices: Optional[int] = None
+) -> Mesh:
+    """Pick the mesh for a ``--blocks``/``--parallelism`` request.
+
+    - an explicit ``--devices`` count wins;
+    - multi-process runs always span every global device: a mesh capped
+      below the process count could own no devices on some process, which
+      would wedge that process's collectives (each process must
+      participate in every mesh it is part of);
+    - ``blocks <= devices``: a mesh of exactly ``blocks`` devices;
+    - ``blocks > devices``: all devices — the kernels stack the extra
+      logical blocks per device (the SVM kernel vmaps ceil(K/D) SDCA
+      chains per device; the ALS solver is row-exact, so any logical
+      block count partitions onto D device blocks without changing the
+      result).
+    """
+    honor_platform_env()
+    if n_devices is not None:
+        return make_mesh(n_devices)
+    if jax.process_count() > 1 or blocks is None:
+        return make_mesh()
+    return make_mesh(min(blocks, len(jax.devices())))
 
 
 def block_sharding(mesh: Mesh, *, rank: int = 2) -> NamedSharding:

@@ -65,6 +65,35 @@ class QueryClient:
             return None
         raise RuntimeError(f"query failed: {reply}")
 
+    def query_states(self, name: str, keys) -> list:
+        """Batched point lookups — ONE round trip for any number of keys
+        (the MGET verb).  Returns payloads in key order, None per missing
+        key.  This is the edge over the reference, whose online SGD pays two
+        network hops per rating (SGD.java:172-173)."""
+        keys = list(keys)
+        if not keys:
+            return []
+        for key in keys:
+            if "\t" in key or "\n" in key or "," in key:
+                raise ValueError("keys must not contain tabs/newlines/commas")
+        reply = self._roundtrip(f"MGET\t{name}\t{','.join(keys)}")
+        if not reply.startswith("M\t"):
+            raise RuntimeError(f"mget failed: {reply}")
+        items = reply[2:].split("\t")
+        if len(items) != len(keys):
+            raise RuntimeError(
+                f"mget returned {len(items)} items for {len(keys)} keys"
+            )
+        out = []
+        for it in items:
+            if it == "N":
+                out.append(None)
+            elif it.startswith("V"):
+                out.append(it[1:])
+            else:  # per-key store error ("E" slot from the native server)
+                raise RuntimeError(f"mget item failed: {it!r}")
+        return out
+
     def topk(self, name: str, user_id: str, k: int):
         """Device-scored top-k recommendations for a user; returns a list of
         (item_id, score) or None if the user is unknown."""

@@ -324,7 +324,11 @@ def _resolve_journal_dir(params: Params) -> str:
     if params.has("journalDir"):
         return params.get_required("journalDir")
     bootstrap = params.get("bootstrap.servers")
-    if bootstrap and ("/" in bootstrap or os.path.isdir(bootstrap)):
+    looks_like_path = bool(bootstrap) and "://" not in bootstrap and (
+        os.path.isdir(bootstrap)
+        or bootstrap.startswith(("/", "./", "../"))
+    )  # broker URLs (PLAINTEXT://host:9092, host:9092/chroot) fall through
+    if looks_like_path:
         print(
             f"[serve] mapping --bootstrap.servers {bootstrap} to the local "
             "journal directory",

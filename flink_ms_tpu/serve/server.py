@@ -5,13 +5,23 @@ KvState server queried by ``QueryClientHelper.queryState``
 Line protocol over TCP (persistent connections, thread per client):
 
     request:  ``GET\\t<state_name>\\t<key>\\n``
+              ``MGET\\t<state_name>\\t<k1>,<k2>,...\\n``  (batched point gets)
               ``TOPK\\t<state_name>\\t<user_id>\\t<k>\\n``  (device-scored top-k)
               ``PING\\n``
     response: ``V\\t<value>\\n``   key found / top-k payload ``item:score;...``
               ``N\\n``            unknown key (client maps to Optional.empty,
                                   mirroring UnknownKeyOrNamespace handling)
+              ``M\\t<i1>\\t<i2>...\\n``  MGET reply, one item per key in
+                                  request order: ``N`` missing, ``V<value>``
+                                  found (values are tab-free by contract —
+                                  model rows are CSV/semicolon text)
               ``E\\t<msg>\\n``    error (unknown state name, bad request)
               ``PONG\\t<job_id>\\t<state_name>\\n``
+
+The batched verb exists to beat the reference's serving hot spot: its online
+SGD pays two Netty round trips per rating (SGD.java:172-173) and its MSE job
+one per rating plus one per user group (MSE.java:129-158); MGET folds each
+of those into a single round trip.
 
 A C++ epoll implementation of the same protocol
 (``native/lookup_server.cpp``, wrapped by
@@ -43,6 +53,7 @@ class LookupServer:
         self.tables = tables
         self.job_id = job_id
         self.topk_handlers = topk_handlers or {}
+        self.requests = 0  # observability; also lets tests assert round trips
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -69,6 +80,7 @@ class LookupServer:
         self._thread: Optional[threading.Thread] = None
 
     def _dispatch(self, line: str) -> str:
+        self.requests += 1
         parts = line.split("\t")
         if parts[0] == "PING":
             return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
@@ -79,6 +91,16 @@ class LookupServer:
                 return f"E\tunknown state: {state}"
             value = table.get(key)
             return "N" if value is None else f"V\t{value}"
+        if parts[0] == "MGET" and len(parts) == 3:
+            _, state, keys_csv = parts
+            table = self.tables.get(state)
+            if table is None:
+                return f"E\tunknown state: {state}"
+            items = []
+            for key in keys_csv.split(","):
+                value = table.get(key)
+                items.append("N" if value is None else f"V{value}")
+            return "M\t" + "\t".join(items)
         if parts[0] == "TOPK" and len(parts) == 4:
             _, state, user_id, k_s = parts
             handler = self.topk_handlers.get(state)

@@ -32,7 +32,7 @@ from ..core import formats as F
 from ..core.params import Params, field_delimiter_from
 from ..ops.als import ALSConfig, ALSModel, als_fit, rmse
 from ..parallel.distributed import is_primary, maybe_init_distributed
-from ..parallel.mesh import honor_platform_env, make_mesh
+from ..parallel.mesh import honor_platform_env, mesh_for_blocks
 from ..utils import profiling
 
 
@@ -57,18 +57,13 @@ def run(params: Params) -> ALSModel | None:
         alpha=params.get_float("alpha", 40.0),
     )
 
-    n_devices = params.get_int("devices")
-    blocks = params.get_int("blocks")
-    import jax
-
     honor_platform_env()
     maybe_init_distributed(params)
-    avail = len(jax.devices())
-    if n_devices is None:
-        # --blocks larger than the device count is legal in the reference
-        # (more blocks than slots); here blocking == mesh size, capped
-        n_devices = min(blocks, avail) if blocks is not None else avail
-    mesh = make_mesh(n_devices)
+    # --blocks larger than the device count is legal in the reference (more
+    # blocks than slots).  The blocked-ALS solve is exact per row, so any
+    # logical block count partitions onto the D device blocks without
+    # changing the result; multi-process runs always span every device
+    mesh = mesh_for_blocks(params.get_int("blocks"), params.get_int("devices"))
 
     # get_required raises loudly on a present-but-valueless flag
     tmp = (

@@ -23,7 +23,7 @@ from ..core import formats as F
 from ..core.params import Params
 from ..ops.svm import SVMConfig, SVMModel, prepare_svm_blocked, svm_fit
 from ..parallel.distributed import is_primary, maybe_init_distributed
-from ..parallel.mesh import honor_platform_env, make_mesh
+from ..parallel.mesh import honor_platform_env, mesh_for_blocks
 from ..utils import profiling
 
 
@@ -31,20 +31,17 @@ def run(params: Params) -> SVMModel:
     training_path = params.get_required("training")
     data = F.read_libsvm(training_path)
 
-    import jax
-
     honor_platform_env()
     maybe_init_distributed(params)
-    avail = len(jax.devices())
     blocks = params.get_int("blocks", 10)
-    n_devices = params.get_int("devices")
-    if n_devices is None:
-        n_devices = min(blocks, avail)
-    mesh = make_mesh(n_devices)
+    # blocks = K logical SDCA chains; the mesh spans min(K, devices) (all
+    # devices in multi-process runs), and the kernel stacks ceil(K/D)
+    # chains per device when K exceeds the device count
+    mesh = mesh_for_blocks(blocks, params.get_int("devices"))
 
     iterations = params.get_int("iteration", params.get_int("iterations", 10))
     problem = prepare_svm_blocked(
-        data, n_devices, seed=params.get_int("seed", 0)
+        data, blocks, seed=params.get_int("seed", 0)
     )
     local_iters = params.get_int("localIterations", problem.rows_per_block)
     config = SVMConfig(
@@ -53,6 +50,10 @@ def run(params: Params) -> SVMModel:
         regularization=params.get_float("regularization", 1.0),
         stepsize=params.get_float("stepsize", 1.0),
         seed=params.get_int("seed", 0),
+        mode=params.get("mode", "avg"),
+        # CoCoA+ smoothing: unset = provably safe gamma*K; values in
+        # [1, gamma*K) are the aggressive sparse-data regime (ops/svm.py)
+        sigma_prime=params.get_float("sigmaPrime"),
     )
 
     t0 = time.time()

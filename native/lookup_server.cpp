@@ -6,6 +6,10 @@
 // Python query clients work unchanged:
 //
 //   GET\t<state>\t<key>\n   ->  V\t<value>\n | N\n | E\t<msg>\n
+//   MGET\t<state>\t<k1>,<k2>,...\n
+//                           ->  M\t<i1>\t<i2>...\n  (per key, in order:
+//                               N missing, V<value> found — one round trip
+//                               for a whole batch of point lookups)
 //   PING\n                  ->  PONG\t<job_id>\t<state>\n
 //   TOPK\t...\n             ->  E\tno topk index for state: <state>\n
 //                               (device-scored top-k stays on the Python
@@ -113,6 +117,35 @@ std::string handle_line(ServerState* s, const std::string& line) {
     reply.reserve(vlen + 3);
     reply.append("V\t").append(buf, vlen).push_back('\n');
     tpums_free_buf(buf);
+    return reply;
+  }
+  if (parts[0] == "MGET" && n == 3) {
+    if (parts[1] != s->state_name) {
+      return "E\tunknown state: " + parts[1] + "\n";
+    }
+    std::string reply = "M";
+    const std::string& keys = parts[2];
+    size_t start = 0;
+    while (true) {
+      size_t comma = keys.find(',', start);
+      size_t len =
+          (comma == std::string::npos ? keys.size() : comma) - start;
+      uint32_t vlen = 0;
+      int err = 0;
+      char* buf = tpums_get(s->store, keys.data() + start,
+                            static_cast<uint32_t>(len), &vlen, &err);
+      if (!buf) {
+        reply += err ? "\tE" : "\tN";  // per-key store error stays in-slot so
+                                       // the batch framing survives
+      } else {
+        reply += "\tV";
+        reply.append(buf, vlen);
+        tpums_free_buf(buf);
+      }
+      if (comma == std::string::npos) break;
+      start = comma + 1;
+    }
+    reply.push_back('\n');
     return reply;
   }
   if (parts[0] == "TOPK" && n == 4) {

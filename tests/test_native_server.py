@@ -78,6 +78,11 @@ def test_protocol_matches_python_server(store):
         b"GET\teven\tmore\ttabs\there\n"
         b"TOPK\ta\tb\tc\td\n"
         b"TOPK\tALS_MODEL\t1\n"
+        b"MGET\tALS_MODEL\t1-U,missing,2-I\n"
+        b"MGET\tALS_MODEL\t1-U\n"
+        b"MGET\tALS_MODEL\t\n"
+        b"MGET\tOTHER\t1-U\n"
+        b"MGET\tALS_MODEL\ta\tb\n"
         b"\n"
     )
     try:
@@ -200,3 +205,13 @@ def test_native_server_requires_native_backend(tmp_path):
     with pytest.raises(ValueError, match="nativeServer"):
         ServingJob(journal, ALS_STATE, parse_als_record,
                    make_backend("memory", None), port=0, native_server=True)
+
+
+def test_mget_batches_native(server):
+    """MGET on the C++ server: order-preserving, one round trip."""
+    with QueryClient("127.0.0.1", server.port) as c:
+        before = server.requests
+        vals = c.query_states(ALS_STATE, ["2-I", "nope", "1-U"])
+        assert vals == ["2.0;-1.0", None, "0.5;1.5"]
+        assert server.requests == before + 1
+        assert c.query_states(ALS_STATE, []) == []

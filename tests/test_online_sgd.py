@@ -276,3 +276,49 @@ def test_once_mode_reads_unterminated_final_line(tmp_path):
     single = tmp_path / "one.tsv"
     single.write_text("7\t8\t2.5")
     assert list(stream_ratings(str(single), "once", 100, "\t")) == [(7, 8, 2.5)]
+
+
+def test_batched_lookup_one_roundtrip_per_rating(tmp_path, rng):
+    """The MGET path: a pass over n ratings costs n+2 server requests
+    (2 mean loads + 1 MGET per rating), vs 2n+2 in per-key parity mode —
+    beating the reference's two-hops-per-rating design (SGD.java:172-173)."""
+    journal = Journal(str(tmp_path / "j"), "als_models")
+    job = ServingJob(
+        journal, ALS_STATE, parse_als_record, MemoryStateBackend(),
+        poll_interval_s=0.002, host="127.0.0.1", port=0,
+    )
+    job.start()
+    try:
+        k = 3
+        rows = [F.format_als_row(u, "U", rng.normal(size=k)) for u in range(4)]
+        rows += [F.format_als_row(i, "I", rng.normal(size=k)) for i in range(4)]
+        rows.append(F.format_mean_row("U", np.zeros(k)))
+        rows.append(F.format_mean_row("I", np.zeros(k)))
+        journal.append(rows)
+        assert _wait_until(lambda: len(job.table) == 10)
+
+        n = 12
+        ratings_path = tmp_path / "stream.tsv"
+        with open(ratings_path, "w") as f:
+            for j in range(n):
+                f.write(f"{j % 4}\t{(j + 1) % 4}\t1.0\n")
+
+        args = ["--input", str(ratings_path), "--mode", "once",
+                "--outputMode", "hdfs", "--outputPath", str(tmp_path / "out"),
+                "--jobId", job.job_id, "--jobManagerHost", "127.0.0.1",
+                "--jobManagerPort", str(job.port)]
+
+        before = job.server.requests
+        assert sgd_mod.run(Params.from_args(args)) == n
+        batched_cost = job.server.requests - before
+
+        before = job.server.requests
+        assert sgd_mod.run(
+            Params.from_args(args + ["--batchedLookups", "false"])
+        ) == n
+        per_key_cost = job.server.requests - before
+
+        assert batched_cost == n + 2
+        assert per_key_cost == 2 * n + 2
+    finally:
+        job.stop()

@@ -303,3 +303,60 @@ def test_consumer_broker_bootstrap_falls_back_to_env_journal(tmp_path, monkeypat
         ["--topic", "models", "--bootstrap.servers", "broker-1:9092"]
     )
     assert _resolve_journal_dir(params) == str(tmp_path / "env-bus")
+
+
+def test_mget_python_server():
+    """MGET on the contract (Python) server: order preserved, one request,
+    missing keys -> None, empty values survive."""
+    from flink_ms_tpu.serve.server import LookupServer
+
+    table = ModelTable(2)
+    table.put("1-U", "0.5;1.5")
+    table.put("2-I", "")
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0).start()
+    try:
+        with QueryClient("127.0.0.1", srv.port) as c:
+            before = srv.requests
+            assert c.query_states(ALS_STATE, ["2-I", "gone", "1-U"]) == \
+                ["", None, "0.5;1.5"]
+            assert srv.requests == before + 1
+            with pytest.raises(ValueError):
+                c.query_states(ALS_STATE, ["has,comma"])
+            with pytest.raises(RuntimeError):
+                c.query_states("NO_STATE", ["1-U"])
+    finally:
+        srv.stop()
+
+
+def test_mse_live_batched_one_roundtrip_per_group(als_job, rng):
+    """Live MSE with MGET costs one request per user group (vs one per
+    rating + one per group in the reference, MSE.java:129-158), with skip
+    semantics intact: group 9 has an unknown user, item 99 is unknown."""
+    job, journal, tmp_path = als_job
+    k = 2
+    rows = [F.format_als_row(u, "U", [1.0, float(u)]) for u in range(3)]
+    rows += [F.format_als_row(i, "I", [0.5, float(i)]) for i in range(3)]
+    journal.append(rows)
+    assert _wait_until(lambda: len(job.table) == 6)
+
+    ratings_path = str(tmp_path / "r.tsv")
+    with open(ratings_path, "w") as f:
+        f.write("header\n")
+        for u in range(3):
+            for i in range(3):
+                f.write(f"{u}\t{i}\t{1.0}\n")
+        f.write("9\t0\t1.0\n")   # unknown user: whole group skipped
+        f.write("0\t99\t1.0\n")  # unknown item: one rating skipped
+    before = job.server.requests
+    out = mse_mod.run(
+        Params.from_args(
+            ["--input", ratings_path, "--jobManagerHost", "127.0.0.1",
+             "--jobManagerPort", str(job.port), "--jobId", job.job_id]
+        )
+    )
+    # 4 user groups (0,1,2,9) -> 4 MGETs, nothing else
+    assert job.server.requests - before == 4
+    expected = float(np.mean(
+        [(1.0 - (1.0 * 0.5 + u * i)) ** 2 for u in range(3) for i in range(3)]
+    ))
+    assert out == pytest.approx(expected)
