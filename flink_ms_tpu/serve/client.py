@@ -98,6 +98,20 @@ class QueryClient:
         """Device-scored top-k recommendations for a user; returns a list of
         (item_id, score) or None if the user is unknown."""
         reply = self._roundtrip(f"TOPK\t{name}\t{user_id}\t{k}")
+        return self._parse_topk_reply(reply)
+
+    def topk_by_vector(self, name: str, factors_payload: str, k: int):
+        """Top-k against an explicit query vector (``f1;f2;...`` payload) —
+        the TOPKV verb.  Used by the sharded client to score a worker's
+        catalog slice when the user's row lives on a different worker."""
+        if "\t" in factors_payload or "\n" in factors_payload:
+            raise ValueError("factor payloads must not contain tabs/newlines")
+        reply = self._roundtrip(f"TOPKV\t{name}\t{k}\t{factors_payload}")
+        out = self._parse_topk_reply(reply)
+        return [] if out is None else out
+
+    @staticmethod
+    def _parse_topk_reply(reply: str):
         if reply == "N":
             return None
         if not reply.startswith("V\t"):

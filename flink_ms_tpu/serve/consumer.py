@@ -293,13 +293,16 @@ class ServingJob:
                 if not line:
                     continue
                 try:
-                    key, value = self.parse_fn(line)
+                    parsed = self.parse_fn(line)
                 except ValueError:
                     # the reference would fail the task and burn a restart on
                     # a malformed row; skip-and-count is the deliberate fix
                     # (SURVEY.md Appendix C decision)
                     self.parse_errors += 1
                     continue
+                if parsed is None:
+                    continue  # row owned by another sharded worker
+                key, value = parsed
                 self.table.put(key, value)
             self.offset = next_offset
             now = time.time()

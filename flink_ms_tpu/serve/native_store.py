@@ -260,11 +260,19 @@ class NativeModelTable:
         self.store = store
         self._lock = threading.RLock()
         self.puts = 0
+        self._listeners = []
+
+    def add_change_listener(self, fn) -> None:
+        """fn(key) on every put (same contract as ModelTable)."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def put(self, key: str, value: str) -> None:
         with self._lock:
             self.store.put(key, value)
             self.puts += 1
+            for fn in self._listeners:
+                fn(key)
 
     def get(self, key: str) -> Optional[str]:
         return self.store.get(key)

@@ -7,6 +7,10 @@ Line protocol over TCP (persistent connections, thread per client):
     request:  ``GET\\t<state_name>\\t<key>\\n``
               ``MGET\\t<state_name>\\t<k1>,<k2>,...\\n``  (batched point gets)
               ``TOPK\\t<state_name>\\t<user_id>\\t<k>\\n``  (device-scored top-k)
+              ``TOPKV\\t<state_name>\\t<k>\\t<f1;f2;...>\\n``  (top-k by an
+                                  explicit query vector — lets a sharded
+                                  client fan out across workers that only
+                                  hold a slice of the catalog)
               ``PING\\n``
     response: ``V\\t<value>\\n``   key found / top-k payload ``item:score;...``
               ``N\\n``            unknown key (client maps to Optional.empty,
@@ -101,16 +105,24 @@ class LookupServer:
                 value = table.get(key)
                 items.append("N" if value is None else f"V{value}")
             return "M\t" + "\t".join(items)
-        if parts[0] == "TOPK" and len(parts) == 4:
-            _, state, user_id, k_s = parts
+        if parts[0] in ("TOPK", "TOPKV") and len(parts) == 4:
+            # TOPK resolves the user's factors server-side; TOPKV scores an
+            # explicit query vector (operands: state, k, payload)
+            if parts[0] == "TOPK":
+                _, state, query_arg, k_s = parts
+            else:
+                _, state, k_s, query_arg = parts
             handler = self.topk_handlers.get(state)
-            if handler is None:
+            if handler is None or (
+                parts[0] == "TOPKV" and not hasattr(handler, "by_vector")
+            ):
                 return f"E\tno topk index for state: {state}"
+            fn = handler if parts[0] == "TOPK" else handler.by_vector
             try:
                 k = int(k_s)
                 if k < 1:
                     return "E\tk must be >= 1"
-                payload = handler(user_id, k)
+                payload = fn(query_arg, k)
             except Exception as e:
                 return f"E\ttopk failed: {e}"
             return "N" if payload is None else f"V\t{payload}"

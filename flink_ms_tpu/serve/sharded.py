@@ -1,0 +1,225 @@
+"""Multi-process sharded serving: N worker processes each own a hash slice
+of the queryable state, with client-side key routing.
+
+This is the scale-out dimension of the reference's serving plane:
+``keyBy(0).asQueryableState`` spreads keyed state across TaskManager
+subtasks and the Netty client reaches whichever subtask owns a key's shard
+(``ALSKafkaConsumer.java:85-92`` + the KvState location lookup [dep]).
+Here the same contract is explicit:
+
+- every worker consumes the SAME journal topic but keeps only the keys
+  with ``fnv1a(key) % num_workers == worker_index`` (the identical stable
+  hash the in-process table uses for its shards, ``table.py``);
+- the client routes each key to its owning worker with the same hash —
+  no location service round trip, the hash IS the location;
+- top-k fans out: the user's factor row is fetched from its owner, then a
+  ``TOPKV`` scores every worker's catalog slice with that vector and the
+  client merges the per-worker top-k by score.
+
+Failure semantics (defined, test-pinned): queries for keys owned by a dead
+worker raise ``ConnectionError`` — exactly the reference's behavior while
+a subtask restarts — while every other worker keeps serving.  A restarted
+worker restores its checkpoint and replays the journal from its committed
+offset, after which its keys resolve again.
+
+Worker CLI (one process per worker):
+
+    python -m flink_ms_tpu.serve.sharded --workerIndex 0 --numWorkers 3 \
+        --journalDir DIR --topic T --stateBackend fs \
+        --checkpointDataUri DIR2 [--svm true] [--portFile P]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..core.params import Params
+from .client import QueryClient
+from .consumer import (
+    ALS_STATE,
+    SVM_STATE,
+    ServingJob,
+    make_backend,
+    parse_als_record,
+    parse_svm_record,
+)
+from .journal import Journal
+from .table import _fnv1a
+
+
+def owner_of(key: str, num_workers: int) -> int:
+    """The worker owning `key` — the one routing function shared by
+    ingest filtering and client routing."""
+    return _fnv1a(key) % num_workers
+
+
+def sharded_parse(
+    parse_fn: Callable[[str], Tuple[str, str]],
+    worker_index: int,
+    num_workers: int,
+) -> Callable[[str], Optional[Tuple[str, str]]]:
+    """Wrap a record parser so rows owned by other workers are skipped
+    (the consume loop treats a None parse as not-mine, not an error)."""
+
+    def parse(line: str) -> Optional[Tuple[str, str]]:
+        key, value = parse_fn(line)
+        if owner_of(key, num_workers) != worker_index:
+            return None
+        return key, value
+
+    return parse
+
+
+class ShardedQueryClient:
+    """Routes queries across the worker endpoints by key hash.
+
+    ``endpoints`` is the ordered (host, port) list — index == workerIndex.
+    GET/MGET go straight to the owner; TOPK resolves the user's factors
+    from their owner, then fans ``TOPKV`` to every worker and merges.
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[Tuple[str, int]],
+        timeout_s: float = 5.0,
+        job_id: Optional[str] = None,
+    ):
+        if not endpoints:
+            raise ValueError("need at least one endpoint")
+        self._clients = [
+            QueryClient(host, port, timeout_s=timeout_s, job_id=job_id)
+            for host, port in endpoints
+        ]
+
+    @property
+    def num_workers(self) -> int:
+        return len(self._clients)
+
+    def owner(self, key: str) -> int:
+        return owner_of(key, self.num_workers)
+
+    def query_state(self, name: str, key: str) -> Optional[str]:
+        return self._clients[self.owner(key)].query_state(name, key)
+
+    def query_states(self, name: str, keys) -> list:
+        """Batched lookups: one MGET per worker that owns any of the keys,
+        issued CONCURRENTLY (latency ~ slowest worker, not the sum),
+        results reassembled in request order."""
+        keys = list(keys)
+        out: List[Optional[str]] = [None] * len(keys)
+        by_owner: dict = {}
+        for pos, key in enumerate(keys):
+            by_owner.setdefault(self.owner(key), []).append(pos)
+        if len(by_owner) == 1:
+            ((w, positions),) = by_owner.items()
+            for p, v in zip(positions, self._clients[w].query_states(
+                    name, [keys[p] for p in positions])):
+                out[p] = v
+            return out
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(by_owner)) as pool:
+            futures = {
+                w: pool.submit(
+                    self._clients[w].query_states,
+                    name, [keys[p] for p in positions],
+                )
+                for w, positions in by_owner.items()
+            }
+            for w, positions in by_owner.items():
+                for p, v in zip(positions, futures[w].result()):
+                    out[p] = v
+        return out
+
+    def topk(self, name: str, user_id: str, k: int):
+        """Fan-out top-k: returns the merged [(item, score)] best-k across
+        every worker's catalog slice (scored concurrently), or None if the
+        user is unknown."""
+        user_payload = self.query_state(name, f"{user_id}-U")
+        if user_payload is None:
+            return None
+        from concurrent.futures import ThreadPoolExecutor
+
+        merged: List[Tuple[str, float]] = []
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            for part in pool.map(
+                lambda c: c.topk_by_vector(name, user_payload, k),
+                self._clients,
+            ):
+                merged.extend(part)
+        merged.sort(key=lambda it: -it[1])
+        return merged[:k]
+
+    def ping_all(self) -> List[str]:
+        return [c.ping() for c in self._clients]
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# worker CLI
+# ---------------------------------------------------------------------------
+
+def run_worker(params: Params) -> ServingJob:
+    worker_index = params.get_int("workerIndex")
+    num_workers = params.get_int("numWorkers")
+    if worker_index is None or num_workers is None:
+        raise ValueError("--workerIndex and --numWorkers are required")
+    if not (0 <= worker_index < num_workers):
+        raise ValueError("need 0 <= workerIndex < numWorkers")
+    svm = params.get_bool("svm", False)
+    state_name = SVM_STATE if svm else ALS_STATE
+    base_parse = parse_svm_record if svm else parse_als_record
+
+    journal = Journal(
+        params.get_required("journalDir"), params.get_required("topic")
+    )
+    # each worker checkpoints its own slice: separate subdir per index so
+    # restarts restore the right partition
+    uri = params.get("checkpointDataUri")
+    if uri:
+        uri = f"{uri.rstrip('/')}/worker-{worker_index}"
+    backend = make_backend(params.get("stateBackend", "memory"), uri)
+    job = ServingJob(
+        journal,
+        state_name,
+        sharded_parse(base_parse, worker_index, num_workers),
+        backend,
+        n_shards=params.get_int("shards", 8),
+        checkpoint_interval_ms=params.get_int("checkPointInterval", 60_000),
+        host=params.get("host", "0.0.0.0"),
+        port=params.get_int("port", 0),
+        job_id=params.get("jobId", f"worker-{worker_index}"),
+    ).start()
+    print(
+        f"[serve:sharded] worker {worker_index}/{num_workers} "
+        f"({state_name}) on port {job.port}",
+        file=sys.stderr,
+    )
+    port_file = params.get("portFile")
+    if port_file:
+        with open(port_file, "w") as f:
+            json.dump(
+                {"port": job.port, "workerIndex": worker_index,
+                 "jobId": job.job_id}, f
+            )
+    return job
+
+
+def main(argv=None) -> None:
+    job = run_worker(Params.from_args(sys.argv[1:] if argv is None else argv))
+    job.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,14 @@ class ModelTable:
         self._shards: List[Dict[str, str]] = [dict() for _ in range(n_shards)]
         self._lock = threading.RLock()
         self.puts = 0  # ingest counter (observability)
+        self._listeners: List = []  # change listeners (e.g. the top-k index)
+
+    def add_change_listener(self, fn) -> None:
+        """Register fn(key) to be called on every put.  Callbacks run on
+        the writer thread under the table lock — keep them O(1) (the top-k
+        index just records the key in its dirty set)."""
+        with self._lock:
+            self._listeners.append(fn)
 
     def shard_of(self, key: str) -> int:
         return _fnv1a(key) % self.n_shards
@@ -50,6 +58,8 @@ class ModelTable:
         with self._lock:
             self._shards[self.shard_of(key)][key] = value
             self.puts += 1
+            for fn in self._listeners:
+                fn(key)
 
     def get(self, key: str) -> Optional[str]:
         return self._shards[self.shard_of(key)].get(key)

@@ -148,7 +148,7 @@ std::string handle_line(ServerState* s, const std::string& line) {
     reply.push_back('\n');
     return reply;
   }
-  if (parts[0] == "TOPK" && n == 4) {
+  if ((parts[0] == "TOPK" || parts[0] == "TOPKV") && n == 4) {
     // parity with a Python LookupServer that has no registered handler
     return "E\tno topk index for state: " + parts[1] + "\n";
   }

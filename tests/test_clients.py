@@ -206,12 +206,19 @@ def test_topk_against_brute_force(als_serving):
 
 def test_topk_sees_online_update(als_serving):
     job, uf, itf = als_serving
-    # push a new item that dominates all scores for user 0
     big = 100.0 * np.sign(uf[0])
-    job.journal_append_for_tests = None  # no-op marker
     with QueryClient("127.0.0.1", job.port) as c:
         before = c.topk(ALS_STATE, "0", 1)
-        job.table.put("777-I", ";".join(repr(float(v)) for v in big))
+        assert before[0][0] != "777"
+        # an update to an EXISTING row is applied in place: visible on the
+        # very next query
+        existing = before[0][0]
+        job.table.put(f"{existing}-I", ";".join(repr(float(v)) for v in -big))
+        job.table.put("0-I", ";".join(repr(float(v)) for v in big))
         after = c.topk(ALS_STATE, "0", 1)
-    assert after[0][0] == "777"
-    assert before[0][0] != "777"
+        assert after[0][0] == "0" and after[0][1] > before[0][1]
+        # a NEW item lands via the background rebuild: visible eventually
+        job.table.put("777-I", ";".join(repr(float(v)) for v in 2 * big))
+        assert _wait_until(
+            lambda: c.topk(ALS_STATE, "0", 1)[0][0] == "777"
+        ), "new item never reached the top-k index"
