@@ -179,11 +179,13 @@ def time_fit(mesh, problem, cfg_base, iters, repeats=5):
     inputs: same executable (dynamic trip count) timed at 1 iteration and at
     `iters`; the difference isolates per-iter cost from dispatch overhead.
     Host<->device transfer happens once, outside the timed region; every
-    timed call ends in block_until_ready.  Median over `repeats`."""
-    import jax
+    timed call ends in a hard value-fetch sync (block_until_ready is not a
+    reliable barrier on tunneled backends — utils.profiling.hard_sync).
+    Median over `repeats`."""
     import jax.numpy as jnp
 
     from flink_ms_tpu.ops.als import compile_fit
+    from flink_ms_tpu.utils.profiling import hard_sync
 
     iters = max(iters, 2)  # need two points to isolate per-iter cost
     fit_fn, dev_args = compile_fit(problem, cfg_base, mesh)
@@ -191,7 +193,7 @@ def time_fit(mesh, problem, cfg_base, iters, repeats=5):
     def run(trip):
         t0 = time.time()
         uf, itf = fit_fn(jnp.asarray(trip, jnp.int32), *dev_args)
-        jax.block_until_ready((uf, itf))
+        hard_sync(uf)
         return time.time() - t0
 
     # same executable for every trip count (dynamic while_loop bound), so

@@ -99,14 +99,16 @@ def run_svm_section(devices, platform, small: bool) -> dict:
     )
     fit, dev_args = compile_svm_fit(problem, cfg, mesh)
 
-    import jax
+    from flink_ms_tpu.utils.profiling import hard_sync
 
     # steady-state sec/round: same executable (dynamic trip count) timed at
-    # 1 round and at `rounds`; difference isolates per-round cost
+    # 1 round and at `rounds`; difference isolates per-round cost.  The
+    # timed region ends in a hard value-fetch sync — block_until_ready is
+    # not a reliable barrier on tunneled backends.
     def run_rounds(r):
         t = time.time()
         w, a = fit(jnp.asarray(r, jnp.int32), *dev_args)
-        jax.block_until_ready((w, a))
+        hard_sync(w)
         return time.time() - t, w
 
     run_rounds(1)  # compile + warmup

@@ -17,6 +17,27 @@ import time
 from typing import Dict, List, Optional
 
 
+def hard_sync(x) -> float:
+    """Force completion of the computation producing `x` and return one
+    element as a Python float.
+
+    ``jax.block_until_ready`` is NOT a reliable barrier on tunneled device
+    backends (observed on the axon TPU tunnel: repeat executions return
+    "ready" arrays whose computation is still in flight, collapsing timed
+    regions to dispatch cost).  The only dependable barrier is a value
+    fetch, so this dispatches a tiny on-device reduction of the first leaf
+    and pulls the scalar to the host.  Use this — never bare
+    block_until_ready — to end a timed region in benchmarks.
+    """
+    import jax
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    if hasattr(leaf, "ravel"):
+        leaf = leaf.ravel()[:1]  # dependent slice: forces the producer
+    return float(np.asarray(leaf).ravel()[0])
+
+
 @contextlib.contextmanager
 def trace(trace_dir: Optional[str]):
     """JAX/XLA profiler trace of the enclosed block, written to `trace_dir`
