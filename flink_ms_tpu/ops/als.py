@@ -10,9 +10,14 @@ regularized normal equations
 
 as a *batched Cholesky* (MXU-friendly), and the reference's per-iteration
 factor-block shuffle over Netty becomes a single ``all_gather`` over ICI.
-Ratings are laid out as per-block padded CSR; normal-equation assembly is a
-``lax.scan`` over fixed-size nnz chunks with ``segment_sum`` so no
-(nnz, k, k) intermediate ever materializes.
+
+Ratings are laid out **degree-bucketed**: within each block, entities are
+grouped by degree class (power-of-two widths) and each group's rating lists
+are padded to the class width, so normal-equation assembly is a short list
+of dense batched ``einsum`` contractions — pure gather + MXU matmul, no
+scatter.  (A scatter/``segment_sum`` formulation was measured 8-10x slower
+on v5e: TPU scatter serializes per row, and XLA's batched small-matrix
+Cholesky streams the whole (n, k, k) tensor per elimination step.)
 
 Supports the two training modes named in BASELINE.md:
 
@@ -45,9 +50,6 @@ from jax import shard_map
 
 from ..parallel.mesh import BLOCK_AXIS, block_sharding, num_blocks
 
-_CHUNK = 4096  # nnz entries per assembly step; bounds the (C, k, k) scratch
-
-
 # ---------------------------------------------------------------------------
 # config + host-side problem layout
 # ---------------------------------------------------------------------------
@@ -67,31 +69,48 @@ class ALSConfig:
     dtype: jnp.dtype = jnp.float32
 
 
+_MIN_BUCKET_W = 8  # smallest rating-list pad width (sublane-friendly)
+
+
+@dataclasses.dataclass
+class SideLayout:
+    """Degree-bucketed layout of one orientation (user- or item-major).
+
+    Entities of a block are grouped by degree class; class j pads every
+    member's rating list to ``widths[j]`` columns.  The factor table itself
+    lives in *slot order* on device — ``perm`` maps dense entity index to
+    its global slot ``block * per_block + local`` — so bucket outputs are
+    contiguous rows and the solve writes factors with no scatter.
+    """
+
+    per_block: int            # slots per block (Σ_j rows[j] ≥ entities/block)
+    n_rows: int               # real entity count
+    perm: np.ndarray          # (n_rows,) dense index -> global slot
+    widths: Tuple[int, ...]   # pad width per bucket, descending
+    rows: Tuple[int, ...]     # rows per bucket per block (static across blocks)
+    idx: list                 # per bucket: (D, rows[j], widths[j]) int32,
+    #                           opposite-side global slot of each rating
+    val: list                 # per bucket: ratings, pad entries 0
+    msk: list                 # per bucket: 1.0 real / 0.0 pad
+    count: np.ndarray         # (D, per_block) degree per slot (0 for dummies)
+
+
 @dataclasses.dataclass
 class BlockedProblem:
     """Ratings re-laid-out for a D-block mesh (host-side, numpy).
 
     The analog of FlinkML's user-block x item-block routing tables [dep]:
-    instead of routing messages, each block holds padded CSR of the ratings
-    it owns in both orientations, and factor exchange is an all_gather.
+    instead of routing messages, each block holds the degree-bucketed pad
+    layout of the ratings it owns in both orientations, and factor exchange
+    is an all_gather.
     """
 
     n_blocks: int
     user_ids: np.ndarray      # (n_users,) raw ids, sorted
     item_ids: np.ndarray      # (n_items,) raw ids, sorted
-    users_per_block: int
-    items_per_block: int
     nnz: int
-    # user-major CSR, shapes (D, nnz_u_pad) / counts (D, users_per_block)
-    u_item_idx: np.ndarray
-    u_rating: np.ndarray
-    u_seg: np.ndarray
-    u_count: np.ndarray
-    # item-major CSR, shapes (D, nnz_i_pad) / counts (D, items_per_block)
-    i_user_idx: np.ndarray
-    i_rating: np.ndarray
-    i_seg: np.ndarray
-    i_count: np.ndarray
+    u: SideLayout             # user-major (solves user factors)
+    i: SideLayout             # item-major (solves item factors)
 
     @property
     def n_users(self) -> int:
@@ -100,6 +119,125 @@ class BlockedProblem:
     @property
     def n_items(self) -> int:
         return int(self.item_ids.shape[0])
+
+    # factor-table slot counts (include bucket-padding dummy rows)
+    @property
+    def users_per_block(self) -> int:
+        return self.u.per_block
+
+    @property
+    def items_per_block(self) -> int:
+        return self.i.per_block
+
+
+def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int):
+    """Degree-sorted block layout of one side -> (deg, block_of, rank, perm,
+    widths, rows, per_block, bucket_of).
+
+    Entities are split into D contiguous dense-index blocks (the reference's
+    ``setBlocks`` partitioning), then within each block ordered by degree
+    descending so each degree bucket is a contiguous slot range.
+    """
+    dense_pb = -(-n_rows // n_blocks)  # dense entities per block (ceil)
+    deg = np.bincount(row_idx, minlength=n_rows).astype(np.int64)
+    block_of = np.arange(n_rows) // dense_pb
+    # within-block order: degree desc, dense index as tiebreak
+    order = np.lexsort((np.arange(n_rows), -deg, block_of))
+    # bucket = index into descending power-of-two widths
+    widths_all = []
+    w = 1 << max(int(np.max(deg)) - 1, 0).bit_length()
+    w = max(w, _MIN_BUCKET_W)
+    while True:
+        widths_all.append(w)
+        if w <= _MIN_BUCKET_W:
+            break
+        w //= 2
+    widths_all = np.array(widths_all)  # descending powers of two
+    # bucket of an entity = smallest width >= its degree.  widths_all[idx]
+    # = w0 >> idx, so idx = log2(w0) - ceil(log2(deg)); log2 is exact on
+    # binary powers, so the ceil is reliable
+    logw0 = int(widths_all[0]).bit_length() - 1
+    need = np.ceil(np.log2(np.maximum(deg, 1).astype(np.float64))).astype(np.int64)
+    bucket_of = np.clip(logw0 - need, 0, len(widths_all) - 1)
+    # per (block, bucket) entity counts -> static rows per bucket = max over blocks
+    counts_bb = np.zeros((n_blocks, len(widths_all)), dtype=np.int64)
+    np.add.at(counts_bb, (block_of, bucket_of), 1)
+    rows_per_bucket = counts_bb.max(axis=0)
+    keep = rows_per_bucket > 0
+    widths = tuple(int(x) for x in widths_all[keep])
+    rows = tuple(int(x) for x in rows_per_bucket[keep])
+    # remap bucket ids to the kept, descending-width list
+    remap = np.cumsum(keep) - 1
+    bucket_of = remap[bucket_of]
+    offsets = np.concatenate([[0], np.cumsum(rows)])  # slot offset per bucket
+    per_block = int(offsets[-1])
+    # rank of each entity within its (block, bucket), following `order`
+    sorted_b = block_of[order]
+    sorted_j = bucket_of[order]
+    key = sorted_b * len(widths) + sorted_j
+    starts = np.searchsorted(key, np.arange(n_blocks * len(widths) + 1))
+    rank = np.arange(n_rows) - starts[key]
+    perm_sorted = sorted_b * per_block + offsets[sorted_j] + rank
+    perm = np.empty(n_rows, dtype=np.int64)
+    perm[order] = perm_sorted
+    return deg, block_of, bucket_of, perm, widths, rows, per_block
+
+
+def _fill_side(
+    row_idx, col_idx, vals, n_rows, n_blocks, side_order, opp_perm, dtype
+) -> SideLayout:
+    """Build one side's bucketed arrays from its precomputed ``_side_order``
+    result.  ``opp_perm`` maps the opposite side's dense indices to its
+    global slots (the positions valid against the all_gather'd factor
+    table)."""
+    deg, block_of, bucket_of, perm, widths, rows, per_block = side_order
+    nb = len(widths)
+    idx = [np.zeros((n_blocks, rows[j], widths[j]), np.int32) for j in range(nb)]
+    val = [np.zeros((n_blocks, rows[j], widths[j]), dtype) for j in range(nb)]
+    msk = [np.zeros((n_blocks, rows[j], widths[j]), dtype) for j in range(nb)]
+    count = np.zeros((n_blocks, per_block), dtype)
+
+    # ratings sorted by owning entity -> contiguous per-entity runs
+    order_r = np.argsort(row_idx, kind="stable")
+    ent_start = np.searchsorted(row_idx[order_r], np.arange(n_rows + 1))
+    col_sorted = opp_perm[col_idx[order_r]].astype(np.int64)
+    val_sorted = vals[order_r]
+
+    local = perm - block_of * per_block  # slot within block
+    offsets = np.concatenate([[0], np.cumsum(rows)])
+    count[(block_of, local)] = deg.astype(dtype)
+
+    for j in range(nb):
+        sel = np.nonzero(bucket_of == j)[0]  # dense entity ids in bucket j
+        if len(sel) == 0:
+            continue
+        lens = deg[sel]
+        total = int(lens.sum())
+        if total == 0:
+            continue
+        # ragged fill: src positions into the entity-sorted rating arrays,
+        # dst positions into the flattened (D*rows_j, w_j) bucket arrays
+        rep = np.repeat(np.arange(len(sel)), lens)
+        intra = np.arange(total) - np.repeat(
+            np.concatenate([[0], np.cumsum(lens)[:-1]]), lens
+        )
+        src = np.repeat(ent_start[sel], lens) + intra
+        flat_row = block_of[sel] * rows[j] + (local[sel] - offsets[j])
+        dst = np.repeat(flat_row * widths[j], lens) + intra
+        idx[j].reshape(-1)[dst] = col_sorted[src]
+        val[j].reshape(-1)[dst] = val_sorted[src]
+        msk[j].reshape(-1)[dst] = 1.0
+    return SideLayout(
+        per_block=per_block,
+        n_rows=n_rows,
+        perm=perm,
+        widths=widths,
+        rows=rows,
+        idx=idx,
+        val=val,
+        msk=msk,
+        count=count,
+    )
 
 
 def prepare_blocked(
@@ -110,11 +248,8 @@ def prepare_blocked(
     dtype=np.float32,
 ) -> BlockedProblem:
     """Build the blocked layout: dense-reindex raw ids, split entities into
-    D contiguous blocks, and emit padded CSR per block in both orientations.
-
-    Padding convention: pad entries carry seg id == entities_per_block (an
-    extra segment that is sliced off after ``segment_sum``), idx 0, rating 0.
-    """
+    D contiguous blocks, degree-sort within blocks, and emit the bucketed
+    pad layout per block in both orientations."""
     users = np.asarray(users)
     items = np.asarray(items)
     ratings = np.asarray(ratings, dtype=np.float64)
@@ -124,114 +259,130 @@ def prepare_blocked(
     user_ids, u_idx = np.unique(users, return_inverse=True)
     item_ids, i_idx = np.unique(items, return_inverse=True)
 
-    def one_side(row_idx, col_idx, vals, n_rows):
-        per_block = -(-n_rows // n_blocks)  # ceil
-        order = np.argsort(row_idx, kind="stable")
-        r_sorted = row_idx[order]
-        c_sorted = col_idx[order]
-        v_sorted = vals[order]
-        block_of = r_sorted // per_block
-        # contiguous span of each block in the sorted arrays
-        bounds = np.searchsorted(block_of, np.arange(n_blocks + 1))
-        max_nnz = int(np.max(bounds[1:] - bounds[:-1])) if len(vals) else 0
-        nnz_pad = max(_round_up(max_nnz, 8), 8)
-        idx = np.zeros((n_blocks, nnz_pad), dtype=np.int32)
-        val = np.zeros((n_blocks, nnz_pad), dtype=dtype)
-        seg = np.full((n_blocks, nnz_pad), per_block, dtype=np.int32)
-        cnt = np.zeros((n_blocks, per_block), dtype=dtype)
-        for b in range(n_blocks):
-            s, e = bounds[b], bounds[b + 1]
-            m = e - s
-            idx[b, :m] = c_sorted[s:e]
-            val[b, :m] = v_sorted[s:e]
-            local = r_sorted[s:e] - b * per_block
-            seg[b, :m] = local
-            np.add.at(cnt[b], local, 1.0)
-        return idx, val, seg, cnt, per_block
-
-    u_item_idx, u_rating, u_seg, u_count, upb = one_side(
-        u_idx, i_idx, ratings, len(user_ids)
+    # slot orders first: each side's idx arrays point at the OPPOSITE side's
+    # slots, so both perms must exist before either fill
+    u_order = _side_order(u_idx, len(user_ids), n_blocks)
+    i_order = _side_order(i_idx, len(item_ids), n_blocks)
+    u_perm, i_perm = u_order[3], i_order[3]
+    u_side = _fill_side(
+        u_idx, i_idx, ratings, len(user_ids), n_blocks, u_order, i_perm, dtype
     )
-    i_user_idx, i_rating, i_seg, i_count, ipb = one_side(
-        i_idx, u_idx, ratings, len(item_ids)
+    i_side = _fill_side(
+        i_idx, u_idx, ratings, len(item_ids), n_blocks, i_order, u_perm, dtype
     )
     return BlockedProblem(
         n_blocks=n_blocks,
         user_ids=user_ids,
         item_ids=item_ids,
-        users_per_block=upb,
-        items_per_block=ipb,
         nnz=int(len(ratings)),
-        u_item_idx=u_item_idx,
-        u_rating=u_rating,
-        u_seg=u_seg,
-        u_count=u_count,
-        i_user_idx=i_user_idx,
-        i_rating=i_rating,
-        i_seg=i_seg,
-        i_count=i_count,
+        u=u_side,
+        i=i_side,
     )
-
-
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
 
 
 # ---------------------------------------------------------------------------
 # device-side kernel
 # ---------------------------------------------------------------------------
 
-def _assemble_normal_eqs(y_all, idx, rating, seg, n_seg, k, implicit, alpha, dtype):
-    """Accumulate A_u = Σ w·y yᵀ and b_u = Σ t·y over nnz entries in chunks.
+def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype):
+    """A_u = Σ w·y yᵀ and b_u = Σ t·y per slot, as batched MXU matmuls.
 
-    y_all:  (n_cols_pad, k) gathered opposite-side factors
-    idx:    (nnz_pad,) int32 column index per rating
-    rating: (nnz_pad,)
-    seg:    (nnz_pad,) local row index, padding rows point at segment n_seg
-    returns A (n_seg+1, k, k), b (n_seg+1, k) — caller slices off the pad seg.
+    y_all:   (n_slots_global, k) gathered opposite-side factor table
+    buckets: list of (idx, val, msk) with shapes (rows_j, w_j) — one entry
+             per degree bucket, rows covering contiguous slot ranges
+    returns A (per_block, k, k), b (per_block, k) in slot order.
 
-    Explicit:  w = 1,        t = r           (normal equations of LS)
-    Implicit:  w = alpha*r,  t = 1 + alpha*r (HKV; YtY added by caller)
+    Explicit:  w = msk,       t = r             (normal equations of LS)
+    Implicit:  w = alpha*r,   t = (1+alpha*r)·m (HKV; YtY added by caller)
+
+    Pad entries have val 0 / msk 0 and idx 0; the gathered row 0 factors are
+    real values, so every term is masked through w or t.
     """
-    nnz_pad = idx.shape[0]
-    n_chunks = _round_up(nnz_pad, _CHUNK) // _CHUNK
-    pad_to = n_chunks * _CHUNK
-    if pad_to != nnz_pad:
-        idx = jnp.pad(idx, (0, pad_to - nnz_pad))
-        rating = jnp.pad(rating, (0, pad_to - nnz_pad))
-        seg = jnp.pad(seg, (0, pad_to - nnz_pad), constant_values=n_seg)
-
-    idx_c = idx.reshape(n_chunks, _CHUNK)
-    rat_c = rating.reshape(n_chunks, _CHUNK)
-    seg_c = seg.reshape(n_chunks, _CHUNK)
-
-    def step(carry, xs):
-        A, b = carry
-        ci, cr, cs = xs
-        y = jnp.take(y_all, ci, axis=0)                      # (C, k)
+    As, bs = [], []
+    for idx, val, msk in buckets:
+        y = jnp.take(y_all, idx, axis=0)                     # (r_j, w_j, k)
         if implicit:
-            w = (alpha * cr).astype(dtype)
-            t = (1.0 + alpha * cr).astype(dtype)
+            w = (alpha * val).astype(dtype)
+            t = ((1.0 + alpha * val) * msk).astype(dtype)
         else:
-            w = jnp.ones_like(cr, dtype=dtype)
-            t = cr.astype(dtype)
-        yw = y * w[:, None]
-        outer = yw[:, :, None] * y[:, None, :]               # (C, k, k)
-        # per-block CSR is sorted by local row (prepare_blocked), and both
-        # chunking and padding preserve the order — let XLA use the cheaper
-        # sorted-scatter lowering
-        A = A + jax.ops.segment_sum(
-            outer, cs, num_segments=n_seg + 1, indices_are_sorted=True
+            w = msk.astype(dtype)
+            t = val.astype(dtype)
+        yw = y * w[..., None]
+        # contraction over the rating axis rides the MXU; HIGHEST keeps
+        # f32 products (bf16 single-pass shifts the normal equations
+        # enough to slow convergence at small lambda)
+        As.append(
+            jnp.einsum(
+                "rwk,rwl->rkl", yw, y, precision=jax.lax.Precision.HIGHEST
+            )
         )
-        b = b + jax.ops.segment_sum(
-            y * t[:, None], cs, num_segments=n_seg + 1, indices_are_sorted=True
+        bs.append(
+            jnp.einsum(
+                "rwk,rw->rk", y, t, precision=jax.lax.Precision.HIGHEST
+            )
         )
-        return (A, b), None
+    return jnp.concatenate(As, axis=0), jnp.concatenate(bs, axis=0)
 
-    A0 = jnp.zeros((n_seg + 1, k, k), dtype=dtype)
-    b0 = jnp.zeros((n_seg + 1, k), dtype=dtype)
-    (A, b), _ = jax.lax.scan(step, (A0, b0), (idx_c, rat_c, seg_c))
-    return A, b
+
+def _chol_solve_unrolled(A, b):
+    """Batched SPD solve by unrolled right-looking Cholesky + substitutions.
+
+    XLA's ``lax.linalg.cholesky``/``triangular_solve`` lower to a device
+    while-loop of dynamic slices that is latency-bound for large batches of
+    tiny matrices (measured ~35 ms for (20k, 16, 16) on v5e).  This variant
+    unrolls the k elimination steps as vectorized rank-1 downdates over the
+    whole batch — pure VPU elementwise work that XLA fuses.  k is small
+    (10-64 per the reference's numFactors surface) so the unroll is cheap
+    to compile.  A (n, k, k), b (n, k) -> x (n, k).
+    """
+    n, k = b.shape
+    M = A
+    cols = []  # cols[j][:, i] = L[:, i, j] (column j of L; rows < j zero)
+    upper = jnp.cumsum(jnp.eye(k, dtype=A.dtype), axis=0)  # lower-tri ones
+    for j in range(k):
+        d = jax.lax.rsqrt(M[:, j, j])
+        col = M[:, :, j] * d[:, None] * upper[:, j][None, :]
+        cols.append(col)
+        M = M - col[:, :, None] * col[:, None, :]
+    # forward solve L z = b, running accumulator acc = Σ_p cols[p]·z_p
+    acc = jnp.zeros_like(b)
+    zs = []
+    for j in range(k):
+        z = (b[:, j] - acc[:, j]) / cols[j][:, j]
+        zs.append(z)
+        acc = acc + cols[j] * z[:, None]
+    # back solve Lᵀ x = z; row j of L (= column j of Lᵀ) needs L as a matrix
+    Lmat = jnp.stack(cols, axis=-1)  # (n, k, k) lower-triangular
+    acc = jnp.zeros_like(b)
+    xs = [None] * k
+    for j in reversed(range(k)):
+        x = (zs[j] - acc[:, j]) / Lmat[:, j, j]
+        xs[j] = x
+        acc = acc + Lmat[:, j, :] * x[:, None]
+    return jnp.stack(xs, axis=-1)
+
+
+# solver selection: "unrolled" (default for k <= _UNROLL_MAX_K) or "lax";
+# override with FLINK_MS_ALS_SOLVER for benchmarking either path
+_UNROLL_MAX_K = 64
+
+
+def _solver_choice() -> str:
+    return os.environ.get("FLINK_MS_ALS_SOLVER", "auto")
+
+
+def _chol_solve(A, b):
+    k = A.shape[-1]
+    choice = _solver_choice()
+    if choice == "unrolled" or (choice == "auto" and k <= _UNROLL_MAX_K):
+        return _chol_solve_unrolled(A, b)
+    L = jax.lax.linalg.cholesky(A)
+    x = jax.lax.linalg.triangular_solve(
+        L, b[..., None], left_side=True, lower=True
+    )
+    return jax.lax.linalg.triangular_solve(
+        L, x, left_side=True, lower=True, transpose_a=True
+    )[..., 0]
 
 
 def _solve_factors(A, b, counts, lam, weighted_reg, dtype):
@@ -242,14 +393,21 @@ def _solve_factors(A, b, counts, lam, weighted_reg, dtype):
     # system so Cholesky stays PD, then zero the result
     diag = lam * reg + jnp.where(counts > 0, 0.0, 1.0)
     A = A + diag[:, None, None] * jnp.eye(k, dtype=dtype)
-    L = jax.lax.linalg.cholesky(A)
-    x = jax.lax.linalg.triangular_solve(
-        L, b[..., None], left_side=True, lower=True
-    )
-    x = jax.lax.linalg.triangular_solve(
-        L, x, left_side=True, lower=True, transpose_a=True
-    )[..., 0]
+    x = _chol_solve(A, b)
     return jnp.where((counts > 0)[:, None], x, 0.0)
+
+
+def _flat_side_args(side: SideLayout, dtype):
+    """Device-arg flattening of one side: bucket triples then the count."""
+    out = []
+    for j in range(len(side.widths)):
+        out += [
+            side.idx[j],
+            side.val[j].astype(dtype),
+            side.msk[j].astype(dtype),
+        ]
+    out.append(side.count.astype(dtype))
+    return out
 
 
 def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
@@ -262,29 +420,36 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     alpha = config.alpha
     weighted = config.weighted_reg and not implicit
     dtype = config.dtype
-    upb = problem.users_per_block
-    ipb = problem.items_per_block
+    n_u_buckets = len(problem.u.widths)
+    n_i_buckets = len(problem.i.widths)
 
-    def half_sweep(y_shard, idx, rating, seg, counts, n_seg):
-        # y_shard: (1, cols_pb, k) this device's shard of the opposite factors
+    def half_sweep(y_shard, flat):
+        # y_shard: (1, opp_pb, k) this device's shard of the opposite factors
+        *bucket_args, counts = flat
         y_all = jax.lax.all_gather(y_shard[0], BLOCK_AXIS, axis=0, tiled=True)
-        A, b = _assemble_normal_eqs(
-            y_all, idx[0], rating[0], seg[0], n_seg, k, implicit, alpha, dtype
-        )
-        A, b = A[:n_seg], b[:n_seg]
+        buckets = [
+            (bucket_args[3 * j][0], bucket_args[3 * j + 1][0],
+             bucket_args[3 * j + 2][0])
+            for j in range(len(bucket_args) // 3)
+        ]
+        A, b = _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype)
         if implicit:
             yty = jax.lax.psum(
                 jnp.einsum("nk,nm->km", y_shard[0], y_shard[0]), BLOCK_AXIS
             )
             A = A + yty[None, :, :]
         x = _solve_factors(A, b, counts[0], lam, weighted, dtype)
-        return x[None]  # (1, n_seg, k)
+        return x[None]  # (1, per_block, k)
 
-    def fit_body(iterations, uf, itf, ui, ur, us, uc, ii, ir, is_, ic):
+    n_u_args = 3 * n_u_buckets + 1
+
+    def fit_body(iterations, uf, itf, *flat):
+        u_flat, i_flat = flat[:n_u_args], flat[n_u_args:]
+
         def one_iter(_, carry):
             uf, itf = carry
-            uf = half_sweep(itf, ui, ur, us, uc, upb)
-            itf = half_sweep(uf, ii, ir, is_, ic, ipb)
+            uf = half_sweep(itf, u_flat)
+            itf = half_sweep(uf, i_flat)
             return uf, itf
 
         # dynamic trip count (lowers to while_loop): one compiled program
@@ -293,10 +458,14 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
 
     spec3 = P(BLOCK_AXIS, None, None)
     spec2 = P(BLOCK_AXIS, None)
+    flat_specs = (
+        (spec3,) * (3 * n_u_buckets) + (spec2,)
+        + (spec3,) * (3 * n_i_buckets) + (spec2,)
+    )
     sharded_fit = shard_map(
         fit_body,
         mesh=mesh,
-        in_specs=(P(),) + (spec3, spec3) + (spec2,) * 8,
+        in_specs=(P(), spec3, spec3) + flat_specs,
         out_specs=(spec3, spec3),
         check_vma=False,
     )
@@ -314,16 +483,19 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     key = (
         mesh,
         problem.n_blocks,
-        problem.users_per_block,
-        problem.items_per_block,
-        problem.u_item_idx.shape,
-        problem.i_user_idx.shape,
+        problem.u.per_block,
+        problem.i.per_block,
+        problem.u.widths,
+        problem.u.rows,
+        problem.i.widths,
+        problem.i.rows,
         config.num_factors,
         config.lambda_,
         config.implicit,
         config.alpha,
         config.weighted_reg,
         str(config.dtype),
+        _solver_choice(),  # env override is baked in at trace time
     )
     fn = _SWEEP_CACHE.pop(key, None)
     if fn is None:
@@ -355,10 +527,12 @@ def _staging_meta(problem: "BlockedProblem", config: "ALSConfig",
         h.update(np.ascontiguousarray(init[1]).tobytes())
         init_id = h.hexdigest()
     # the actual rating data matters too: same-shaped re-exports of fresh
-    # data must retrain, not resume (CSR arrays cover ids, values, layout)
+    # data must retrain, not resume (bucket arrays cover ids, values, layout)
     hd = hashlib.sha1()
-    for a in (problem.u_item_idx, problem.u_rating, problem.u_seg,
-              problem.user_ids, problem.item_ids):
+    for a in (
+        [problem.u.perm, problem.user_ids, problem.item_ids]
+        + problem.u.idx + problem.u.val
+    ):
         hd.update(np.ascontiguousarray(a).tobytes())
     return {
         "data": hd.hexdigest(),
@@ -460,17 +634,15 @@ def init_factors(n_pad: int, k: int, key, dtype) -> jnp.ndarray:
 
 def _pad_factors(problem: BlockedProblem, D: int, k: int, dtype,
                  uf_raw: np.ndarray, itf_raw: np.ndarray):
-    """Dense-id (n_users, k)/(n_items, k) factors -> block-shaped padded
-    device layout (D, per_block, k)."""
-    n_users_pad = problem.users_per_block * D
-    n_items_pad = problem.items_per_block * D
-    uf0 = np.zeros((n_users_pad, k), dtype=dtype)
-    uf0[: problem.n_users] = uf_raw
-    itf0 = np.zeros((n_items_pad, k), dtype=dtype)
-    itf0[: problem.n_items] = itf_raw
+    """Dense-id (n_users, k)/(n_items, k) factors -> block-shaped slot
+    layout (D, per_block, k); dummy slots stay zero."""
+    uf0 = np.zeros((problem.u.per_block * D, k), dtype=dtype)
+    uf0[problem.u.perm] = uf_raw
+    itf0 = np.zeros((problem.i.per_block * D, k), dtype=dtype)
+    itf0[problem.i.perm] = itf_raw
     return (
-        jnp.asarray(uf0).reshape(D, problem.users_per_block, k),
-        jnp.asarray(itf0).reshape(D, problem.items_per_block, k),
+        jnp.asarray(uf0).reshape(D, problem.u.per_block, k),
+        jnp.asarray(itf0).reshape(D, problem.i.per_block, k),
     )
 
 
@@ -489,41 +661,32 @@ def compile_fit(
     k = config.num_factors
     dtype = config.dtype
 
-    n_users_pad = problem.users_per_block * D
-    n_items_pad = problem.items_per_block * D
-    if init is not None:
-        uf0, itf0 = _pad_factors(problem, D, k, dtype, init[0], init[1])
-    else:
+    if init is None:
         key_u, key_i = jax.random.split(jax.random.PRNGKey(config.seed))
-        # zero the padding rows: implicit mode's psum'd Gramian (and any
-        # future dense reduction over the factor table) must not see them
-        row_u = jnp.arange(n_users_pad)[:, None] < problem.n_users
-        row_i = jnp.arange(n_items_pad)[:, None] < problem.n_items
-        uf0 = (init_factors(n_users_pad, k, key_u, dtype) * row_u).reshape(
-            D, problem.users_per_block, k
+        # draw in dense-id space (first n rows of the padded draw, keeping
+        # the draw shape stable for reproducibility) and place via perm —
+        # dummy slots stay zero so the implicit mode's psum'd Gramian (and
+        # any future dense reduction over the table) never sees them
+        init = (
+            np.asarray(init_factors(problem.u.per_block * D, k, key_u, dtype))[
+                : problem.n_users
+            ],
+            np.asarray(init_factors(problem.i.per_block * D, k, key_i, dtype))[
+                : problem.n_items
+            ],
         )
-        itf0 = (init_factors(n_items_pad, k, key_i, dtype) * row_i).reshape(
-            D, problem.items_per_block, k
-        )
+    uf0, itf0 = _pad_factors(problem, D, k, dtype, init[0], init[1])
 
     shard3 = block_sharding(mesh, rank=3)
     shard2 = block_sharding(mesh, rank=2)
-    dev_args = [
-        jax.device_put(uf0, shard3),
-        jax.device_put(itf0, shard3),
-    ] + [
-        jax.device_put(jnp.asarray(a), shard2)
-        for a in (
-            problem.u_item_idx,
-            problem.u_rating.astype(dtype),
-            problem.u_seg,
-            problem.u_count.astype(dtype),
-            problem.i_user_idx,
-            problem.i_rating.astype(dtype),
-            problem.i_seg,
-            problem.i_count.astype(dtype),
-        )
-    ]
+    dev_args = [jax.device_put(uf0, shard3), jax.device_put(itf0, shard3)]
+    for side in (problem.u, problem.i):
+        for a in _flat_side_args(side, dtype):
+            dev_args.append(
+                jax.device_put(
+                    jnp.asarray(a), shard2 if a.ndim == 2 else shard3
+                )
+            )
     return _cached_sweep(problem, config, mesh), dev_args
 
 
@@ -559,16 +722,16 @@ def als_fit(
     dtype = config.dtype
     shard3 = block_sharding(mesh, rank=3)
     fit_fn, dev_args = compile_fit(problem, config, mesh, init=init)
-    n_users_pad = problem.users_per_block * D
-    n_items_pad = problem.items_per_block * D
+    n_users_pad = problem.u.per_block * D
+    n_items_pad = problem.i.per_block * D
 
     def to_dense(uf_d, itf_d):
         # multi-process runs: factor shards live on remote hosts too, so
         # materialization is a cross-host allgather (plain copy locally)
         from ..parallel.distributed import to_host_array
 
-        u = to_host_array(uf_d).reshape(n_users_pad, k)[: problem.n_users]
-        i = to_host_array(itf_d).reshape(n_items_pad, k)[: problem.n_items]
+        u = to_host_array(uf_d).reshape(n_users_pad, k)[problem.u.perm]
+        i = to_host_array(itf_d).reshape(n_items_pad, k)[problem.i.perm]
         return u, i
 
     if temporary_path is None:

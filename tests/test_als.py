@@ -38,13 +38,24 @@ def _numpy_user_halfsweep(u, i, r, itf, k, lam, weighted):
 def test_prepare_blocked_layout(rng):
     u, i, r = _synthetic(rng)
     p = A.prepare_blocked(u, i, r, 4)
-    assert p.u_item_idx.shape[0] == 4
-    # every rating accounted for exactly once (counts sum to nnz)
-    assert int(p.u_count.sum()) == p.nnz == len(r)
-    assert int(p.i_count.sum()) == p.nnz
-    # padding segments point at the overflow row
-    pad_mask = p.u_seg == p.users_per_block
-    assert (p.u_rating[pad_mask] == 0).all()
+    assert all(a.shape[0] == 4 for a in p.u.idx)
+    # every rating accounted for exactly once (counts and masks sum to nnz)
+    assert int(p.u.count.sum()) == p.nnz == len(r)
+    assert int(p.i.count.sum()) == p.nnz
+    assert int(sum(m.sum() for m in p.u.msk)) == p.nnz
+    # pad entries carry zero rating and zero mask
+    for v, m in zip(p.u.val, p.u.msk):
+        assert (v[m == 0] == 0).all()
+    # perm is a bijection into the slot space and respects block membership
+    assert len(np.unique(p.u.perm)) == p.n_users
+    dense_pb = -(-p.n_users // 4)
+    np.testing.assert_array_equal(
+        p.u.perm // p.u.per_block, np.arange(p.n_users) // dense_pb
+    )
+    # every bucket row's entry count fits its width
+    for w, m in zip(p.u.widths, p.u.msk):
+        per_row = m.sum(axis=-1)
+        assert per_row.max() <= w
 
 
 def test_assembly_matches_numpy(rng):
@@ -52,24 +63,38 @@ def test_assembly_matches_numpy(rng):
     k = 4
     p = A.prepare_blocked(u, i, r, 1)
     itf = rng.normal(size=(9, k)).astype(np.float32)
-    y_all = np.zeros((p.items_per_block, k), dtype=np.float32)
-    y_all[:9] = itf
+    y_all = np.zeros((p.i.per_block, k), dtype=np.float32)
+    y_all[p.i.perm] = itf  # factor table lives in slot order
+    buckets = [
+        (jnp.asarray(p.u.idx[j][0]), jnp.asarray(p.u.val[j][0]),
+         jnp.asarray(p.u.msk[j][0]))
+        for j in range(len(p.u.widths))
+    ]
     Amat, b = A._assemble_normal_eqs(
-        jnp.asarray(y_all),
-        jnp.asarray(p.u_item_idx[0]),
-        jnp.asarray(p.u_rating[0]),
-        jnp.asarray(p.u_seg[0]),
-        p.users_per_block,
-        k,
-        False,
-        40.0,
-        jnp.float32,
+        jnp.asarray(y_all), buckets, False, 40.0, jnp.float32
     )
+    Amat, b = np.asarray(Amat), np.asarray(b)
     for uu in range(12):
         sel = u == uu
         Y = itf[i[sel]]
-        np.testing.assert_allclose(np.asarray(Amat)[uu], Y.T @ Y, rtol=1e-4)
-        np.testing.assert_allclose(np.asarray(b)[uu], Y.T @ r[sel], rtol=1e-4)
+        slot = p.u.perm[uu]
+        np.testing.assert_allclose(Amat[slot], Y.T @ Y, rtol=1e-4)
+        np.testing.assert_allclose(b[slot], Y.T @ r[sel], rtol=1e-4)
+
+
+@pytest.mark.parametrize("k", [3, 8, 16, 50])
+def test_chol_solve_unrolled_matches_numpy(rng, k):
+    n = 257
+    G = rng.standard_normal((n, k, k)).astype(np.float32)
+    A_ = G @ G.transpose(0, 2, 1) + 5.0 * np.eye(k, dtype=np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    x = np.asarray(
+        jax.jit(A._chol_solve_unrolled)(jnp.asarray(A_), jnp.asarray(b))
+    )
+    x_ref = np.linalg.solve(
+        A_.astype(np.float64), b.astype(np.float64)[..., None]
+    )[..., 0]
+    np.testing.assert_allclose(x, x_ref, rtol=2e-3, atol=2e-4)
 
 
 @pytest.mark.parametrize("weighted", [True, False])
