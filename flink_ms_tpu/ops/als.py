@@ -67,6 +67,10 @@ class ALSConfig:
     alpha: float = 40.0          # implicit confidence scale, c = 1 + alpha*r
     weighted_reg: bool = True    # ALS-WR: lambda * n_u (FlinkML semantics)
     dtype: jnp.dtype = jnp.float32
+    # MXU pass count for the assembly einsums: "highest" = full-f32 products
+    # (6-pass bf16), "high" = 3-pass, "default" = single-pass bf16 (fastest,
+    # shifts the normal equations ~1e-3 relative) — benchmark knob
+    assembly_precision: str = "highest"
 
 
 _MIN_BUCKET_W = 8  # smallest rating-list pad width (sublane-friendly)
@@ -284,7 +288,8 @@ def prepare_blocked(
 # device-side kernel
 # ---------------------------------------------------------------------------
 
-def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype):
+def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
+                         precision="highest"):
     """A_u = Σ w·y yᵀ and b_u = Σ t·y per slot, as batched MXU matmuls.
 
     y_all:   (n_slots_global, k) gathered opposite-side factor table
@@ -311,16 +316,8 @@ def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype):
         # contraction over the rating axis rides the MXU; HIGHEST keeps
         # f32 products (bf16 single-pass shifts the normal equations
         # enough to slow convergence at small lambda)
-        As.append(
-            jnp.einsum(
-                "rwk,rwl->rkl", yw, y, precision=jax.lax.Precision.HIGHEST
-            )
-        )
-        bs.append(
-            jnp.einsum(
-                "rwk,rw->rk", y, t, precision=jax.lax.Precision.HIGHEST
-            )
-        )
+        As.append(jnp.einsum("rwk,rwl->rkl", yw, y, precision=precision))
+        bs.append(jnp.einsum("rwk,rw->rk", y, t, precision=precision))
     return jnp.concatenate(As, axis=0), jnp.concatenate(bs, axis=0)
 
 
@@ -432,7 +429,10 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
              bucket_args[3 * j + 2][0])
             for j in range(len(bucket_args) // 3)
         ]
-        A, b = _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype)
+        A, b = _assemble_normal_eqs(
+            y_all, buckets, implicit, alpha, dtype,
+            precision=config.assembly_precision,
+        )
         if implicit:
             yty = jax.lax.psum(
                 jnp.einsum("nk,nm->km", y_shard[0], y_shard[0]), BLOCK_AXIS
@@ -495,6 +495,7 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         config.alpha,
         config.weighted_reg,
         str(config.dtype),
+        config.assembly_precision,
         _solver_choice(),  # env override is baked in at trace time
     )
     fn = _SWEEP_CACHE.pop(key, None)
@@ -541,6 +542,7 @@ def _staging_meta(problem: "BlockedProblem", config: "ALSConfig",
         "implicit": config.implicit,
         "alpha": config.alpha,
         "weighted_reg": config.weighted_reg,
+        "assembly_precision": config.assembly_precision,
         "seed": config.seed,
         "dtype": str(np.dtype(config.dtype)),
         "init": init_id,

@@ -99,7 +99,15 @@ def mesh_for_blocks(
         return make_mesh(n_devices)
     if jax.process_count() > 1 or blocks is None:
         return make_mesh()
-    return make_mesh(min(blocks, len(jax.devices())))
+    avail = len(jax.devices())
+    if blocks > avail:
+        print(
+            f"[mesh] --blocks {blocks} exceeds the {avail} visible "
+            f"device(s); running the logical blocks on {avail} device "
+            "block(s) (SVM stacks chains per device; ALS partitioning is "
+            "row-exact)"
+        )
+    return make_mesh(min(blocks, avail))
 
 
 def block_sharding(mesh: Mesh, *, rank: int = 2) -> NamedSharding:

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""ALS kernel microbenchmark: assembly + solve variants on the current
+backend.
+
+Times one full compiled sweep (steady-state, hard-sync barrier) across the
+solver (unrolled vs lax) and assembly-precision (highest/high/default)
+axes, at a configurable scale.  Used to pick kernel defaults on real
+hardware; safe to run on CPU for smoke.
+
+  python scripts/als_microbench.py [--small] [--nnz N] [--rank K]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--nnz", type=int, default=None)
+    ap.add_argument("--users", type=int, default=None)
+    ap.add_argument("--items", type=int, default=None)
+    ap.add_argument("--rank", type=int, default=None)
+    args = ap.parse_args()
+
+    small = args.small
+    nnz = args.nnz or (500_000 if small else 20_000_000)
+    n_users = args.users or (20_000 if small else 138_493)
+    n_items = args.items or (2_000 if small else 26_744)
+    rank = args.rank or (16 if small else 50)
+
+    from flink_ms_tpu.parallel.mesh import honor_platform_env
+
+    honor_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ms_tpu.ops import als as A
+    from flink_ms_tpu.parallel.mesh import make_mesh
+    from flink_ms_tpu.utils.profiling import hard_sync
+
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform != "cpu"] or devs
+    mesh = make_mesh(devices=accel[:1])
+    print(f"backend: {accel[0].platform} ({getattr(accel[0], 'device_kind', '?')})")
+
+    rng = np.random.default_rng(0)
+    users = rng.integers(0, n_users, nnz)
+    items = rng.integers(0, n_items, nnz)
+    ratings = rng.uniform(1.0, 5.0, nnz)
+    t0 = time.time()
+    problem = A.prepare_blocked(users, items, ratings, 1)
+    print(f"prepare_blocked: {time.time() - t0:.1f}s  "
+          f"(u widths={problem.u.widths}, i widths={problem.i.widths})")
+
+    # dev_args depend only on (problem, dtype): upload once, reuse across
+    # all solver/precision variants (only the compiled sweep differs)
+    base_cfg = A.ALSConfig(num_factors=rank, iterations=1, lambda_=0.1)
+    _, dev_args = A.compile_fit(problem, base_cfg, mesh)
+
+    def steady(cfg):
+        fit_fn = A._cached_sweep(problem, cfg, mesh)
+
+        def run(trip):
+            t = time.time()
+            uf, _ = fit_fn(jnp.asarray(trip, jnp.int32), *dev_args)
+            hard_sync(uf)
+            return time.time() - t
+
+        run(1), run(4)  # compile + warmup
+        iters = 4
+        while run(iters) < 0.5 and iters < 20_000:
+            iters *= 4
+        samples = sorted(
+            max((run(iters) - run(1)) / (iters - 1), 1e-9) for _ in range(3)
+        )
+        return samples[1]
+
+    for solver in ("unrolled", "lax"):
+        os.environ["FLINK_MS_ALS_SOLVER"] = solver
+        for precision in ("highest", "high", "default"):
+            cfg = A.ALSConfig(
+                num_factors=rank, iterations=1, lambda_=0.1,
+                assembly_precision=precision,
+            )
+            spi = steady(cfg)
+            flops = 2 * nnz * (2 * rank * rank + 2 * rank) + (
+                n_users + n_items
+            ) * (rank ** 3 / 3 + 4 * rank * rank)
+            print(
+                f"solver={solver:8s} precision={precision:8s}: "
+                f"{spi * 1e3:9.2f} ms/iter  "
+                f"({flops / spi / 1e12:6.2f} TFLOP/s analytic)"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -129,6 +129,22 @@ def test_multiblock_equals_singleblock(rng):
     )
 
 
+def test_blocks_exceed_devices_runs_and_converges(rng):
+    """--blocks > devices (legal in the reference: more blocks than slots,
+    ALSImpl.scala:39-41): for ALS the solve is row-exact, so the logical
+    block count is a parallelism hint only — mesh_for_blocks spans all
+    devices and training must run and converge."""
+    from flink_ms_tpu.parallel.mesh import mesh_for_blocks
+
+    u, i, r = _synthetic(rng, n_users=50, n_items=37)
+    mesh16 = mesh_for_blocks(16)  # 16 logical blocks on the 8-device mesh
+    assert mesh16.devices.size == 8
+    cfg = A.ALSConfig(num_factors=5, iterations=6, lambda_=1e-3,
+                      weighted_reg=False)
+    model = A.als_fit(u, i, r, cfg, mesh16)
+    assert A.rmse(model, u, i, r) < 0.05
+
+
 def test_recovers_low_rank_matrix(rng):
     u, i, r = _synthetic(rng, n_users=60, n_items=45, k_true=3, frac=0.5)
     cfg = A.ALSConfig(num_factors=6, iterations=12, lambda_=1e-3, weighted_reg=False)
