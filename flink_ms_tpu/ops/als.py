@@ -598,6 +598,10 @@ def load_staged(path: str, meta: dict, max_iteration: Optional[int] = None):
         try:
             with np.load(os.path.join(path, name)) as z:
                 saved = json.loads(bytes(z["meta"]).decode())
+                # snapshots written before the assembly_precision field
+                # existed were produced with hard-coded HIGHEST — backfill
+                # so they keep resuming
+                saved.setdefault("assembly_precision", "highest")
                 if saved != meta:
                     continue
                 return iteration, z["user_factors"], z["item_factors"]

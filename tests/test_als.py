@@ -129,6 +129,40 @@ def test_multiblock_equals_singleblock(rng):
     )
 
 
+def test_skewed_degrees_match_numpy(rng):
+    """Power-law degree distribution (one super-popular item, many
+    degree-1 users — the ML-20M shape) must bucket correctly: one
+    iteration still matches the per-row normal-equation spec."""
+    n_users, n_items, k, lam = 60, 10, 3, 0.2
+    # item 0 is in every user's list; other items are rare; several users
+    # rate exactly one item (narrowest bucket, heavy pad)
+    u_list, i_list = [], []
+    for uu in range(n_users):
+        u_list.append(uu)
+        i_list.append(0)
+        if uu % 3 == 0:  # two-thirds of users are degree-1
+            for extra in range(1 + uu % 7):
+                u_list.append(uu)
+                i_list.append(1 + (uu + extra) % (n_items - 1))
+    u = np.array(u_list)
+    i = np.array(i_list)
+    r = rng.uniform(1, 5, len(u))
+    uf0 = rng.normal(size=(n_users, k)).astype(np.float32)
+    itf0 = rng.normal(size=(n_items, k)).astype(np.float32)
+    for blocks in (1, 4):
+        cfg = A.ALSConfig(num_factors=k, iterations=1, lambda_=lam,
+                          weighted_reg=True)
+        model = A.als_fit(u, i, r, cfg, make_mesh(blocks), init=(uf0, itf0))
+        uf_expect = _numpy_user_halfsweep(u, i, r, itf0, k, lam, True)
+        np.testing.assert_allclose(
+            model.user_factors, uf_expect, rtol=2e-3, atol=2e-4
+        )
+        itf_expect = _numpy_user_halfsweep(i, u, r, uf_expect, k, lam, True)
+        np.testing.assert_allclose(
+            model.item_factors, itf_expect, rtol=2e-3, atol=2e-4
+        )
+
+
 def test_blocks_exceed_devices_runs_and_converges(rng):
     """--blocks > devices (legal in the reference: more blocks than slots,
     ALSImpl.scala:39-41): for ALS the solve is row-exact, so the logical
