@@ -49,6 +49,16 @@ class Journal:
                     os.fsync(f.fileno())
                 return f.tell()
 
+    def sync(self) -> None:
+        """fsync the topic file without writing — the checkpoint-boundary
+        flush for producers appending with ``flush=False``."""
+        with self._lock:
+            try:
+                with open(self.path, "a") as f:
+                    os.fsync(f.fileno())
+            except FileNotFoundError:
+                pass
+
     # -- consumer side -----------------------------------------------------
 
     def end_offset(self) -> int:
