@@ -301,6 +301,49 @@ def run_serving_section(small: bool) -> dict:
         except Exception:
             _log(traceback.format_exc())
             out["sgd_error"] = traceback.format_exc(limit=3)
+
+        # 7. native data plane: same journal through the C++ persistent
+        # store + epoll lookup server (the reference's RocksDB + Netty
+        # KvState analog).  Error-isolated: native toolchain problems
+        # record native_error without costing the section.
+        njob = None
+        try:
+            from flink_ms_tpu.serve.consumer import make_backend
+
+            backend = make_backend("rocksdb", os.path.join(tmp, "chk_native"))
+            njob = ServingJob(
+                journal, ALS_STATE, parse_als_record, backend,
+                host="127.0.0.1", port=0, poll_interval_s=0.01,
+                native_server=True,
+            ).start()
+            # full-ingest barrier (like section 3): percentiles against a
+            # partially-loaded store would mix cheap misses into the numbers
+            deadline = time.time() + 600
+            while len(njob.table) < total_rows and time.time() < deadline:
+                time.sleep(0.1)
+            if len(njob.table) < total_rows:
+                raise RuntimeError(
+                    f"native ingest stalled: {len(njob.table)}/{total_rows}"
+                )
+            rng = np.random.default_rng(3)
+            with QueryClient("127.0.0.1", njob.port, timeout_s=60) as c:
+                nat = []
+                for _ in range(n_get):
+                    u = int(rng.integers(1, n_users + 1))
+                    i = int(rng.integers(1, n_items + 1))
+                    t0 = time.perf_counter()
+                    c.query_states(ALS_STATE, [f"{u}-U", f"{i}-I"])
+                    nat.append((time.perf_counter() - t0) * 1000.0)
+            out.update(
+                {f"serving_native_mget_{q}_ms": v for q, v in _pcts(nat).items()}
+            )
+            _log(f"[bench:serve] native MGET {_pcts(nat)} ms")
+        except Exception:
+            _log(traceback.format_exc())
+            out["native_error"] = traceback.format_exc(limit=3)
+        finally:
+            if njob is not None:
+                njob.stop()
         return out
     finally:
         if job is not None:
