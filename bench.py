@@ -279,7 +279,9 @@ def run_als_section(devices, platform, small: bool) -> dict:
 
 def main() -> None:
     small = os.environ.get("BENCH_SMALL") == "1"
-    sections = os.environ.get("BENCH_SECTIONS", "als,svm,serving").split(",")
+    sections = os.environ.get(
+        "BENCH_SECTIONS", "als,svm,serving,svmserve"
+    ).split(",")
     result: dict = {}
 
     from flink_ms_tpu.parallel.mesh import honor_platform_env
@@ -309,29 +311,28 @@ def main() -> None:
         _log(traceback.format_exc())
         result["als_error"] = traceback.format_exc(limit=3)
 
-    if "svm" in sections:
+    # every extra section degrades independently: a failure records its
+    # <name>_error key without costing the others their metrics
+    extra = (
+        ("svm", "run_svm_section", lambda f: f(devices, platform, small)),
+        ("serving", "run_serving_section", lambda f: f(small)),
+        ("svmserve", "run_svm_serving_section", lambda f: f(small)),
+    )
+    for name, fn_name, call in extra:
+        if name not in sections:
+            continue
         try:
-            from bench_sections import run_svm_section
-        except ImportError:
-            result["svm_error"] = "bench_sections module not available"
-        else:
-            try:
-                result.update(run_svm_section(devices, platform, small))
-            except Exception:
-                _log(traceback.format_exc())
-                result["svm_error"] = traceback.format_exc(limit=3)
+            import bench_sections
 
-    if "serving" in sections:
+            fn = getattr(bench_sections, fn_name)
+        except (ImportError, AttributeError):
+            result[f"{name}_error"] = "bench_sections module not available"
+            continue
         try:
-            from bench_sections import run_serving_section
-        except ImportError:
-            result["serving_error"] = "bench_sections module not available"
-        else:
-            try:
-                result.update(run_serving_section(small))
-            except Exception:
-                _log(traceback.format_exc())
-                result["serving_error"] = traceback.format_exc(limit=3)
+            result.update(call(fn))
+        except Exception:
+            _log(traceback.format_exc())
+            result[f"{name}_error"] = traceback.format_exc(limit=3)
 
     if "metric" not in result:
         # headline section failed: still emit a valid, loud artifact

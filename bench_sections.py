@@ -133,6 +133,135 @@ def run_svm_section(devices, platform, small: bool) -> dict:
     }
 
 
+def _wait_for_ingest(job, expected: int, what: str, timeout_s: float = 600) -> None:
+    """Block until the job's table holds ``expected`` keys; loud on stall so
+    a latency section never silently measures a partially-loaded store."""
+    deadline = time.time() + timeout_s
+    while len(job.table) < expected and time.time() < deadline:
+        time.sleep(0.1)
+    if len(job.table) < expected:
+        raise RuntimeError(
+            f"{what} ingest stalled: {len(job.table)}/{expected} rows"
+        )
+
+
+# ---------------------------------------------------------------------------
+# SVM serving section: flat (query-per-feature) and range-partitioned
+# (query-per-bucket) lookup shapes — the reference's SVMPredictRandom and
+# RangePartitionSVMPredict harnesses (BASELINE.md rows 2-3)
+# ---------------------------------------------------------------------------
+
+def run_svm_serving_section(small: bool) -> dict:
+    from flink_ms_tpu.core.params import Params
+    from flink_ms_tpu.gen import svm_model_generator
+    from flink_ms_tpu.serve import producer
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import (
+        SVM_STATE,
+        MemoryStateBackend,
+        ServingJob,
+        parse_svm_record,
+    )
+    from flink_ms_tpu.serve.journal import Journal
+
+    n_feat = int(os.environ.get("BENCH_SVMSERVE_FEATURES",
+                                2_000 if small else 47_236))
+    range_ = int(os.environ.get("BENCH_SVMSERVE_RANGE", 100 if small else 1_000))
+    n_q = int(os.environ.get("BENCH_SVMSERVE_QUERIES", 100 if small else 1_000))
+    q_nnz = int(os.environ.get("BENCH_SVMSERVE_NNZ", 20 if small else 70))
+
+    tmp = tempfile.mkdtemp(prefix="bench_svmserve_")
+    out = {}
+    jobs = []
+    try:
+        # range-partitioned model rows via the generator (reference shape:
+        # "bucket,idx:w;..."), flat rows derived from them so both planes
+        # serve the same weights
+        svm_model_generator.run(Params.from_dict({
+            "numFeatures": n_feat, "range": range_,
+            "output": os.path.join(tmp, "model"), "parallelism": 1,
+        }))
+        producer.run(Params.from_dict({
+            "journalDir": os.path.join(tmp, "bus"), "topic": "svm-range",
+            "input": os.path.join(tmp, "model"),
+        }), label="SVM")
+        flat_rows = []
+        model_buckets = set()
+        from flink_ms_tpu.core.formats import parse_svm_range_row
+
+        with open(os.path.join(tmp, "model")) as f:  # parallelism=1: one file
+            for line in f:
+                if not line.strip():
+                    continue
+                bucket, pairs = parse_svm_range_row(line.strip())
+                model_buckets.add(bucket)
+                flat_rows += [f"{idx},{w!r}" for idx, w in pairs]
+        flat_journal = Journal(os.path.join(tmp, "bus"), "svm-flat")
+        flat_journal.append(flat_rows, flush=False)
+        flat_journal.sync()
+
+        range_journal = Journal(os.path.join(tmp, "bus"), "svm-range")
+        rjob = ServingJob(
+            range_journal, SVM_STATE, parse_svm_record, MemoryStateBackend(),
+            host="127.0.0.1", port=0, poll_interval_s=0.01,
+        ).start()
+        jobs.append(rjob)
+        fjob = ServingJob(
+            flat_journal, SVM_STATE, parse_svm_record, MemoryStateBackend(),
+            host="127.0.0.1", port=0, poll_interval_s=0.01,
+        ).start()
+        jobs.append(fjob)
+        n_buckets = len(model_buckets)  # generator emits n_feat//range + 1
+        _wait_for_ingest(rjob, n_buckets, "svm range-plane")
+        _wait_for_ingest(fjob, len(flat_rows), "svm flat-plane")
+
+        rng = np.random.default_rng(11)
+        queries = [
+            np.unique(rng.integers(1, n_feat + 1, q_nnz))
+            for _ in range(n_q)
+        ]
+        # flat plane: one GET per feature (SVMPredictRandom.java:68-81)
+        ms = []
+        with QueryClient("127.0.0.1", fjob.port, timeout_s=60) as c:
+            for feats in queries:
+                t0 = time.perf_counter()
+                acc = 0.0
+                for fid in feats:
+                    payload = c.query_state(SVM_STATE, str(fid))
+                    if payload is not None:
+                        acc += float(payload)
+                ms.append((time.perf_counter() - t0) * 1000.0)
+        out.update({f"svmserve_flat_{q}_ms": v for q, v in _pcts(ms).items()})
+        # range plane: one GET per bucket + payload parse
+        # (RangePartitionSVMPredict.java:60-101)
+        ms_r = []
+        with QueryClient("127.0.0.1", rjob.port, timeout_s=60) as c:
+            for feats in queries:
+                t0 = time.perf_counter()
+                acc = 0.0
+                needed = {}
+                for fid in feats:
+                    needed.setdefault(int(fid) // range_, []).append(int(fid))
+                for bucket, fids in needed.items():
+                    payload = c.query_state(SVM_STATE, str(bucket))
+                    if payload is None:
+                        continue
+                    weights = dict(parse_svm_range_row(f"{bucket},{payload}")[1])
+                    for fid in fids:
+                        acc += weights.get(fid, 0.0)
+                ms_r.append((time.perf_counter() - t0) * 1000.0)
+        out.update({f"svmserve_range_{q}_ms": v for q, v in _pcts(ms_r).items()})
+        out["svmserve_features"] = n_feat
+        out["svmserve_buckets"] = n_buckets
+        _log(f"[bench:svmserve] flat {_pcts(ms)} ms, range {_pcts(ms_r)} ms "
+             f"({n_feat} features, {n_buckets} buckets, {q_nnz} nnz/query)")
+        return out
+    finally:
+        for job in jobs:
+            job.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # serving section: generator -> producer -> consumer -> latency harnesses
 # ---------------------------------------------------------------------------
@@ -188,13 +317,7 @@ def run_serving_section(small: bool) -> dict:
             host="127.0.0.1", port=0, poll_interval_s=0.01,
         ).start()
         t0 = time.time()
-        deadline = time.time() + 600
-        while len(job.table) < total_rows and time.time() < deadline:
-            time.sleep(0.1)
-        if len(job.table) < total_rows:
-            raise RuntimeError(
-                f"ingest stalled: {len(job.table)}/{total_rows} rows"
-            )
+        _wait_for_ingest(job, total_rows, "serving")
         out["ingest_rows_per_sec"] = round(total_rows / (time.time() - t0))
         _log(f"[bench:serve] ingested {total_rows} rows in "
              f"{time.time() - t0:.1f}s")
@@ -307,6 +430,7 @@ def run_serving_section(small: bool) -> dict:
         # KvState analog).  Error-isolated: native toolchain problems
         # record native_error without costing the section.
         njob = None
+        backend = None
         try:
             from flink_ms_tpu.serve.consumer import make_backend
 
@@ -316,15 +440,9 @@ def run_serving_section(small: bool) -> dict:
                 host="127.0.0.1", port=0, poll_interval_s=0.01,
                 native_server=True,
             ).start()
-            # full-ingest barrier (like section 3): percentiles against a
-            # partially-loaded store would mix cheap misses into the numbers
-            deadline = time.time() + 600
-            while len(njob.table) < total_rows and time.time() < deadline:
-                time.sleep(0.1)
-            if len(njob.table) < total_rows:
-                raise RuntimeError(
-                    f"native ingest stalled: {len(njob.table)}/{total_rows}"
-                )
+            # full-ingest barrier: percentiles against a partially-loaded
+            # store would mix cheap misses into the numbers
+            _wait_for_ingest(njob, total_rows, "native serving")
             rng = np.random.default_rng(3)
             with QueryClient("127.0.0.1", njob.port, timeout_s=60) as c:
                 nat = []
@@ -344,6 +462,12 @@ def run_serving_section(small: bool) -> dict:
         finally:
             if njob is not None:
                 njob.stop()
+            elif backend is not None:
+                # job never started: release the store handle + flock before
+                # the tmp dir is removed
+                store = getattr(backend, "store", None)
+                if store is not None:
+                    store.close()
         return out
     finally:
         if job is not None:
