@@ -323,10 +323,12 @@ def main() -> None:
             continue
         try:
             import bench_sections
-
-            fn = getattr(bench_sections, fn_name)
-        except (ImportError, AttributeError):
+        except ImportError:
             result[f"{name}_error"] = "bench_sections module not available"
+            continue
+        fn = getattr(bench_sections, fn_name, None)
+        if fn is None:
+            result[f"{name}_error"] = f"bench_sections.{fn_name} missing"
             continue
         try:
             result.update(call(fn))

@@ -133,15 +133,22 @@ def run_svm_section(devices, platform, small: bool) -> dict:
     }
 
 
-def _wait_for_ingest(job, expected: int, what: str, timeout_s: float = 600) -> None:
-    """Block until the job's table holds ``expected`` keys; loud on stall so
-    a latency section never silently measures a partially-loaded store."""
+def _wait_for_ingest(jobs, expected: int, what: str, timeout_s: float = 600) -> None:
+    """Block until the jobs' tables hold ``expected`` keys combined; loud on
+    stall so a latency section never measures a partially-loaded store.
+    ``jobs`` is one ServingJob or a list (sharded: disjoint key slices)."""
+    if not isinstance(jobs, (list, tuple)):
+        jobs = [jobs]
+
+    def count():
+        return sum(len(j.table) for j in jobs)
+
     deadline = time.time() + timeout_s
-    while len(job.table) < expected and time.time() < deadline:
+    while count() < expected and time.time() < deadline:
         time.sleep(0.1)
-    if len(job.table) < expected:
+    if count() < expected:
         raise RuntimeError(
-            f"{what} ingest stalled: {len(job.table)}/{expected} rows"
+            f"{what} ingest stalled: {count()}/{expected} rows"
         )
 
 
@@ -468,6 +475,59 @@ def run_serving_section(small: bool) -> dict:
                 store = getattr(backend, "store", None)
                 if store is not None:
                     store.close()
+
+        # 8. sharded plane (ALSKafkaConsumer.java:85-92 scale-out): W
+        # workers each own a hash slice of the same journal; the client
+        # routes MGET to owners and fans TOPK out with a score merge
+        sjobs = []
+        try:
+            from flink_ms_tpu.serve.sharded import (
+                ShardedQueryClient,
+                run_worker,
+            )
+
+            W = int(os.environ.get("BENCH_SHARD_WORKERS", 3))
+            for widx in range(W):
+                sjobs.append(run_worker(Params.from_dict({
+                    "workerIndex": widx, "numWorkers": W,
+                    "journalDir": os.path.join(tmp, "bus"),
+                    "topic": "als-models", "stateBackend": "memory",
+                    "host": "127.0.0.1", "port": 0,
+                })))
+            _wait_for_ingest(sjobs, total_rows, "sharded serving")
+            rng = np.random.default_rng(5)
+            sh = []
+            with ShardedQueryClient(
+                [("127.0.0.1", j.port) for j in sjobs], timeout_s=60
+            ) as c:
+                for _ in range(n_get):
+                    u = int(rng.integers(1, n_users + 1))
+                    i = int(rng.integers(1, n_items + 1))
+                    t0 = time.perf_counter()
+                    c.query_states(ALS_STATE, [f"{u}-U", f"{i}-I"])
+                    sh.append((time.perf_counter() - t0) * 1000.0)
+                tk = []
+                c.topk(ALS_STATE, "1", topk_k)  # index build per worker
+                for _ in range(max(n_topk // 2, 5)):
+                    uid = int(rng.integers(1, n_users + 1))
+                    t0 = time.perf_counter()
+                    c.topk(ALS_STATE, str(uid), topk_k)
+                    tk.append((time.perf_counter() - t0) * 1000.0)
+            out.update(
+                {f"serving_shard_mget_{q}_ms": v for q, v in _pcts(sh).items()}
+            )
+            out.update(
+                {f"serving_shard_topk_{q}_ms": v for q, v in _pcts(tk).items()}
+            )
+            out["serving_shard_workers"] = W
+            _log(f"[bench:serve] sharded({W}) MGET {_pcts(sh)} ms, "
+                 f"TOPK {_pcts(tk)} ms")
+        except Exception:
+            _log(traceback.format_exc())
+            out["shard_error"] = traceback.format_exc(limit=3)
+        finally:
+            for j in sjobs:
+                j.stop()
         return out
     finally:
         if job is not None:

@@ -88,10 +88,17 @@ class ShardedQueryClient:
     ):
         if not endpoints:
             raise ValueError("need at least one endpoint")
+        from concurrent.futures import ThreadPoolExecutor
+
         self._clients = [
             QueryClient(host, port, timeout_s=timeout_s, job_id=job_id)
             for host, port in endpoints
         ]
+        # persistent pool: spinning an executor up per query costs more
+        # than the fan-out round trips it parallelizes.  One slot per
+        # worker; per-worker QueryClients are each used by at most one
+        # in-flight future at a time (futures are joined before return).
+        self._pool = ThreadPoolExecutor(max_workers=len(self._clients))
 
     @property
     def num_workers(self) -> int:
@@ -118,19 +125,22 @@ class ShardedQueryClient:
                     name, [keys[p] for p in positions])):
                 out[p] = v
             return out
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import wait as _futures_wait
 
-        with ThreadPoolExecutor(max_workers=len(by_owner)) as pool:
-            futures = {
-                w: pool.submit(
-                    self._clients[w].query_states,
-                    name, [keys[p] for p in positions],
-                )
-                for w, positions in by_owner.items()
-            }
-            for w, positions in by_owner.items():
-                for p, v in zip(positions, futures[w].result()):
-                    out[p] = v
+        futures = {
+            w: self._pool.submit(
+                self._clients[w].query_states,
+                name, [keys[p] for p in positions],
+            )
+            for w, positions in by_owner.items()
+        }
+        # join EVERY future before propagating any failure: an orphaned
+        # in-flight future would race the next query on its worker's
+        # lock-free QueryClient socket and cross-wire replies
+        _futures_wait(list(futures.values()))
+        for w, positions in by_owner.items():
+            for p, v in zip(positions, futures[w].result()):
+                out[p] = v
         return out
 
     def topk(self, name: str, user_id: str, k: int):
@@ -140,15 +150,16 @@ class ShardedQueryClient:
         user_payload = self.query_state(name, f"{user_id}-U")
         if user_payload is None:
             return None
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import wait as _futures_wait
 
+        futs = [
+            self._pool.submit(c.topk_by_vector, name, user_payload, k)
+            for c in self._clients
+        ]
+        _futures_wait(futs)  # join all before any result() can raise
         merged: List[Tuple[str, float]] = []
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            for part in pool.map(
-                lambda c: c.topk_by_vector(name, user_payload, k),
-                self._clients,
-            ):
-                merged.extend(part)
+        for f in futs:
+            merged.extend(f.result())
         merged.sort(key=lambda it: -it[1])
         return merged[:k]
 
@@ -156,6 +167,9 @@ class ShardedQueryClient:
         return [c.ping() for c in self._clients]
 
     def close(self) -> None:
+        # every query path joins its futures before returning, so nothing
+        # is in flight here; wait=True keeps that invariant explicit
+        self._pool.shutdown(wait=True)
         for c in self._clients:
             c.close()
 
