@@ -133,6 +133,22 @@ def run_svm_section(devices, platform, small: bool) -> dict:
     }
 
 
+def _write_ratings_tsv(path: str, n: int, n_users: int, n_items: int,
+                       seed: int, header: bool = False) -> None:
+    """Random user\\titem\\trating rows within the served id ranges — shared
+    by the SGD-throughput and live-MSE steps."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        if header:
+            f.write("userId\titemId\trating\n")
+        for _ in range(n):
+            f.write(
+                f"{rng.integers(1, n_users + 1)}\t"
+                f"{rng.integers(1, n_items + 1)}\t"
+                f"{rng.uniform(1, 5):.3f}\n"
+            )
+
+
 def _wait_for_ingest(jobs, expected: int, what: str, timeout_s: float = 600) -> None:
     """Block until the jobs' tables hold ``expected`` keys combined; loud on
     stall so a latency section never measures a partially-loaded store.
@@ -402,15 +418,8 @@ def run_serving_section(small: bool) -> dict:
             n_sgd = int(
                 os.environ.get("BENCH_SGD_RATINGS", 500 if small else 5_000)
             )
-            rng = np.random.default_rng(7)
             ratings_path = os.path.join(tmp, "sgd_ratings.tsv")
-            with open(ratings_path, "w") as f:
-                for _ in range(n_sgd):
-                    f.write(
-                        f"{rng.integers(1, n_users + 1)}\t"
-                        f"{rng.integers(1, n_items + 1)}\t"
-                        f"{rng.uniform(1, 5):.3f}\n"
-                    )
+            _write_ratings_tsv(ratings_path, n_sgd, n_users, n_items, seed=7)
             mean_payload = ";".join(["0.1"] * k)
             t0 = time.time()
             processed = online_sgd.run(Params.from_dict({
@@ -431,6 +440,34 @@ def run_serving_section(small: bool) -> dict:
         except Exception:
             _log(traceback.format_exc())
             out["sgd_error"] = traceback.format_exc(limit=3)
+
+        # 6b. live MSE evaluation rate (MSE.java:52-69 parity: batch job
+        # scoring ratings against the LIVE served model, one user-group
+        # lookup + per-rating item lookups, batched into MGETs here)
+        try:
+            from flink_ms_tpu.eval import mse as mse_eval
+
+            n_mse = int(os.environ.get("BENCH_MSE_RATINGS",
+                                       1_000 if small else 10_000))
+            mse_in = os.path.join(tmp, "mse_ratings.tsv")
+            _write_ratings_tsv(mse_in, n_mse, n_users, n_items, seed=13,
+                               header=True)
+            t0 = time.time()
+            mse_val = mse_eval.run(Params.from_dict({
+                "input": mse_in, "jobId": job.job_id,
+                "jobManagerHost": "127.0.0.1", "jobManagerPort": job.port,
+                "queryTimeout": 60,
+            }))
+            mse_s = time.time() - t0
+            if mse_val is None:  # every lookup missed: no measurement
+                raise RuntimeError("live MSE scored zero ratings")
+            out["mse_live_ratings_per_sec"] = round(n_mse / mse_s)
+            out["mse_live_value"] = float(mse_val)
+            _log(f"[bench:serve] live MSE {mse_val:.4f} over {n_mse} ratings "
+                 f"in {mse_s:.1f}s ({out['mse_live_ratings_per_sec']}/s)")
+        except Exception:
+            _log(traceback.format_exc())
+            out["mse_error"] = traceback.format_exc(limit=3)
 
         # 7. native data plane: same journal through the C++ persistent
         # store + epoll lookup server (the reference's RocksDB + Netty
@@ -497,8 +534,10 @@ def run_serving_section(small: bool) -> dict:
             _wait_for_ingest(sjobs, total_rows, "sharded serving")
             rng = np.random.default_rng(5)
             sh = []
+            # 600s timeout: the first TOPK pays every worker's index build,
+            # like the single-node build in section 5
             with ShardedQueryClient(
-                [("127.0.0.1", j.port) for j in sjobs], timeout_s=60
+                [("127.0.0.1", j.port) for j in sjobs], timeout_s=600
             ) as c:
                 for _ in range(n_get):
                     u = int(rng.integers(1, n_users + 1))
@@ -506,6 +545,13 @@ def run_serving_section(small: bool) -> dict:
                     t0 = time.perf_counter()
                     c.query_states(ALS_STATE, [f"{u}-U", f"{i}-I"])
                     sh.append((time.perf_counter() - t0) * 1000.0)
+                # publish MGET percentiles before the TOPK phase so a
+                # TOPK failure cannot discard them
+                out.update({
+                    f"serving_shard_mget_{q}_ms": v
+                    for q, v in _pcts(sh).items()
+                })
+                out["serving_shard_workers"] = W
                 tk = []
                 c.topk(ALS_STATE, "1", topk_k)  # index build per worker
                 for _ in range(max(n_topk // 2, 5)):
@@ -514,12 +560,8 @@ def run_serving_section(small: bool) -> dict:
                     c.topk(ALS_STATE, str(uid), topk_k)
                     tk.append((time.perf_counter() - t0) * 1000.0)
             out.update(
-                {f"serving_shard_mget_{q}_ms": v for q, v in _pcts(sh).items()}
-            )
-            out.update(
                 {f"serving_shard_topk_{q}_ms": v for q, v in _pcts(tk).items()}
             )
-            out["serving_shard_workers"] = W
             _log(f"[bench:serve] sharded({W}) MGET {_pcts(sh)} ms, "
                  f"TOPK {_pcts(tk)} ms")
         except Exception:
