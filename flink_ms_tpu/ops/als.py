@@ -288,6 +288,60 @@ def prepare_blocked(
 # device-side kernel
 # ---------------------------------------------------------------------------
 
+# upper bound on one bucket's gathered-factor transient (r·w·k f32); a
+# bucket above it assembles in row chunks under lax.map so HBM holds one
+# chunk's gather at a time.  Baked in at trace time (part of the sweep
+# cache key via _assembly_chunk_bytes in _cached_sweep).
+_ASSEMBLY_CHUNK_ENV = "FLINK_MS_ALS_ASSEMBLY_CHUNK_BYTES"
+
+
+def _assembly_chunk_bytes() -> int:
+    return int(os.environ.get(_ASSEMBLY_CHUNK_ENV, 2 << 30))
+
+
+def _bucket_normal_eqs(y_all, idx, val, msk, implicit, alpha, dtype,
+                       precision):
+    """One bucket's (A, b): gather the opposite factors for each row's
+    rating list and contract over the rating axis on the MXU."""
+    def contract(idx_c, val_c, msk_c):
+        y = jnp.take(y_all, idx_c, axis=0)                   # (r, w, k)
+        if implicit:
+            w = (alpha * val_c).astype(dtype)
+            t = ((1.0 + alpha * val_c) * msk_c).astype(dtype)
+        else:
+            w = msk_c.astype(dtype)
+            t = val_c.astype(dtype)
+        yw = y * w[..., None]
+        # HIGHEST keeps f32 products (bf16 single-pass shifts the normal
+        # equations enough to slow convergence at small lambda)
+        A = jnp.einsum("rwk,rwl->rkl", yw, y, precision=precision)
+        b = jnp.einsum("rwk,rw->rk", y, t, precision=precision)
+        return A, b
+
+    r, w = idx.shape
+    k = y_all.shape[1]
+    # peak transient is ~2x the gather: the yw intermediate is the same
+    # size as y and TPU dots don't fuse elementwise producers into operands
+    need = 2 * r * w * k * 4
+    limit = _assembly_chunk_bytes()
+    if need <= limit:
+        return contract(idx, val, msk)
+    # chunked: bound the (C, w, k) gather + yw transients; lax.map runs
+    # chunks sequentially so only one pair is ever live
+    C = max(min(int(limit // (2 * w * k * 4)), r), 1)
+    nc = -(-r // C)
+    pad = nc * C - r
+    if pad:
+        idx = jnp.pad(idx, ((0, pad), (0, 0)))
+        val = jnp.pad(val, ((0, pad), (0, 0)))
+        msk = jnp.pad(msk, ((0, pad), (0, 0)))  # masked rows contribute 0
+    A, b = jax.lax.map(
+        lambda args: contract(*args),
+        (idx.reshape(nc, C, w), val.reshape(nc, C, w), msk.reshape(nc, C, w)),
+    )
+    return A.reshape(nc * C, k, k)[:r], b.reshape(nc * C, k)[:r]
+
+
 def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
                          precision="highest"):
     """A_u = Σ w·y yᵀ and b_u = Σ t·y per slot, as batched MXU matmuls.
@@ -305,19 +359,11 @@ def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
     """
     As, bs = [], []
     for idx, val, msk in buckets:
-        y = jnp.take(y_all, idx, axis=0)                     # (r_j, w_j, k)
-        if implicit:
-            w = (alpha * val).astype(dtype)
-            t = ((1.0 + alpha * val) * msk).astype(dtype)
-        else:
-            w = msk.astype(dtype)
-            t = val.astype(dtype)
-        yw = y * w[..., None]
-        # contraction over the rating axis rides the MXU; HIGHEST keeps
-        # f32 products (bf16 single-pass shifts the normal equations
-        # enough to slow convergence at small lambda)
-        As.append(jnp.einsum("rwk,rwl->rkl", yw, y, precision=precision))
-        bs.append(jnp.einsum("rwk,rw->rk", y, t, precision=precision))
+        A, b = _bucket_normal_eqs(
+            y_all, idx, val, msk, implicit, alpha, dtype, precision
+        )
+        As.append(A)
+        bs.append(b)
     return jnp.concatenate(As, axis=0), jnp.concatenate(bs, axis=0)
 
 
@@ -496,7 +542,8 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         config.weighted_reg,
         str(config.dtype),
         config.assembly_precision,
-        _solver_choice(),  # env override is baked in at trace time
+        _solver_choice(),          # env overrides are baked in at trace
+        _assembly_chunk_bytes(),   # time, so they key the executable
     )
     fn = _SWEEP_CACHE.pop(key, None)
     if fn is None:

@@ -129,6 +129,27 @@ def test_multiblock_equals_singleblock(rng):
     )
 
 
+def test_chunked_assembly_matches_unchunked(rng, monkeypatch):
+    """A tiny FLINK_MS_ALS_ASSEMBLY_CHUNK_BYTES forces the lax.map chunked
+    path; factors must match the single-shot assembly (same math on the
+    same rows — tolerance only covers codegen-level rounding)."""
+    u, i, r = _synthetic(rng, n_users=30, n_items=20)
+    k = 4
+    uf0 = rng.normal(size=(30, k)).astype(np.float32)
+    itf0 = rng.normal(size=(20, k)).astype(np.float32)
+    cfg = A.ALSConfig(num_factors=k, iterations=2, lambda_=0.1)
+    mesh = make_mesh(2)
+    plain = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    monkeypatch.setenv("FLINK_MS_ALS_ASSEMBLY_CHUNK_BYTES", "512")
+    chunked = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    np.testing.assert_allclose(
+        chunked.user_factors, plain.user_factors, rtol=1e-3, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        chunked.item_factors, plain.item_factors, rtol=1e-3, atol=1e-5
+    )
+
+
 def test_skewed_degrees_match_numpy(rng):
     """Power-law degree distribution (one super-popular item, many
     degree-1 users — the ML-20M shape) must bucket correctly: one
