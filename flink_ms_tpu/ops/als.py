@@ -326,20 +326,15 @@ def _bucket_normal_eqs(y_all, idx, val, msk, implicit, alpha, dtype,
     limit = _assembly_chunk_bytes()
     if need <= limit:
         return contract(idx, val, msk)
-    # chunked: bound the (C, w, k) gather + yw transients; lax.map runs
-    # chunks sequentially so only one pair is ever live
+    # chunked: lax.map with batch_size runs vmapped row chunks sequentially,
+    # so only one chunk's gather + yw transients are ever live
     C = max(min(int(limit // (2 * w * k * 4)), r), 1)
-    nc = -(-r // C)
-    pad = nc * C - r
-    if pad:
-        idx = jnp.pad(idx, ((0, pad), (0, 0)))
-        val = jnp.pad(val, ((0, pad), (0, 0)))
-        msk = jnp.pad(msk, ((0, pad), (0, 0)))  # masked rows contribute 0
-    A, b = jax.lax.map(
-        lambda args: contract(*args),
-        (idx.reshape(nc, C, w), val.reshape(nc, C, w), msk.reshape(nc, C, w)),
-    )
-    return A.reshape(nc * C, k, k)[:r], b.reshape(nc * C, k)[:r]
+
+    def one_row(args):
+        A, b = contract(*(a[None] for a in args))
+        return A[0], b[0]
+
+    return jax.lax.map(one_row, (idx, val, msk), batch_size=C)
 
 
 def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
@@ -417,6 +412,10 @@ def _solver_choice() -> str:
 def _chol_solve(A, b):
     k = A.shape[-1]
     choice = _solver_choice()
+    if choice == "pallas":
+        from .cholesky_pallas import cholesky_solve_batched
+
+        return cholesky_solve_batched(A, b).astype(A.dtype)
     if choice == "unrolled" or (choice == "auto" and k <= _UNROLL_MAX_K):
         return _chol_solve_unrolled(A, b)
     L = jax.lax.linalg.cholesky(A)

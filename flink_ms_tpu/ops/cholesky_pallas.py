@@ -1,0 +1,121 @@
+"""Fused batched SPD solve as a Pallas TPU kernel.
+
+The ALS half-sweep ends in n independent k×k normal-equation solves
+(k = numFactors, 10-64; n = entities per block, 10^4-10^6).  Both XLA's
+``lax.linalg.cholesky`` (a while-loop of dynamic slices — latency-bound)
+and the unrolled rank-1-downdate formulation (streams the whole (n, k, k)
+tensor from HBM once per elimination step — ~n·k³ bytes of traffic) are
+memory-bound on TPU.  The roofline optimum is to read A once and write x
+once; that needs the factorization to stay resident, which is exactly a
+Pallas kernel:
+
+- **batch on the lane axis**: tiles are laid out (k, k, T) with T batch
+  elements on the 128-wide lane dimension, so every elimination step is a
+  (k, T) vectorized VPU op — no per-element scalar loops;
+- the k-step Cholesky, forward- and back-substitution all run on the tile
+  while it lives in VMEM; HBM sees one read of A/b and one write of x.
+
+Like every kernel in this repo it has an interpreter-mode path so CPU
+tests pin numerics (``interpret=None`` auto-selects off-TPU); selection
+happens in ``ops/als._chol_solve`` via FLINK_MS_ALS_SOLVER=pallas.
+
+Reference capability: the per-ID regularized solves inside FlinkML's
+blocked ALS [dep], reached from ``ALSImpl.scala:52`` (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _solve_kernel(a_ref, b_ref, x_ref, *, k: int):
+    """One tile: A (k, k, T) SPD, b (k, T) -> x (k, T).
+
+    Right-looking Cholesky by rank-1 downdates, then the two triangular
+    substitutions, fully unrolled over the static k — every op is
+    vectorized over the T lanes.
+    """
+    M = a_ref[:]                                  # (k, k, T)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)
+    cols = []                                     # cols[j]: (k, T), >=2D ops
+    for j in range(k):
+        d = jax.lax.rsqrt(M[j, j:j + 1, :])       # (1, T)
+        col = M[:, j, :] * d                      # (k, T)
+        col = jnp.where(rows >= j, col, 0.0)      # zero rows above the pivot
+        cols.append(col)
+        M = M - col[:, None, :] * col[None, :, :]
+    # L[i, j] = cols[j][i]; diag entries as a (k, T) stack for the solves
+    diag = jnp.concatenate([c[j:j + 1, :] for j, c in enumerate(cols)], axis=0)
+
+    b = b_ref[:]                                  # (k, T)
+    # forward solve L z = b with a running accumulator acc = Σ_p L[:,p]·z_p
+    acc = jnp.zeros_like(b)
+    zs = []                                       # zs[j]: (1, T)
+    for j in range(k):
+        z = (b[j:j + 1, :] - acc[j:j + 1, :]) / diag[j:j + 1, :]
+        zs.append(z)
+        acc = acc + cols[j] * z
+    # back solve Lᵀ x = z: after fixing x_j, fold row j of L (gathered
+    # from the column stack: L[j, p] = cols[p][j]) into acc
+    Lrows = jnp.stack([c for c in cols], axis=1)  # (k, k, T): [i, j, :]
+    acc = jnp.zeros_like(b)
+    xs = [None] * k
+    for j in reversed(range(k)):
+        x = (zs[j] - acc[j:j + 1, :]) / diag[j:j + 1, :]
+        xs[j] = x
+        acc = acc + Lrows[j, :, :] * x            # row j of L, (k, T)
+    x_ref[:] = jnp.concatenate(xs, axis=0)        # (k, T)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _solve_padded(At, bt, tile: int, interpret: bool):
+    k = At.shape[0]
+    n_pad = At.shape[2]
+    kernel = functools.partial(_solve_kernel, k=k)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((k, k, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((k, tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((k, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((k, n_pad), At.dtype),
+        interpret=interpret,
+    )(At, bt)
+
+
+def cholesky_solve_batched(A, b, tile: int = 128, interpret=None):
+    """Batched SPD solve A x = b.  A (n, k, k), b (n, k) -> x (n, k).
+
+    ``tile`` batch elements ride the lane axis per grid step; VMEM holds
+    ~3·k²·tile·4 bytes (A tile, L, downdate temps) — tile=128 keeps k=64
+    under the ~16 MB budget.  ``interpret=None`` auto-selects interpreter
+    mode off-TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, k = b.shape
+    At = jnp.transpose(A.astype(jnp.float32), (1, 2, 0))  # (k, k, n)
+    bt = jnp.transpose(b.astype(jnp.float32), (1, 0))     # (k, n)
+    n_pad = _round_up(max(n, tile), tile)
+    if n_pad != n:
+        # pad batch lanes with the identity system (x = b = 0): rsqrt(0)
+        # on zero-padding would spread inf/nan through those lanes only,
+        # but keeping them finite is free and friendlier to debugging
+        At = jnp.pad(At, ((0, 0), (0, 0), (0, n_pad - n)))
+        eye_pad = jnp.eye(k, dtype=At.dtype)[:, :, None] * jnp.ones(
+            (1, 1, n_pad - n), At.dtype
+        )
+        At = At.at[:, :, n:].set(eye_pad)
+        bt = jnp.pad(bt, ((0, 0), (0, n_pad - n)))
+    x = _solve_padded(At, bt, tile, bool(interpret))
+    return jnp.transpose(x[:, :n], (1, 0))

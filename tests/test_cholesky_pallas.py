@@ -1,0 +1,48 @@
+"""Pallas batched Cholesky solve: numerics vs numpy in interpreter mode,
+and end-to-end ALS parity via FLINK_MS_ALS_SOLVER=pallas (SURVEY.md §4:
+kernel unit tests against closed form)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_ms_tpu.ops.cholesky_pallas import cholesky_solve_batched
+
+
+@pytest.mark.parametrize("k", [3, 8, 16, 50])
+@pytest.mark.parametrize("n", [1, 100, 257])
+def test_matches_numpy(rng, k, n):
+    G = rng.standard_normal((n, k, k)).astype(np.float32)
+    A = G @ G.transpose(0, 2, 1) + 5.0 * np.eye(k, dtype=np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    x = np.asarray(cholesky_solve_batched(jnp.asarray(A), jnp.asarray(b)))
+    x_ref = np.linalg.solve(
+        A.astype(np.float64), b.astype(np.float64)[..., None]
+    )[..., 0]
+    np.testing.assert_allclose(x, x_ref, rtol=2e-3, atol=2e-4)
+
+
+def test_als_fit_with_pallas_solver_matches_default(rng, monkeypatch):
+    from flink_ms_tpu.ops import als as A
+    from flink_ms_tpu.parallel.mesh import make_mesh
+
+    n_users, n_items, k = 40, 30, 4
+    uf = rng.normal(size=(n_users, k))
+    itf = rng.normal(size=(n_items, k))
+    full = uf @ itf.T
+    mask = rng.uniform(size=full.shape) < 0.6
+    u, i = np.nonzero(mask)
+    r = full[u, i]
+    uf0 = rng.normal(size=(n_users, k)).astype(np.float32)
+    itf0 = rng.normal(size=(n_items, k)).astype(np.float32)
+    cfg = A.ALSConfig(num_factors=k, iterations=2, lambda_=0.1)
+    mesh = make_mesh(2)
+    base = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    monkeypatch.setenv("FLINK_MS_ALS_SOLVER", "pallas")
+    pallas = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    np.testing.assert_allclose(
+        pallas.user_factors, base.user_factors, rtol=1e-3, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        pallas.item_factors, base.item_factors, rtol=1e-3, atol=1e-5
+    )
