@@ -134,6 +134,25 @@ class BlockedProblem:
         return self.i.per_block
 
 
+def _dense_ids(arr: np.ndarray):
+    """``np.unique(arr, return_inverse=True)`` with an O(n) fast path.
+
+    Rating files carry small non-negative integer ids (ML-20M: user ids
+    ≤ 138k), where a presence bitmap + cumsum replaces unique's O(n log n)
+    sort over all nnz entries.  Sparse/huge/negative/non-integer ids fall
+    back to unique; both paths return sorted unique ids + dense inverse.
+    """
+    if np.issubdtype(arr.dtype, np.integer) and arr.size:
+        mx = int(arr.max())
+        if int(arr.min()) >= 0 and mx <= max(4 * arr.size, 1 << 20):
+            present = np.zeros(mx + 1, dtype=bool)
+            present[arr] = True
+            ids = np.nonzero(present)[0]
+            lookup = np.cumsum(present) - 1
+            return ids, lookup[arr]
+    return np.unique(arr, return_inverse=True)
+
+
 def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int):
     """Degree-sorted block layout of one side -> (deg, block_of, rank, perm,
     widths, rows, per_block, bucket_of).
@@ -260,8 +279,8 @@ def prepare_blocked(
     if users.shape[0] == 0:
         raise ValueError("empty ratings input")
 
-    user_ids, u_idx = np.unique(users, return_inverse=True)
-    item_ids, i_idx = np.unique(items, return_inverse=True)
+    user_ids, u_idx = _dense_ids(users)
+    item_ids, i_idx = _dense_ids(items)
 
     # slot orders first: each side's idx arrays point at the OPPOSITE side's
     # slots, so both perms must exist before either fill

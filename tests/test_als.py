@@ -129,6 +129,23 @@ def test_multiblock_equals_singleblock(rng):
     )
 
 
+def test_dense_ids_matches_unique(rng):
+    """Bitmap fast path == np.unique on every id regime it claims."""
+    for arr in (
+        rng.integers(0, 50, 500),                      # dense small ints
+        rng.integers(0, 10**6, 300),                   # sparse, under the
+        #                            1<<20 bitmap floor: still fast path
+        np.array([5, 5_000_000, 5, 7]),                # huge gap (fallback:
+        #                            mx > max(4n, 1<<20))
+        np.array([-3, 7, 7, 0]),                       # negative (fallback)
+        rng.uniform(0, 9, 100).round(1),               # floats (fallback)
+    ):
+        ids, inv = A._dense_ids(np.asarray(arr))
+        ids_ref, inv_ref = np.unique(np.asarray(arr), return_inverse=True)
+        np.testing.assert_array_equal(ids, ids_ref)
+        np.testing.assert_array_equal(inv, inv_ref)
+
+
 def test_chunked_assembly_matches_unchunked(rng, monkeypatch):
     """A tiny FLINK_MS_ALS_ASSEMBLY_CHUNK_BYTES forces the lax.map chunked
     path; factors must match the single-shot assembly (same math on the

@@ -143,5 +143,7 @@ def test_p99_flat_under_streaming_writer(rng):
     # bound is relative to the quiet baseline (with an absolute floor) so
     # a loaded CI machine — where the GIL-hot writer amplifies any
     # scheduling delay — doesn't flake the assertion.
-    assert p50_busy < max(0.05, 25 * p50_quiet), (p50_quiet, p50_busy)
-    assert p99_busy < max(0.15, 25 * p99_quiet), (p99_quiet, p99_busy)
+    # the 0.6 s cap keeps the relative slack below the ~1 s rebuild cost,
+    # so the assertion never disarms entirely on a slow machine
+    assert p50_busy < min(max(0.05, 25 * p50_quiet), 0.6), (p50_quiet, p50_busy)
+    assert p99_busy < min(max(0.15, 25 * p99_quiet), 0.6), (p99_quiet, p99_busy)
