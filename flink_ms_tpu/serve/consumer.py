@@ -289,6 +289,7 @@ class ServingJob:
         last_checkpoint = time.time()
         while not self._stop.is_set():
             lines, next_offset = self.journal.read_from(self.offset)
+            batch = []
             for line in lines:
                 if not line:
                     continue
@@ -302,8 +303,12 @@ class ServingJob:
                     continue
                 if parsed is None:
                     continue  # row owned by another sharded worker
-                key, value = parsed
-                self.table.put(key, value)
+                batch.append(parsed)
+            # one lock acquisition per chunk, not per row — but chunked so
+            # a cold-start replay of a big journal can't starve concurrent
+            # queries behind one multi-second critical section
+            for s in range(0, len(batch), 10_000):
+                self.table.put_many(batch[s:s + 10_000])
             self.offset = next_offset
             now = time.time()
             if now - last_checkpoint >= self.checkpoint_interval_s:

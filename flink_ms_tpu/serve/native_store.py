@@ -274,6 +274,12 @@ class NativeModelTable:
             for fn in self._listeners:
                 fn(key)
 
+    def put_many(self, pairs) -> None:
+        """Batched ingest (same contract as ModelTable.put_many)."""
+        with self._lock:
+            for key, value in pairs:
+                self.put(key, value)
+
     def get(self, key: str) -> Optional[str]:
         return self.store.get(key)
 

@@ -61,6 +61,13 @@ class ModelTable:
             for fn in self._listeners:
                 fn(key)
 
+    def put_many(self, pairs) -> None:
+        """Batched ingest: one outer lock acquisition per batch (the
+        re-entrant per-put acquire is then uncontended and cheap)."""
+        with self._lock:
+            for key, value in pairs:
+                self.put(key, value)
+
     def get(self, key: str) -> Optional[str]:
         return self._shards[self.shard_of(key)].get(key)
 
