@@ -1,0 +1,53 @@
+"""Bench harness integrity: the section dispatch table in bench.py must
+reference real functions in bench_sections.py, and the tiny-config serving
+pipeline must produce its metric keys without error keys (the artifact
+contract the driver's end-of-round run depends on)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_section_table_names_resolve():
+    import ast
+
+    import bench_sections
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tree = ast.parse(open(os.path.join(root, "bench.py")).read())
+    names = [
+        n.value for n in ast.walk(tree)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        and n.value.startswith("run_") and n.value.endswith("_section")
+    ]
+    assert names, "section table not found in bench.py"
+    for fn_name in names:
+        assert callable(getattr(bench_sections, fn_name, None)), fn_name
+
+
+@pytest.mark.slow
+def test_tiny_serving_section_clean(monkeypatch):
+    """Serving section at a tiny config: all metric families present, no
+    *_error keys."""
+    for k, v in {
+        "BENCH_SERVE_USERS": "60", "BENCH_SERVE_ITEMS": "40",
+        "BENCH_SERVE_K": "4", "BENCH_SERVE_QUERIES": "20",
+        "BENCH_SERVE_TOPK_QUERIES": "4", "BENCH_SGD_RATINGS": "20",
+        "BENCH_MSE_RATINGS": "30", "BENCH_SHARD_WORKERS": "2",
+    }.items():
+        monkeypatch.setenv(k, v)
+    from bench_sections import run_serving_section
+
+    out = run_serving_section(small=True)
+    errors = {k: v for k, v in out.items() if k.endswith("_error")}
+    assert not errors, errors
+    for prefix in (
+        "gen_rows_per_sec", "ingest_rows_per_sec", "serving_get_p50_ms",
+        "serving_mget_p50_ms", "serving_topk_p50_ms",
+        "sgd_ratings_per_sec", "mse_live_value",
+        "serving_native_mget_p50_ms", "serving_shard_mget_p50_ms",
+    ):
+        assert prefix in out, (prefix, sorted(out))
