@@ -243,8 +243,10 @@ def run_svm_serving_section(small: bool) -> dict:
             np.unique(rng.integers(1, n_feat + 1, q_nnz))
             for _ in range(n_q)
         ]
-        # flat plane: one GET per feature (SVMPredictRandom.java:68-81)
-        ms = []
+        # flat plane: one GET per feature (SVMPredictRandom.java:68-81),
+        # then the batched variant — the whole sparse vector in ONE MGET
+        # round trip, the beat-the-reference path (SURVEY.md §3.5)
+        ms, ms_b = [], []
         with QueryClient("127.0.0.1", fjob.port, timeout_s=60) as c:
             for feats in queries:
                 t0 = time.perf_counter()
@@ -254,7 +256,17 @@ def run_svm_serving_section(small: bool) -> dict:
                     if payload is not None:
                         acc += float(payload)
                 ms.append((time.perf_counter() - t0) * 1000.0)
+            for feats in queries:
+                t0 = time.perf_counter()
+                payloads = c.query_states(
+                    SVM_STATE, [str(int(f)) for f in feats]
+                )
+                sum(float(p) for p in payloads if p is not None)
+                ms_b.append((time.perf_counter() - t0) * 1000.0)
         out.update({f"svmserve_flat_{q}_ms": v for q, v in _pcts(ms).items()})
+        out.update(
+            {f"svmserve_flat_mget_{q}_ms": v for q, v in _pcts(ms_b).items()}
+        )
         # range plane: one GET per bucket + payload parse
         # (RangePartitionSVMPredict.java:60-101)
         ms_r = []
@@ -276,7 +288,8 @@ def run_svm_serving_section(small: bool) -> dict:
         out.update({f"svmserve_range_{q}_ms": v for q, v in _pcts(ms_r).items()})
         out["svmserve_features"] = n_feat
         out["svmserve_buckets"] = n_buckets
-        _log(f"[bench:svmserve] flat {_pcts(ms)} ms, range {_pcts(ms_r)} ms "
+        _log(f"[bench:svmserve] flat {_pcts(ms)} ms, "
+             f"flat-mget {_pcts(ms_b)} ms, range {_pcts(ms_r)} ms "
              f"({n_feat} features, {n_buckets} buckets, {q_nnz} nnz/query)")
         return out
     finally:
