@@ -428,13 +428,18 @@ def _solver_choice() -> str:
     return os.environ.get("FLINK_MS_ALS_SOLVER", "auto")
 
 
-def _chol_solve(A, b):
+def _chol_solve(A, b, platform: Optional[str] = None):
     k = A.shape[-1]
     choice = _solver_choice()
     if choice == "pallas":
         from .cholesky_pallas import cholesky_solve_batched
 
         return cholesky_solve_batched(A, b).astype(A.dtype)
+    if choice == "auto" and platform == "cpu":
+        # LAPACK-backed lax.linalg: on the host backend it both compiles
+        # orders of magnitude faster than the k-step unroll (whose rank-50
+        # graph takes minutes in XLA:CPU) and runs faster
+        choice = "lax"
     if choice == "unrolled" or (choice == "auto" and k <= _UNROLL_MAX_K):
         return _chol_solve_unrolled(A, b)
     L = jax.lax.linalg.cholesky(A)
@@ -446,7 +451,8 @@ def _chol_solve(A, b):
     )[..., 0]
 
 
-def _solve_factors(A, b, counts, lam, weighted_reg, dtype):
+def _solve_factors(A, b, counts, lam, weighted_reg, dtype,
+                   platform: Optional[str] = None):
     """Batched Cholesky solve of (A + λ·reg·I) x = b with empty rows masked."""
     k = A.shape[-1]
     reg = counts if weighted_reg else jnp.ones_like(counts)
@@ -454,7 +460,7 @@ def _solve_factors(A, b, counts, lam, weighted_reg, dtype):
     # system so Cholesky stays PD, then zero the result
     diag = lam * reg + jnp.where(counts > 0, 0.0, 1.0)
     A = A + diag[:, None, None] * jnp.eye(k, dtype=dtype)
-    x = _chol_solve(A, b)
+    x = _chol_solve(A, b, platform)
     return jnp.where((counts > 0)[:, None], x, 0.0)
 
 
@@ -483,6 +489,7 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     dtype = config.dtype
     n_u_buckets = len(problem.u.widths)
     n_i_buckets = len(problem.i.widths)
+    platform = mesh.devices.flat[0].platform
 
     def half_sweep(y_shard, flat):
         # y_shard: (1, opp_pb, k) this device's shard of the opposite factors
@@ -502,7 +509,7 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
                 jnp.einsum("nk,nm->km", y_shard[0], y_shard[0]), BLOCK_AXIS
             )
             A = A + yty[None, :, :]
-        x = _solve_factors(A, b, counts[0], lam, weighted, dtype)
+        x = _solve_factors(A, b, counts[0], lam, weighted, dtype, platform)
         return x[None]  # (1, per_block, k)
 
     n_u_args = 3 * n_u_buckets + 1

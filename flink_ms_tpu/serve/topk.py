@@ -39,12 +39,53 @@ def _default_engine() -> str:
     return os.environ.get("TPUMS_TOPK_ENGINE", "xla")
 
 
+_warm_started = False
+_warm_lock = threading.Lock()
+
+
+def _warm_jit_async() -> None:
+    """Pay JAX's cold-pipeline cost off the query path, once per process.
+
+    The first jit in a fresh process costs ~8 s (backend init + compiler
+    warm-up) and the first scatter another ~3 s — measured on the CPU
+    backend; a same-structure compile at the real shapes afterwards is
+    ~1 s.  Serving workers answer their first TOPK/TOPKV within a client's
+    5 s queryTimeout only if that cold cost is paid at startup, so this
+    runs tiny dummy-shape compiles of exactly the two programs the index
+    uses (matmul+top_k, row scatter) on a daemon thread."""
+    global _warm_started
+    with _warm_lock:
+        if _warm_started:
+            return
+        _warm_started = True
+
+    def warm():
+        try:
+            from ..parallel.mesh import honor_platform_env
+
+            honor_platform_env()
+            import jax
+            import jax.numpy as jnp
+
+            m = jnp.zeros((8, 4), jnp.float32)
+            q = jnp.zeros((4,), jnp.float32)
+            jax.jit(lambda a, b: jax.lax.top_k(a @ b, 2))(m, q)
+            pos = np.zeros((4,), dtype=np.int32)
+            vec = jnp.zeros((4, 4), jnp.float32)
+            m.at[pos].set(vec).block_until_ready()
+        except Exception as e:  # pragma: no cover - best-effort warm-up
+            print(f"[topk] jit warm-up failed: {e}", file=sys.stderr)
+
+    threading.Thread(target=warm, name="topk-jit-warm", daemon=True).start()
+
+
 class DeviceFactorIndex:
     def __init__(self, table: ModelTable, factor_suffix: str = "-I",
                  engine: Optional[str] = None):
         self.table = table
         self.suffix = factor_suffix
         self.engine = engine or _default_engine()
+        _warm_jit_async()
         self._lock = threading.Lock()
         self._ids: List[str] = []
         self._id_pos: dict = {}   # id -> row index in the device matrix

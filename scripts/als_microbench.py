@@ -20,6 +20,86 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def run_breakdown(A_mod, problem, cfg, mesh, dev_args, hard_sync):
+    """Time the user half-sweep's phases separately on one device: the
+    opposite-factor gather, the full normal-equation assembly, and the
+    batched Cholesky solve.  Isolates where a sweep's wall-clock goes so
+    kernel work targets the real bottleneck (single-device layout: dev_args
+    leading block axis is 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    k = cfg.num_factors
+    n_u_buckets = len(problem.u.widths)
+    itf0 = dev_args[1]
+    u_flat = dev_args[2:2 + 3 * n_u_buckets + 1]
+    *bucket_args, counts = u_flat
+    y_all = itf0[0]
+    platform = mesh.devices.flat[0].platform
+
+    @jax.jit
+    def gather_only(y_all, *bs):
+        # one pass of the raw opposite-factor gathers, reduced to force
+        # materialization (mirrors jnp.take in _bucket_normal_eqs)
+        tot = jnp.zeros((), y_all.dtype)
+        for j in range(n_u_buckets):
+            idx = bs[3 * j]
+            tot = tot + jnp.take(y_all, idx, axis=0).sum()
+        return tot
+
+    @jax.jit
+    def assemble_only(y_all, *bs):
+        bl = [(bs[3 * j], bs[3 * j + 1], bs[3 * j + 2])
+              for j in range(n_u_buckets)]
+        A, b = A_mod._assemble_normal_eqs(
+            y_all, bl, cfg.implicit, cfg.alpha, cfg.dtype,
+            precision=cfg.assembly_precision,
+        )
+        return A.sum() + b.sum()
+
+    @jax.jit
+    def solve_only(A, b, counts):
+        x = A_mod._solve_factors(
+            A, b, counts, cfg.lambda_, cfg.weighted_reg, cfg.dtype,
+            platform,
+        )
+        return x
+
+    @jax.jit
+    def assemble_full(y_all, *bs):
+        bl = [(bs[3 * j], bs[3 * j + 1], bs[3 * j + 2])
+              for j in range(n_u_buckets)]
+        return A_mod._assemble_normal_eqs(
+            y_all, bl, cfg.implicit, cfg.alpha, cfg.dtype,
+            precision=cfg.assembly_precision,
+        )
+
+    flat_bufs = [a[0] for a in bucket_args]
+
+    def timeit(fn, *args_):
+        out = fn(*args_)
+        hard_sync(out if not isinstance(out, tuple) else out[0])
+        reps = 5
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn(*args_)
+        hard_sync(out if not isinstance(out, tuple) else out[0])
+        return (time.time() - t0) / reps
+
+    t_gather = timeit(gather_only, y_all, *flat_bufs)
+    t_asm = timeit(assemble_only, y_all, *flat_bufs)
+    A, b = assemble_full(y_all, *flat_bufs)
+    jax.block_until_ready(A)
+    t_solve = timeit(solve_only, A, b, counts[0])
+    print(
+        f"user half-sweep breakdown (k={k}):\n"
+        f"  gather-only   : {t_gather * 1e3:9.2f} ms\n"
+        f"  assembly (A,b): {t_asm * 1e3:9.2f} ms  (incl. gather)\n"
+        f"  solve         : {t_solve * 1e3:9.2f} ms  "
+        f"(batch {int(counts.shape[1])})"
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true")
@@ -27,6 +107,10 @@ def main():
     ap.add_argument("--users", type=int, default=None)
     ap.add_argument("--items", type=int, default=None)
     ap.add_argument("--rank", type=int, default=None)
+    ap.add_argument("--breakdown", action="store_true",
+                    help="time gather/assembly/solve phases separately")
+    ap.add_argument("--solvers", default="unrolled,lax")
+    ap.add_argument("--precisions", default="highest,high,default")
     args = ap.parse_args()
 
     small = args.small
@@ -65,6 +149,9 @@ def main():
     base_cfg = A.ALSConfig(num_factors=rank, iterations=1, lambda_=0.1)
     _, dev_args = A.compile_fit(problem, base_cfg, mesh)
 
+    if args.breakdown:
+        run_breakdown(A, problem, base_cfg, mesh, dev_args, hard_sync)
+
     def steady(cfg):
         fit_fn = A._cached_sweep(problem, cfg, mesh)
 
@@ -83,9 +170,14 @@ def main():
         )
         return samples[1]
 
-    for solver in ("unrolled", "lax"):
+    valid_solvers = {"unrolled", "lax", "pallas", "auto"}
+    solvers = args.solvers.split(",")
+    unknown = [s for s in solvers if s not in valid_solvers]
+    if unknown:
+        ap.error(f"unknown solver(s) {unknown}; choose from {sorted(valid_solvers)}")
+    for solver in solvers:
         os.environ["FLINK_MS_ALS_SOLVER"] = solver
-        for precision in ("highest", "high", "default"):
+        for precision in args.precisions.split(","):
             cfg = A.ALSConfig(
                 num_factors=rank, iterations=1, lambda_=0.1,
                 assembly_precision=precision,
