@@ -27,6 +27,7 @@ BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving; default all).
 """
 
+import contextlib
 import json
 import os
 import sys
@@ -278,6 +279,16 @@ def run_als_section(devices, platform, small: bool) -> dict:
 # ---------------------------------------------------------------------------
 
 def main() -> None:
+    # stdout is the artifact: exactly ONE JSON line.  Section code calls
+    # CLI mains in-process (producer, SGD, MSE) whose job summaries print
+    # to stdout — reroute everything but the final JSON to stderr.
+    real_stdout = sys.stdout
+    with contextlib.redirect_stdout(sys.stderr):
+        result = _run_all()
+    print(json.dumps(result), file=real_stdout)
+
+
+def _run_all() -> dict:
     small = os.environ.get("BENCH_SMALL") == "1"
     sections = os.environ.get(
         "BENCH_SECTIONS", "als,svm,serving,svmserve"
@@ -292,12 +303,11 @@ def main() -> None:
         devices, platform, backend_error = acquire_devices()
     except Exception as e:
         _log(traceback.format_exc())
-        print(json.dumps({
+        return {
             "metric": "als_ml20m_sec_per_iter", "value": None,
             "unit": "s/iter", "vs_baseline": None,
             "backend_error": f"no backend at all: {e}",
-        }))
-        return
+        }
     result["platform"] = platform
     result["n_devices"] = len(devices)
     result["device_kind"] = getattr(devices[0], "device_kind", "unknown")
@@ -343,7 +353,7 @@ def main() -> None:
         result.setdefault("unit", "s/iter")
         result.setdefault("vs_baseline", None)
 
-    print(json.dumps(result))
+    return result
 
 
 if __name__ == "__main__":

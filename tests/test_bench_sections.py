@@ -29,6 +29,38 @@ def test_section_table_names_resolve():
 
 
 @pytest.mark.slow
+def test_stdout_is_exactly_one_json_line():
+    """The driver parses bench.py stdout as THE artifact; in-process CLI
+    mains (producer/SGD/MSE job summaries) must not leak onto it."""
+    import json
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ambient = {k: v for k, v in os.environ.items()
+               if not k.startswith("BENCH_")}
+    env = dict(ambient,
+               BENCH_SECTIONS="als,svm,serving,svmserve",
+               JAX_PLATFORMS="cpu", BENCH_SMALL="1", BENCH_SKIP_CPU="1",
+               BENCH_NNZ="2000", BENCH_USERS="100", BENCH_ITEMS="50",
+               BENCH_RANK="4", BENCH_SVM_EXAMPLES="400",
+               BENCH_SVM_FEATURES="60", BENCH_SVM_ROUNDS="2",
+               BENCH_SERVE_USERS="40", BENCH_SERVE_ITEMS="30",
+               BENCH_SERVE_K="4", BENCH_SERVE_QUERIES="10",
+               BENCH_SERVE_TOPK_QUERIES="2", BENCH_SGD_RATINGS="10",
+               BENCH_MSE_RATINGS="10", BENCH_SHARD_WORKERS="2",
+               BENCH_SVMSERVE_FEATURES="50", BENCH_SVMSERVE_QUERIES="5")
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=root, env=env,
+        capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout polluted: {lines[:5]}"
+    parsed = json.loads(lines[0])
+    assert "metric" in parsed and "value" in parsed
+
+
+@pytest.mark.slow
 def test_tiny_serving_section_clean(monkeypatch):
     """Serving section at a tiny config: all metric families present, no
     *_error keys."""
