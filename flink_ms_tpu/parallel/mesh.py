@@ -37,6 +37,23 @@ def _ambient_accel_platforms() -> tuple:
     )
 
 
+# Plugins that reach their device over a network transport (tunnel/relay)
+# and can therefore hang backend init indefinitely when that transport is
+# dead.  Deliberately NOT the ambient list: popping a standard local
+# plugin's factory (e.g. "tpu") breaks more than init — the name backs
+# jax's known-platform registry, so pallas/Mosaic lowering registration
+# fails at import.  Deployment config: FLINK_MS_TPU_REMOTE_PLUGINS.
+_DEFAULT_REMOTE_PLUGINS = "axon"
+
+
+def _remote_plugins() -> tuple:
+    return tuple(
+        os.environ.get(
+            "FLINK_MS_TPU_REMOTE_PLUGINS", _DEFAULT_REMOTE_PLUGINS
+        ).split(",")
+    )
+
+
 def honor_platform_env() -> None:
     """Apply an explicitly-set ``JAX_PLATFORMS`` before backend init.
 
@@ -61,6 +78,32 @@ def honor_platform_env() -> None:
             jax.config.update("jax_platforms", val)
         except Exception:
             pass  # backend already live — too late to switch, keep going
+
+
+def pin_host_backend() -> None:
+    """Commit this process to the host CPU backend, robust to a dead
+    accelerator transport.
+
+    ``jax.devices("cpu")`` initializes EVERY registered plugin, so a
+    serving worker that only wants the host backend still blocks forever
+    when the accelerator tunnel is wedged.  Before any backend has
+    initialized, dropping the remote-transport plugin factories
+    (``_remote_plugins()``) and pinning ``jax_platforms=cpu`` makes
+    host-only init unconditional; once a backend is live this is a no-op
+    (the accelerator already initialized, so ``jax.devices("cpu")``
+    returns promptly and placement is handled by ``device_put``)."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if not getattr(_xb, "_backends", None):
+            for name in _remote_plugins():
+                _xb._backend_factories.pop(name, None)
+            jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already live; device_put handles placement
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:

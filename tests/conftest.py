@@ -18,9 +18,24 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+# Subprocesses the tests spawn (serving workers, CLI drives) must not
+# re-register the tunneled accelerator plugin either: a wedged tunnel
+# blocks EVERY backend init in-process — jax initializes all registered
+# plugins even under a cpu pin — so one dead relay would hang the whole
+# suite.  Blanking the pool override makes sitecustomize skip register().
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-jax.config.update("jax_platforms", "cpu")
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This interpreter already ran sitecustomize, so the accelerator factory
+# may be registered; pin_host_backend drops every ambient accelerator
+# factory and pins jax_platforms=cpu before the first backend init.
+from flink_ms_tpu.parallel.mesh import pin_host_backend  # noqa: E402
+
+pin_host_backend()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

