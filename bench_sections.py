@@ -458,6 +458,24 @@ def run_serving_section(small: bool) -> dict:
             out["sgd_ratings_per_sec"] = round(processed / sgd_s)
             _log(f"[bench:serve] SGD {processed} ratings in {sgd_s:.1f}s "
                  f"({out['sgd_ratings_per_sec']}/s)")
+            # and the chunked-MGET variant (--batchSize): one round trip
+            # per chunk, carry-forward sequential semantics per rating
+            batch = int(os.environ.get("BENCH_SGD_BATCH", 64))
+            t0 = time.time()
+            processed_b = online_sgd.run(Params.from_dict({
+                "input": ratings_path, "mode": "once", "outputMode": "kafka",
+                "journalDir": os.path.join(tmp, "bus"), "topic": "als-models",
+                "jobId": job.job_id, "jobManagerHost": "127.0.0.1",
+                "jobManagerPort": job.port, "queryTimeout": 60,
+                "flushEveryUpdate": False, "batchSize": batch,
+                "userMean": mean_payload, "itemMean": mean_payload,
+            }))
+            sgd_bs = time.time() - t0
+            out["sgd_batched_ratings_per_sec"] = round(processed_b / sgd_bs)
+            out["sgd_batch_size"] = batch
+            _log(f"[bench:serve] SGD batched({batch}) {processed_b} ratings "
+                 f"in {sgd_bs:.1f}s "
+                 f"({out['sgd_batched_ratings_per_sec']}/s)")
         except Exception:
             _log(traceback.format_exc())
             out["sgd_error"] = traceback.format_exc(limit=3)

@@ -90,6 +90,36 @@ class SGDStep:
             payload = None
         return self._vec(id_, suffix, payload, mean)
 
+    def _update(self, u: np.ndarray, v: np.ndarray, rating: float):
+        err = rating - float(u @ v)
+        u_new = u + self.lr * (err * v - self.user_reg * u)
+        if self.version == "v1":
+            v_new = v + self.lr * (err * u - self.item_reg * v)
+        else:  # v0: item step sees the already-updated user vector
+            v_new = v + self.lr * (err * u_new - self.item_reg * v)
+        return u_new, v_new
+
+    def _emit(self, user: int, item: int, u_new, v_new):
+        """-> (rows to emit, [(key, vec)] that became visible).
+
+        v1 emits even if NaN (log-only detection, SGD.java:230); v0 drops
+        NaN rows, so the served state — and a batch's carry-forward
+        cache — keeps the old vector for them."""
+        rows, visible = [], []
+        user_row = F.format_als_row(user, F.USER, u_new)
+        item_row = F.format_als_row(item, F.ITEM, v_new)
+        for row, key, vec, side in (
+            (user_row, f"{user}-U", u_new, "user"),
+            (item_row, f"{item}-I", v_new, "item"),
+        ):
+            if self.version != "v1" and "nan" in row.lower():
+                self.nan_records += 1
+                print(f"NaN in {side}Record{row}")
+                continue
+            rows.append(row)
+            visible.append((key, vec))
+        return rows, visible
+
     def process(self, user: int, item: int, rating: float) -> List[str]:
         if self.lookup_many is not None:
             keys = [f"{user}-U", f"{item}-I"]
@@ -103,33 +133,65 @@ class SGDStep:
         else:
             u = self._factors(user, "-U", self.user_mean)
             v = self._factors(item, "-I", self.item_mean)
-        err = rating - float(u @ v)
+        u_new, v_new = self._update(u, v, rating)
+        rows, _ = self._emit(user, item, u_new, v_new)
+        return rows
 
-        if self.version == "v1":
-            u_new = u + self.lr * (err * v - self.user_reg * u)
-            v_new = v + self.lr * (err * u - self.item_reg * v)
-        else:  # v0: item step sees the already-updated user vector
-            u_new = u + self.lr * (err * v - self.user_reg * u)
-            v_new = v + self.lr * (err * u_new - self.item_reg * v)
+    def process_batch(
+        self, ratings: List[Tuple[int, int, float]]
+    ) -> List[str]:
+        """Process a chunk of ratings with ONE lookup round trip.
+
+        All distinct factor keys of the chunk are fetched in a single
+        MGET; each rating is then processed *sequentially* against a
+        local carry-forward cache (later ratings see the vectors earlier
+        ratings in the chunk produced).  In the closed loop this is the
+        same dataflow as per-rating mode — there the update only becomes
+        visible to the next rating once the serving job happens to ingest
+        the emitted row, a race the local cache resolves deterministically
+        in favor of always-visible.  v0's drop-NaN rule keeps the OLD
+        vector in the cache for dropped rows, exactly like a row that was
+        never emitted.  Emission order (user row then item row, rating
+        order) is preserved."""
+        if self.lookup_many is None:
+            out: List[str] = []
+            for user, item, rating in ratings:
+                out.extend(self.process(user, item, rating))
+            return out
+        keys: List[str] = []
+        seen = set()
+        for user, item, _ in ratings:
+            for key in (f"{user}-U", f"{item}-I"):
+                if key not in seen:
+                    seen.add(key)
+                    keys.append(key)
+        try:
+            payloads = self.lookup_many(keys)
+        except Exception as e:
+            # a failed chunk fetch must not cold-start the WHOLE chunk
+            # (batchSize x the per-rating blast radius): fall back to
+            # per-rating processing, which contains any further failure
+            # to that one rating's two rows
+            print(f"batch query failed for {len(keys)} keys, falling back "
+                  f"to per-rating lookups: {e}", file=sys.stderr)
+            out = []
+            for user, item, rating in ratings:
+                out.extend(self.process(user, item, rating))
+            return out
+        cache: Dict[str, np.ndarray] = {}
+        for key, payload in zip(keys, payloads):
+            mean = self.user_mean if key.endswith("-U") else self.item_mean
+            id_, suffix = key[:-2], key[-2:]
+            cache[key] = self._vec(id_, suffix, payload, mean)
 
         out = []
-        user_row = F.format_als_row(user, F.USER, u_new)
-        item_row = F.format_als_row(item, F.ITEM, v_new)
-        if self.version == "v1":
-            # emit even if NaN (log-only detection, SGD.java:230)
-            out.append(user_row)
-            out.append(item_row)
-        else:
-            if "nan" in user_row.lower():
-                self.nan_records += 1
-                print(f"NaN in userRecord{user_row}")
-            else:
-                out.append(user_row)
-            if "nan" in item_row.lower():
-                self.nan_records += 1
-                print(f"NaN in itemRecord{item_row}")
-            else:
-                out.append(item_row)
+        for user, item, rating in ratings:
+            u_new, v_new = self._update(
+                cache[f"{user}-U"], cache[f"{item}-I"], rating
+            )
+            rows, visible = self._emit(user, item, u_new, v_new)
+            out.extend(rows)
+            cache.update(visible)
         return out
 
 
@@ -143,10 +205,14 @@ def stream_ratings(
     interval_ms: int,
     delimiter: str,
     stop: Optional[Callable[[], bool]] = None,
-) -> Iterator[Tuple[int, int, float]]:
+    idle_sentinel: bool = False,
+) -> Iterator[Optional[Tuple[int, int, float]]]:
     """Yield (user, item, rating) from a file/nested-dir source.  ``once``
     processes the current contents and returns; ``continuous`` re-polls
-    every ``interval_ms``, picking up appended lines and new files."""
+    every ``interval_ms``, picking up appended lines and new files.
+    ``idle_sentinel`` yields one ``None`` before each poll sleep so a
+    batching consumer can flush a partial batch instead of holding it
+    while the source idles."""
     if mode not in ("continuous", "once"):
         raise ValueError("Invalid mode. Specify --mode [continuous|once] ")
     consumed: Dict[str, int] = {}
@@ -187,6 +253,8 @@ def stream_ratings(
             return
         if stop is not None and stop():
             return
+        if idle_sentinel:
+            yield None
         time.sleep(interval_ms / 1000.0)
 
 
@@ -274,16 +342,40 @@ def run(params: Params, stop: Optional[Callable[[], bool]] = None) -> int:
         else:
             raise ValueError("outputMode must be kafka|journal|hdfs")
 
+        # --batchSize > 1: chunk the stream, one MGET per chunk, sequential
+        # carry-forward semantics per rating (see SGDStep.process_batch).
+        # Default 1 = strict per-rating parity with SGD.java.
+        batch_size = params.get_int("batchSize", 1)
         n = 0
-        for user, item, rating in stream_ratings(
+        pending: List[Tuple[int, int, float]] = []
+
+        def flush() -> None:
+            nonlocal n
+            if not pending:
+                return
+            emit(step.process_batch(pending))
+            n += len(pending)
+            pending.clear()
+
+        for rec in stream_ratings(
             params.get_required("input"),
             mode,
             params.get_int("interval", 60_000),
             delimiter,
             stop=stop,
+            idle_sentinel=batch_size > 1,
         ):
-            emit(step.process(user, item, rating))
-            n += 1
+            if rec is None:  # source idle: don't hold a partial batch
+                flush()
+                continue
+            if batch_size <= 1:
+                emit(step.process(*rec))
+                n += 1
+                continue
+            pending.append(rec)
+            if len(pending) >= batch_size:
+                flush()
+        flush()
         if output_mode in ("kafka", "journal"):
             journal.sync()  # checkpoint-boundary durability for flush=False
     finally:

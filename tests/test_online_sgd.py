@@ -322,3 +322,105 @@ def test_batched_lookup_one_roundtrip_per_rating(tmp_path, rng):
         assert per_key_cost == 2 * n + 2
     finally:
         job.stop()
+
+def test_process_batch_matches_sequential_closed_loop(rng):
+    """Batched processing (one MGET per chunk, local carry-forward) must
+    produce exactly the rows a sequential closed loop produces when every
+    emitted row is ingested before the next rating — including ratings in
+    the chunk that revisit the same user/item."""
+    k = 4
+    base = {
+        f"{u}-U": ";".join(repr(float(x)) for x in rng.normal(size=k))
+        for u in range(3)
+    }
+    base.update({
+        f"{i}-I": ";".join(repr(float(x)) for x in rng.normal(size=k))
+        for i in range(3)
+    })
+    ratings = [(0, 0, 4.0), (1, 1, 2.0), (0, 1, 5.0), (0, 0, 1.0), (2, 2, 3.0)]
+
+    for version in ("v1", "v0"):
+        # sequential oracle: per-rating process() against a table that
+        # ingests every emitted row immediately
+        table = dict(base)
+        seq_step = SGDStep(table.get, "0;0;0;0", "0;0;0;0",
+                           learning_rate=0.1, user_reg=0.01, item_reg=0.02,
+                           version=version)
+        seq_rows = []
+        for u, i, r in ratings:
+            rows = seq_step.process(u, i, r)
+            seq_rows.extend(rows)
+            for row in rows:
+                id_, typ, vec = F.parse_als_row(row)
+                table[f"{id_}-{typ}"] = ";".join(repr(float(x)) for x in vec)
+
+        # batched: one chunk, one MGET
+        snap = dict(base)
+        calls = []
+
+        def lookup_many(keys):
+            calls.append(list(keys))
+            return [snap.get(key) for key in keys]
+
+        batch_step = SGDStep(snap.get, "0;0;0;0", "0;0;0;0",
+                             learning_rate=0.1, user_reg=0.01, item_reg=0.02,
+                             version=version, lookup_many=lookup_many)
+        batch_rows = batch_step.process_batch(ratings)
+        assert len(calls) == 1, "batch must use exactly one MGET"
+        assert len(calls[0]) == len(set(calls[0])), "no duplicate keys"
+        assert len(batch_rows) == len(seq_rows)
+        for got, want in zip(batch_rows, seq_rows):
+            gi, gt, gv = F.parse_als_row(got)
+            wi, wt, wv = F.parse_als_row(want)
+            assert (gi, gt) == (wi, wt)
+            np.testing.assert_allclose(gv, wv, rtol=1e-10)
+
+
+def test_run_with_batch_size_closed_loop(tmp_path, rng):
+    """--batchSize > 1 through the real run() path: all ratings processed,
+    partial final batch flushed, rows land in the journal."""
+    from flink_ms_tpu.online import sgd as sgd_mod
+    from flink_ms_tpu.serve.journal import Journal
+    from flink_ms_tpu.serve.consumer import (
+        ALS_STATE, MemoryStateBackend, ServingJob, parse_als_record,
+    )
+    from flink_ms_tpu.core.params import Params
+
+    k = 3
+    bus = str(tmp_path / "bus")
+    model_rows = [
+        F.format_als_row(i, t, rng.normal(size=k))
+        for i in range(5) for t in ("U", "I")
+    ]
+    model_rows.append("MEAN,U," + ";".join(["0.0"] * k))
+    model_rows.append("MEAN,I," + ";".join(["0.0"] * k))
+    Journal(bus, "models").append(model_rows, flush=True)
+    job = ServingJob(
+        Journal(bus, "models"), ALS_STATE, parse_als_record,
+        MemoryStateBackend(), host="127.0.0.1", port=0,
+        poll_interval_s=0.01,
+    ).start()
+    try:
+        assert _wait_until(lambda: job.table.get("4-I") is not None)
+        ratings = tmp_path / "ratings.tsv"
+        recs = [(int(rng.integers(0, 5)), int(rng.integers(0, 5)),
+                 float(rng.uniform(1, 5))) for _ in range(7)]
+        ratings.write_text(
+            "".join(f"{u}\t{i}\t{r}\n" for u, i, r in recs))
+        n = sgd_mod.run(Params.from_dict({
+            "input": str(ratings), "mode": "once", "outputMode": "journal",
+            "journalDir": bus, "topic": "models", "jobId": job.job_id,
+            "jobManagerHost": "127.0.0.1", "jobManagerPort": job.port,
+            "batchSize": 3,  # 7 ratings -> 2 full chunks + partial flush
+        }))
+        assert n == 7
+        # the emitted updates re-enter the serving state via the journal:
+        # every touched key's served payload ends up != its original row
+        touched = {f"{u}-U" for u, _, _ in recs} | {f"{i}-I" for _, i, _ in recs}
+        orig = {r.split(",")[0] + "-" + r.split(",")[1]: r.split(",", 2)[2]
+                for r in model_rows if not r.startswith("MEAN")}
+        assert _wait_until(lambda: all(
+            job.table.get(key) not in (None, orig[key]) for key in touched
+        ))
+    finally:
+        job.stop()
