@@ -220,10 +220,14 @@ def _fill_side(
     msk = [np.zeros((n_blocks, rows[j], widths[j]), dtype) for j in range(nb)]
     count = np.zeros((n_blocks, per_block), dtype)
 
-    # ratings sorted by owning entity -> contiguous per-entity runs
-    order_r = np.argsort(row_idx, kind="stable")
+    # ratings sorted by owning entity -> contiguous per-entity runs; the
+    # secondary sort by opposite slot makes each rating list's factor
+    # gather walk HBM in ascending address order (contractions are
+    # order-invariant, so this only changes DMA locality)
+    col_global = opp_perm[col_idx].astype(np.int64)
+    order_r = np.lexsort((col_global, row_idx))
     ent_start = np.searchsorted(row_idx[order_r], np.arange(n_rows + 1))
-    col_sorted = opp_perm[col_idx[order_r]].astype(np.int64)
+    col_sorted = col_global[order_r]
     val_sorted = vals[order_r]
 
     local = perm - block_of * per_block  # slot within block

@@ -40,11 +40,20 @@ def run_breakdown(A_mod, problem, cfg, mesh, dev_args, hard_sync):
     @jax.jit
     def gather_only(y_all, *bs):
         # one pass of the raw opposite-factor gathers, reduced to force
-        # materialization (mirrors jnp.take in _bucket_normal_eqs)
+        # materialization — row chunked with the SAME bound and transient
+        # factor _bucket_normal_eqs uses, so the probe's scan overhead
+        # matches the assembly row it is compared against (a full-bucket
+        # gather at ML-20M scale RESOURCE_EXHAUSTs a 16 GB chip)
+        limit = A_mod._assembly_chunk_bytes()
         tot = jnp.zeros((), y_all.dtype)
         for j in range(n_u_buckets):
             idx = bs[3 * j]
-            tot = tot + jnp.take(y_all, idx, axis=0).sum()
+            w = idx.shape[1]
+            C = max(min(int(limit // (2 * w * k * 4)), idx.shape[0]), 1)
+            tot = tot + jax.lax.map(
+                lambda ic: jnp.take(y_all, ic, axis=0).sum(),
+                idx, batch_size=C,
+            ).sum()
         return tot
 
     @jax.jit
