@@ -261,7 +261,7 @@ def run_als_section(devices, platform, small: bool) -> dict:
             f"-> scaled {baseline:.3f} s/iter @ {nnz}"
         )
 
-    return {
+    out = {
         "metric": "als_ml20m_sec_per_iter" if not small else "als_small_sec_per_iter",
         "value": round(sec_per_iter, 6),
         "unit": "s/iter",
@@ -272,6 +272,41 @@ def run_als_section(devices, platform, small: bool) -> dict:
         "als_nnz": nnz,
         "als_rank": rank,
     }
+
+    # BASELINE.json config "als-ms implicit-feedback ALS (confidence-
+    # weighted) on MovieLens-20M": same problem layout, HKV mode (psum'd
+    # Gramian + confidence-weighted assembly).  Skipped in BENCH_SMALL
+    # sanity mode — the key names the ML-20M config and the extra timed
+    # section would double the quick run's wall-clock.
+    if not small:
+        try:
+            cfg_imp = ALSConfig(num_factors=rank, iterations=1, lambda_=0.1,
+                                seed=42, implicit=True, alpha=40.0)
+            spi_imp = time_fit(mesh, problem, cfg_imp, iters)
+            out["als_implicit_sec_per_iter"] = round(spi_imp, 6)
+            _log(f"[bench] implicit mode: {spi_imp:.3f} s/iter")
+        except Exception:
+            _log(traceback.format_exc())
+            out["als_implicit_error"] = traceback.format_exc(limit=3)
+
+    # BASELINE.json config "flink-als explicit ALS rank=10 on
+    # MovieLens-100K (single-node CPU)": the reference's own smallest
+    # config shape, timed on one host-CPU device as the single-node
+    # reference point
+    if not small and os.environ.get("BENCH_SKIP_CPU") != "1":
+        try:
+            mu, mi, mr = synth_ratings(943, 1_682, 100_000, seed=1)
+            cfg100 = ALSConfig(num_factors=10, iterations=1, lambda_=0.1)
+            cpu_mesh = make_mesh(devices=jax.devices("cpu")[:1])
+            p100 = prepare_blocked(mu, mi, mr, 1)
+            spi100 = time_fit(cpu_mesh, p100, cfg100, 3, repeats=3)
+            out["als_ml100k_cpu_sec_per_iter"] = round(spi100, 6)
+            _log(f"[bench] ML-100K rank-10 single-node CPU: {spi100:.4f} s/iter")
+        except Exception:
+            _log(traceback.format_exc())
+            out["als_ml100k_error"] = traceback.format_exc(limit=3)
+
+    return out
 
 
 # ---------------------------------------------------------------------------
