@@ -274,6 +274,9 @@ def run_svm_serving_section(small: bool) -> dict:
         )
         # range plane: one GET per bucket + payload parse
         # (RangePartitionSVMPredict.java:60-101)
+        from flink_ms_tpu.core.formats import RangePayloadCache
+
+        parse_cache = RangePayloadCache()
         ms_r = []
         with QueryClient("127.0.0.1", rjob.port, timeout_s=60) as c:
             for feats in queries:
@@ -286,11 +289,38 @@ def run_svm_serving_section(small: bool) -> dict:
                     payload = c.query_state(SVM_STATE, str(bucket))
                     if payload is None:
                         continue
-                    weights = dict(parse_svm_range_row(f"{bucket},{payload}")[1])
-                    for fid in fids:
-                        acc += weights.get(fid, 0.0)
+                    # cached vectorized parse + sorted lookup, same as the
+                    # range client's hot path
+                    ws, _ = parse_cache.gather(payload, fids)
+                    acc += float(ws.sum())
                 ms_r.append((time.perf_counter() - t0) * 1000.0)
         out.update({f"svmserve_range_{q}_ms": v for q, v in _pcts(ms_r).items()})
+        # and the batched variant: every needed bucket in ONE MGET round
+        # trip (the reference pays one KvState RPC per bucket,
+        # RangePartitionSVMPredict.java:63)
+        parse_cache = RangePayloadCache()  # fresh: each variant pays its
+        # own cold parses, keeping the two timings comparable
+        ms_rb = []
+        with QueryClient("127.0.0.1", rjob.port, timeout_s=60) as c:
+            for feats in queries:
+                t0 = time.perf_counter()
+                acc = 0.0
+                needed = {}
+                for fid in feats:
+                    needed.setdefault(int(fid) // range_, []).append(int(fid))
+                buckets_q = sorted(needed)
+                payloads = c.query_states(
+                    SVM_STATE, [str(b) for b in buckets_q]
+                )
+                for bucket, payload in zip(buckets_q, payloads):
+                    if payload is None:
+                        continue
+                    ws, _ = parse_cache.gather(payload, needed[bucket])
+                    acc += float(ws.sum())
+                ms_rb.append((time.perf_counter() - t0) * 1000.0)
+        out.update(
+            {f"svmserve_range_mget_{q}_ms": v for q, v in _pcts(ms_rb).items()}
+        )
         out["svmserve_features"] = n_feat
         out["svmserve_buckets"] = n_buckets
         _log(f"[bench:svmserve] flat {_pcts(ms)} ms, "

@@ -141,3 +141,29 @@ def test_interior_empty_factor_token_raises():
     # trailing separator still tolerated (Java split semantics)
     _, _, v = F.parse_als_row("7,U,1.0;2.0;")
     assert list(v) == [1.0, 2.0]
+
+def test_range_payload_cache_coherent_and_bounded():
+    from flink_ms_tpu.core.formats import RangePayloadCache
+
+    cache = RangePayloadCache(max_entries=2)
+    idx, w = cache.lookup("3:0.5;1:0.25;")
+    # sorted ascending by index
+    assert idx.tolist() == [1, 3] and w.tolist() == [0.25, 0.5]
+    # same string -> same (cached) arrays
+    idx2, _ = cache.lookup("3:0.5;1:0.25;")
+    assert idx2 is idx
+    # a republished bucket arrives as a DIFFERENT string: must miss
+    idx3, w3 = cache.lookup("3:0.75;1:0.25;")
+    assert w3.tolist() == [0.25, 0.75]
+    # bounded: inserting past max evicts, no growth
+    cache.lookup("7:1.0")
+    assert len(cache._cache) <= 2
+
+def test_range_payload_malformed_still_raises():
+    """The vectorized fast path must not silently re-pair corrupted rows:
+    structure violations raise exactly as the per-token parser did."""
+    from flink_ms_tpu.core.formats import parse_svm_range_row
+
+    for bad in ("5,1;2", "5,1:2:3;4", "5,:1;2:3", "5,1:2;3"):
+        with pytest.raises(ValueError):
+            parse_svm_range_row(bad)
