@@ -167,10 +167,28 @@ def als_flops_per_iter(nnz: int, n_users: int, n_items: int, k: int) -> float:
 # ALS section
 # ---------------------------------------------------------------------------
 
-def synth_ratings(n_users, n_items, nnz, seed=0):
+def synth_ratings(n_users, n_items, nnz, seed=0, skew=None):
+    """Synthetic ratings.  BENCH_SKEW=zipf (or skew="zipf") draws item
+    popularity and user activity from heavy-tailed marginals (Zipf-like
+    s~1, the real MovieLens-20M shape — wide degree spread stresses the
+    kernel's bucket padding); default is uniform (the round-2 recorded
+    workload)."""
     rng = np.random.default_rng(seed)
-    users = rng.integers(0, n_users, nnz)
-    items = rng.integers(0, n_items, nnz)
+    if skew is None:
+        skew = os.environ.get("BENCH_SKEW", "")
+    if skew == "zipf":
+        # bounded zipf via inverse-CDF over the ranked catalog
+        def zipf_draw(n_ids, size, s=1.0):
+            w = 1.0 / np.arange(1, n_ids + 1) ** s
+            cdf = np.cumsum(w)
+            cdf /= cdf[-1]  # exact 1.0 at the end: no out-of-range draw
+            return np.searchsorted(cdf, rng.uniform(size=size)).astype(np.int64)
+
+        users = zipf_draw(n_users, nnz, s=0.7)   # user activity: milder tail
+        items = zipf_draw(n_items, nnz, s=1.0)   # item popularity: zipf-1
+    else:
+        users = rng.integers(0, n_users, nnz)
+        items = rng.integers(0, n_items, nnz)
     ratings = rng.uniform(1.0, 5.0, nnz)
     return users, items, ratings
 
@@ -223,6 +241,7 @@ def run_als_section(devices, platform, small: bool) -> dict:
     rank = int(os.environ.get("BENCH_RANK", 16 if small else 50))
     iters = int(os.environ.get("BENCH_ITERS", 3 if small else 5))
 
+    skew = os.environ.get("BENCH_SKEW", "") or "uniform"
     users, items, ratings = synth_ratings(n_users, n_items, nnz)
     cfg = ALSConfig(num_factors=rank, iterations=1, lambda_=0.1, seed=42)
     mesh = make_mesh(devices=devices)
@@ -271,6 +290,7 @@ def run_als_section(devices, platform, small: bool) -> dict:
         "als_tflops_per_sec": round(flops / sec_per_iter / 1e12, 3),
         "als_nnz": nnz,
         "als_rank": rank,
+        "workload_skew": skew,
     }
 
     # BASELINE.json config "als-ms implicit-feedback ALS (confidence-
@@ -295,7 +315,10 @@ def run_als_section(devices, platform, small: bool) -> dict:
     # reference point
     if not small and os.environ.get("BENCH_SKIP_CPU") != "1":
         try:
-            mu, mi, mr = synth_ratings(943, 1_682, 100_000, seed=1)
+            # always uniform: this key mirrors the fixed BASELINE.json
+            # reference shape regardless of BENCH_SKEW
+            mu, mi, mr = synth_ratings(943, 1_682, 100_000, seed=1,
+                                       skew="uniform")
             cfg100 = ALSConfig(num_factors=10, iterations=1, lambda_=0.1)
             cpu_mesh = make_mesh(devices=jax.devices("cpu")[:1])
             p100 = prepare_blocked(mu, mi, mr, 1)
