@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# One-shot on-chip measurement sweep: run when the TPU tunnel is healthy.
+# Captures every decision artifact round 2 needs from the real chip into
+# $OUT (default /tmp/onchip_sweep):
+#   1. ALS solver x precision matrix (moderate scale)  -> als_matrix.log
+#   2. ALS phase breakdown (gather/assembly/solve)     -> als_breakdown.log
+#   3. XLA vs Pallas top-k profile (26k + 1M items)    -> topk_profile.log
+#   4. Full headline bench, uniform workload           -> bench_uniform.json/.log
+#   5. Full headline bench, zipf workload              -> bench_zipf.json/.log
+# Each step is independent; a failure logs and continues.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/onchip_sweep}"
+mkdir -p "$OUT"
+
+run() {
+  local name="$1"; shift
+  echo "=== $name: $*" | tee -a "$OUT/sweep.log"
+  timeout "${STEP_TIMEOUT:-1200}" "$@" > "$OUT/$name.log" 2>&1
+  echo "    rc=$? ($(tail -c 200 "$OUT/$name.log" | tr '\n' ' ' | tail -c 120))" \
+    | tee -a "$OUT/sweep.log"
+}
+
+run als_matrix python scripts/als_microbench.py \
+  --nnz 5000000 --users 60000 --items 12000 --rank 50 \
+  --solvers unrolled,lax,pallas --precisions highest,high,default
+
+run als_breakdown python scripts/als_microbench.py \
+  --nnz 5000000 --users 60000 --items 12000 --rank 50 \
+  --breakdown --solvers auto --precisions default
+
+run topk_profile python scripts/topk_profile.py --items 26000 1000000 --rank 50
+
+BENCH_SECTIONS=als,svm,serving,svmserve \
+  timeout "${STEP_TIMEOUT:-1200}" python bench.py \
+  > "$OUT/bench_uniform.json" 2> "$OUT/bench_uniform.log"
+echo "bench_uniform rc=$?" | tee -a "$OUT/sweep.log"
+
+BENCH_SKEW=zipf BENCH_SECTIONS=als \
+  timeout "${STEP_TIMEOUT:-1200}" python bench.py \
+  > "$OUT/bench_zipf.json" 2> "$OUT/bench_zipf.log"
+echo "bench_zipf rc=$?" | tee -a "$OUT/sweep.log"
+
+echo "sweep complete; artifacts in $OUT" | tee -a "$OUT/sweep.log"
