@@ -87,15 +87,19 @@ class SideLayout:
     contiguous rows and the solve writes factors with no scatter.
     """
 
-    per_block: int            # slots per block (Σ_j rows[j] ≥ entities/block)
+    per_block: int            # slots per block (Σ_j rows[j] + 1 — the last
+    #                           slot of every block is a guaranteed dummy)
     n_rows: int               # real entity count
     perm: np.ndarray          # (n_rows,) dense index -> global slot
     widths: Tuple[int, ...]   # pad width per bucket, descending
     rows: Tuple[int, ...]     # rows per bucket per block (static across blocks)
     idx: list                 # per bucket: (D, rows[j], widths[j]) int32,
-    #                           opposite-side global slot of each rating
+    #                           opposite-side global slot of each rating;
+    #                           PAD entries point at the opposite side's
+    #                           guaranteed-zero dummy slot, so gathered pad
+    #                           rows are exact zeros and assembly needs no
+    #                           mask arrays at all
     val: list                 # per bucket: ratings, pad entries 0
-    msk: list                 # per bucket: 1.0 real / 0.0 pad
     count: np.ndarray         # (D, per_block) degree per slot (0 for dummies)
 
 
@@ -154,8 +158,8 @@ def _dense_ids(arr: np.ndarray):
 
 
 def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int):
-    """Degree-sorted block layout of one side -> (deg, block_of, rank, perm,
-    widths, rows, per_block, bucket_of).
+    """Degree-sorted block layout of one side -> (deg, block_of, bucket_of,
+    perm, widths, rows, per_block).
 
     Entities are split into D contiguous dense-index blocks (the reference's
     ``setBlocks`` partitioning), then within each block ordered by degree
@@ -193,7 +197,11 @@ def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int):
     remap = np.cumsum(keep) - 1
     bucket_of = remap[bucket_of]
     offsets = np.concatenate([[0], np.cumsum(rows)])  # slot offset per bucket
-    per_block = int(offsets[-1])
+    # +1: the last slot of every block is a guaranteed dummy — its factor
+    # row is zero for the life of the fit (zero-filled at init in
+    # _pad_factors, kept zero by the count==0 mask in _solve_factors), and
+    # the OPPOSITE side's pad gathers point at it
+    per_block = int(offsets[-1]) + 1
     # rank of each entity within its (block, bucket), following `order`
     sorted_b = block_of[order]
     sorted_j = bucket_of[order]
@@ -207,17 +215,21 @@ def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int):
 
 
 def _fill_side(
-    row_idx, col_idx, vals, n_rows, n_blocks, side_order, opp_perm, dtype
+    row_idx, col_idx, vals, n_rows, n_blocks, side_order, opp_perm,
+    opp_pad_slot, dtype
 ) -> SideLayout:
     """Build one side's bucketed arrays from its precomputed ``_side_order``
     result.  ``opp_perm`` maps the opposite side's dense indices to its
     global slots (the positions valid against the all_gather'd factor
-    table)."""
+    table); ``opp_pad_slot`` is an opposite-side slot whose factor row is
+    guaranteed zero — pad entries gather it, so no mask array exists."""
     deg, block_of, bucket_of, perm, widths, rows, per_block = side_order
     nb = len(widths)
-    idx = [np.zeros((n_blocks, rows[j], widths[j]), np.int32) for j in range(nb)]
+    idx = [
+        np.full((n_blocks, rows[j], widths[j]), opp_pad_slot, np.int32)
+        for j in range(nb)
+    ]
     val = [np.zeros((n_blocks, rows[j], widths[j]), dtype) for j in range(nb)]
-    msk = [np.zeros((n_blocks, rows[j], widths[j]), dtype) for j in range(nb)]
     count = np.zeros((n_blocks, per_block), dtype)
 
     # ratings sorted by owning entity -> contiguous per-entity runs; the
@@ -253,7 +265,6 @@ def _fill_side(
         dst = np.repeat(flat_row * widths[j], lens) + intra
         idx[j].reshape(-1)[dst] = col_sorted[src]
         val[j].reshape(-1)[dst] = val_sorted[src]
-        msk[j].reshape(-1)[dst] = 1.0
     return SideLayout(
         per_block=per_block,
         n_rows=n_rows,
@@ -262,7 +273,6 @@ def _fill_side(
         rows=rows,
         idx=idx,
         val=val,
-        msk=msk,
         count=count,
     )
 
@@ -291,11 +301,17 @@ def prepare_blocked(
     u_order = _side_order(u_idx, len(user_ids), n_blocks)
     i_order = _side_order(i_idx, len(item_ids), n_blocks)
     u_perm, i_perm = u_order[3], i_order[3]
+    # each side's pad gathers target the opposite side's guaranteed dummy
+    # (last slot of block 0 — every block's last slot is a dummy)
+    u_pad_slot = u_order[6] - 1
+    i_pad_slot = i_order[6] - 1
     u_side = _fill_side(
-        u_idx, i_idx, ratings, len(user_ids), n_blocks, u_order, i_perm, dtype
+        u_idx, i_idx, ratings, len(user_ids), n_blocks, u_order, i_perm,
+        i_pad_slot, dtype
     )
     i_side = _fill_side(
-        i_idx, u_idx, ratings, len(item_ids), n_blocks, i_order, u_perm, dtype
+        i_idx, u_idx, ratings, len(item_ids), n_blocks, i_order, u_perm,
+        u_pad_slot, dtype
     )
     return BlockedProblem(
         n_blocks=n_blocks,
@@ -322,42 +338,49 @@ def _assembly_chunk_bytes() -> int:
     return int(os.environ.get(_ASSEMBLY_CHUNK_ENV, 2 << 30))
 
 
-def _bucket_normal_eqs(y_all, idx, val, msk, implicit, alpha, dtype,
+def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
                        precision):
     """One bucket's (A, b): gather the opposite factors for each row's
-    rating list and contract over the rating axis on the MXU."""
-    def contract(idx_c, val_c, msk_c):
+    rating list and contract over the rating axis on the MXU.
+
+    No mask arrays exist: pad entries gather the opposite side's dummy
+    slot, whose factor row is zero by construction, so every pad term
+    vanishes through y itself (explicit A needs no weighting at all —
+    one fewer (r, w, k) transient and multiply on the hot path)."""
+    def contract(idx_c, val_c):
         y = jnp.take(y_all, idx_c, axis=0)                   # (r, w, k)
-        if implicit:
-            w = (alpha * val_c).astype(dtype)
-            t = ((1.0 + alpha * val_c) * msk_c).astype(dtype)
-        else:
-            w = msk_c.astype(dtype)
-            t = val_c.astype(dtype)
-        yw = y * w[..., None]
         # HIGHEST keeps f32 products (bf16 single-pass shifts the normal
         # equations enough to slow convergence at small lambda)
-        A = jnp.einsum("rwk,rwl->rkl", yw, y, precision=precision)
+        if implicit:
+            w = (alpha * val_c).astype(dtype)       # pads: val 0 -> w 0
+            t = (1.0 + alpha * val_c).astype(dtype)  # pads: y row is zero
+            yw = y * w[..., None]
+            A = jnp.einsum("rwk,rwl->rkl", yw, y, precision=precision)
+        else:
+            A = jnp.einsum("rwk,rwl->rkl", y, y, precision=precision)
+            t = val_c.astype(dtype)                  # pads: val 0
         b = jnp.einsum("rwk,rw->rk", y, t, precision=precision)
         return A, b
 
     r, w = idx.shape
     k = y_all.shape[1]
-    # peak transient is ~2x the gather: the yw intermediate is the same
-    # size as y and TPU dots don't fuse elementwise producers into operands
-    need = 2 * r * w * k * 4
+    # peak transient: the gather itself, plus the same-size yw
+    # intermediate in implicit mode (TPU dots don't fuse elementwise
+    # producers into operands)
+    transients = 2 if implicit else 1
+    need = transients * r * w * k * 4
     limit = _assembly_chunk_bytes()
     if need <= limit:
-        return contract(idx, val, msk)
+        return contract(idx, val)
     # chunked: lax.map with batch_size runs vmapped row chunks sequentially,
-    # so only one chunk's gather + yw transients are ever live
-    C = max(min(int(limit // (2 * w * k * 4)), r), 1)
+    # so only one chunk's transients are ever live
+    C = max(min(int(limit // (transients * w * k * 4)), r), 1)
 
     def one_row(args):
         A, b = contract(*(a[None] for a in args))
         return A[0], b[0]
 
-    return jax.lax.map(one_row, (idx, val, msk), batch_size=C)
+    return jax.lax.map(one_row, (idx, val), batch_size=C)
 
 
 def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
@@ -365,23 +388,30 @@ def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
     """A_u = Σ w·y yᵀ and b_u = Σ t·y per slot, as batched MXU matmuls.
 
     y_all:   (n_slots_global, k) gathered opposite-side factor table
-    buckets: list of (idx, val, msk) with shapes (rows_j, w_j) — one entry
+    buckets: list of (idx, val) with shapes (rows_j, w_j) — one entry
              per degree bucket, rows covering contiguous slot ranges
     returns A (per_block, k, k), b (per_block, k) in slot order.
 
-    Explicit:  w = msk,       t = r             (normal equations of LS)
-    Implicit:  w = alpha*r,   t = (1+alpha*r)·m (HKV; YtY added by caller)
+    Explicit:  A = Σ y yᵀ,          b = Σ r·y    (normal equations of LS)
+    Implicit:  A = Σ alpha·r·y yᵀ,  b = Σ (1+alpha·r)·y  (HKV; YtY added
+               by caller)
 
-    Pad entries have val 0 / msk 0 and idx 0; the gathered row 0 factors are
-    real values, so every term is masked through w or t.
+    Pad entries have val 0 and idx = the opposite side's dummy slot, whose
+    factor row is zero — every pad term vanishes through y or val.
     """
     As, bs = [], []
-    for idx, val, msk in buckets:
+    for idx, val in buckets:
         A, b = _bucket_normal_eqs(
-            y_all, idx, val, msk, implicit, alpha, dtype, precision
+            y_all, idx, val, implicit, alpha, dtype, precision
         )
         As.append(A)
         bs.append(b)
+    k = y_all.shape[1]
+    # one zero system for the block's guaranteed dummy last slot (no bucket
+    # row covers it); count==0 regularization keeps it PD and the solve
+    # masks its result to zero, preserving the slot's zero factor row
+    As.append(jnp.zeros((1, k, k), dtype))
+    bs.append(jnp.zeros((1, k), dtype))
     return jnp.concatenate(As, axis=0), jnp.concatenate(bs, axis=0)
 
 
@@ -469,13 +499,13 @@ def _solve_factors(A, b, counts, lam, weighted_reg, dtype,
 
 
 def _flat_side_args(side: SideLayout, dtype):
-    """Device-arg flattening of one side: bucket triples then the count."""
+    """Device-arg flattening of one side: bucket (idx, val) pairs then the
+    count."""
     out = []
     for j in range(len(side.widths)):
         out += [
             side.idx[j],
             side.val[j].astype(dtype),
-            side.msk[j].astype(dtype),
         ]
     out.append(side.count.astype(dtype))
     return out
@@ -500,9 +530,8 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         *bucket_args, counts = flat
         y_all = jax.lax.all_gather(y_shard[0], BLOCK_AXIS, axis=0, tiled=True)
         buckets = [
-            (bucket_args[3 * j][0], bucket_args[3 * j + 1][0],
-             bucket_args[3 * j + 2][0])
-            for j in range(len(bucket_args) // 3)
+            (bucket_args[2 * j][0], bucket_args[2 * j + 1][0])
+            for j in range(len(bucket_args) // 2)
         ]
         A, b = _assemble_normal_eqs(
             y_all, buckets, implicit, alpha, dtype,
@@ -516,7 +545,7 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         x = _solve_factors(A, b, counts[0], lam, weighted, dtype, platform)
         return x[None]  # (1, per_block, k)
 
-    n_u_args = 3 * n_u_buckets + 1
+    n_u_args = 2 * n_u_buckets + 1
 
     def fit_body(iterations, uf, itf, *flat):
         u_flat, i_flat = flat[:n_u_args], flat[n_u_args:]
@@ -534,8 +563,8 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     spec3 = P(BLOCK_AXIS, None, None)
     spec2 = P(BLOCK_AXIS, None)
     flat_specs = (
-        (spec3,) * (3 * n_u_buckets) + (spec2,)
-        + (spec3,) * (3 * n_i_buckets) + (spec2,)
+        (spec3,) * (2 * n_u_buckets) + (spec2,)
+        + (spec3,) * (2 * n_i_buckets) + (spec2,)
     )
     sharded_fit = shard_map(
         fit_body,

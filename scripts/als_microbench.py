@@ -32,7 +32,7 @@ def run_breakdown(A_mod, problem, cfg, mesh, dev_args, hard_sync):
     k = cfg.num_factors
     n_u_buckets = len(problem.u.widths)
     itf0 = dev_args[1]
-    u_flat = dev_args[2:2 + 3 * n_u_buckets + 1]
+    u_flat = dev_args[2:2 + 2 * n_u_buckets + 1]
     *bucket_args, counts = u_flat
     y_all = itf0[0]
     platform = mesh.devices.flat[0].platform
@@ -45,11 +45,14 @@ def run_breakdown(A_mod, problem, cfg, mesh, dev_args, hard_sync):
         # matches the assembly row it is compared against (a full-bucket
         # gather at ML-20M scale RESOURCE_EXHAUSTs a 16 GB chip)
         limit = A_mod._assembly_chunk_bytes()
+        transients = 2 if cfg.implicit else 1
         tot = jnp.zeros((), y_all.dtype)
         for j in range(n_u_buckets):
-            idx = bs[3 * j]
+            idx = bs[2 * j]
             w = idx.shape[1]
-            C = max(min(int(limit // (2 * w * k * 4)), idx.shape[0]), 1)
+            C = max(
+                min(int(limit // (transients * w * k * 4)), idx.shape[0]), 1
+            )
             tot = tot + jax.lax.map(
                 lambda ic: jnp.take(y_all, ic, axis=0).sum(),
                 idx, batch_size=C,
@@ -58,7 +61,7 @@ def run_breakdown(A_mod, problem, cfg, mesh, dev_args, hard_sync):
 
     @jax.jit
     def assemble_only(y_all, *bs):
-        bl = [(bs[3 * j], bs[3 * j + 1], bs[3 * j + 2])
+        bl = [(bs[2 * j], bs[2 * j + 1])
               for j in range(n_u_buckets)]
         A, b = A_mod._assemble_normal_eqs(
             y_all, bl, cfg.implicit, cfg.alpha, cfg.dtype,
@@ -76,7 +79,7 @@ def run_breakdown(A_mod, problem, cfg, mesh, dev_args, hard_sync):
 
     @jax.jit
     def assemble_full(y_all, *bs):
-        bl = [(bs[3 * j], bs[3 * j + 1], bs[3 * j + 2])
+        bl = [(bs[2 * j], bs[2 * j + 1])
               for j in range(n_u_buckets)]
         return A_mod._assemble_normal_eqs(
             y_all, bl, cfg.implicit, cfg.alpha, cfg.dtype,

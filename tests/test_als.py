@@ -39,22 +39,29 @@ def test_prepare_blocked_layout(rng):
     u, i, r = _synthetic(rng)
     p = A.prepare_blocked(u, i, r, 4)
     assert all(a.shape[0] == 4 for a in p.u.idx)
-    # every rating accounted for exactly once (counts and masks sum to nnz)
+    # every rating accounted for exactly once (counts sum to nnz; pad
+    # entries = idx pointing at the opposite side's dummy slot)
     assert int(p.u.count.sum()) == p.nnz == len(r)
     assert int(p.i.count.sum()) == p.nnz
-    assert int(sum(m.sum() for m in p.u.msk)) == p.nnz
-    # pad entries carry zero rating and zero mask
-    for v, m in zip(p.u.val, p.u.msk):
-        assert (v[m == 0] == 0).all()
+    i_pad_slot = p.i.per_block - 1
+    n_pads = sum(int((ix == i_pad_slot).sum()) for ix in p.u.idx)
+    total_cells = sum(ix.size for ix in p.u.idx)
+    assert total_cells - n_pads == p.nnz
+    # pad entries carry zero rating
+    for ix, v in zip(p.u.idx, p.u.val):
+        assert (v[ix == i_pad_slot] == 0).all()
+    # the dummy slot is real: never a destination for any entity's factors
+    assert i_pad_slot not in set(p.i.perm.tolist())
+    assert (p.i.count[:, -1] == 0).all()  # every block's last slot is dummy
     # perm is a bijection into the slot space and respects block membership
     assert len(np.unique(p.u.perm)) == p.n_users
     dense_pb = -(-p.n_users // 4)
     np.testing.assert_array_equal(
         p.u.perm // p.u.per_block, np.arange(p.n_users) // dense_pb
     )
-    # every bucket row's entry count fits its width
-    for w, m in zip(p.u.widths, p.u.msk):
-        per_row = m.sum(axis=-1)
+    # every bucket row's real-entry count fits its width
+    for w, ix in zip(p.u.widths, p.u.idx):
+        per_row = (ix != i_pad_slot).sum(axis=-1)
         assert per_row.max() <= w
 
 
@@ -66,8 +73,7 @@ def test_assembly_matches_numpy(rng):
     y_all = np.zeros((p.i.per_block, k), dtype=np.float32)
     y_all[p.i.perm] = itf  # factor table lives in slot order
     buckets = [
-        (jnp.asarray(p.u.idx[j][0]), jnp.asarray(p.u.val[j][0]),
-         jnp.asarray(p.u.msk[j][0]))
+        (jnp.asarray(p.u.idx[j][0]), jnp.asarray(p.u.val[j][0]))
         for j in range(len(p.u.widths))
     ]
     Amat, b = A._assemble_normal_eqs(
