@@ -12,10 +12,11 @@ as a *batched Cholesky* (MXU-friendly), and the reference's per-iteration
 factor-block shuffle over Netty becomes a single ``all_gather`` over ICI.
 
 Ratings are laid out **degree-bucketed**: within each block, entities are
-grouped by degree class (power-of-two widths) and each group's rating lists
-are padded to the class width, so normal-equation assembly is a short list
-of dense batched ``einsum`` contractions — pure gather + MXU matmul, no
-scatter.  (A scatter/``segment_sum`` formulation was measured 8-10x slower
+grouped by degree class (a geometric width ladder, default ratio 1.5 with
+rungs rounded to multiples of 8 — FLINK_MS_ALS_BUCKET_RATIO) and each
+group's rating lists are padded to the class width, so normal-equation
+assembly is a short list of dense batched ``einsum`` contractions — pure
+gather + MXU matmul, no scatter.  (A scatter/``segment_sum`` formulation was measured 8-10x slower
 on v5e: TPU scatter serializes per row, and XLA's batched small-matrix
 Cholesky streams the whole (n, k, k) tensor per elimination step.)
 
@@ -157,7 +158,30 @@ def _dense_ids(arr: np.ndarray):
     return np.unique(arr, return_inverse=True)
 
 
-def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int):
+def _bucket_ratio() -> float:
+    """FLINK_MS_ALS_BUCKET_RATIO, validated.  In multi-process runs the
+    value must be identical on every host (the ladder determines the
+    sharded factor-table shapes the collectives agree on) — pass an
+    explicit ``bucket_ratio`` to ``prepare_blocked`` to pin it."""
+    import math
+
+    raw = os.environ.get("FLINK_MS_ALS_BUCKET_RATIO", "1.5")
+    try:
+        ratio = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"FLINK_MS_ALS_BUCKET_RATIO={raw!r} is not a number"
+        ) from None
+    if not math.isfinite(ratio) or not (1.05 <= ratio <= 16.0):
+        raise ValueError(
+            f"FLINK_MS_ALS_BUCKET_RATIO={raw!r} must be a finite value in "
+            "[1.05, 16]"
+        )
+    return ratio
+
+
+def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int,
+                ratio: Optional[float] = None):
     """Degree-sorted block layout of one side -> (deg, block_of, bucket_of,
     perm, widths, rows, per_block).
 
@@ -170,22 +194,29 @@ def _side_order(row_idx: np.ndarray, n_rows: int, n_blocks: int):
     block_of = np.arange(n_rows) // dense_pb
     # within-block order: degree desc, dense index as tiebreak
     order = np.lexsort((np.arange(n_rows), -deg, block_of))
-    # bucket = index into descending power-of-two widths
-    widths_all = []
-    w = 1 << max(int(np.max(deg)) - 1, 0).bit_length()
-    w = max(w, _MIN_BUCKET_W)
-    while True:
-        widths_all.append(w)
-        if w <= _MIN_BUCKET_W:
-            break
-        w //= 2
-    widths_all = np.array(widths_all)  # descending powers of two
-    # bucket of an entity = smallest width >= its degree.  widths_all[idx]
-    # = w0 >> idx, so idx = log2(w0) - ceil(log2(deg)); log2 is exact on
-    # binary powers, so the ceil is reliable
-    logw0 = int(widths_all[0]).bit_length() - 1
-    need = np.ceil(np.log2(np.maximum(deg, 1).astype(np.float64))).astype(np.int64)
-    bucket_of = np.clip(logw0 - need, 0, len(widths_all) - 1)
+    # bucket widths: geometric ladder from _MIN_BUCKET_W up to max degree,
+    # each rung rounded up to a multiple of 8 (f32 sublane).  Ratio 1.5
+    # (default, FLINK_MS_ALS_BUCKET_RATIO) measured 14-21% faster full
+    # sweeps than the classic power-of-two ladder (2.0) on both uniform
+    # ML-20M-shaped and zipf-skewed data: a degree distribution sitting
+    # just above a pow-2 rung pads up to ~1.8x, while finer rungs cost
+    # only a few extra einsum dispatches inside the same jit.  1.25 wins
+    # a little more on uniform data but over-fragments skewed catalogs.
+    if ratio is None:
+        ratio = _bucket_ratio()
+    max_deg = max(int(np.max(deg)), 1)
+    ladder = [_MIN_BUCKET_W]
+    while ladder[-1] < max_deg:
+        nxt = int(-(-int(ladder[-1] * ratio) // 8) * 8)  # round up to 8
+        if nxt <= ladder[-1]:
+            nxt = ladder[-1] + 8
+        ladder.append(nxt)
+    widths_all = np.array(ladder[::-1])  # descending
+    # bucket of an entity = smallest rung >= its degree (ladder ascending
+    # -> searchsorted left on the ascending view, then flip the index)
+    asc = widths_all[::-1]
+    pos = np.searchsorted(asc, np.maximum(deg, 1), side="left")
+    bucket_of = len(widths_all) - 1 - pos
     # per (block, bucket) entity counts -> static rows per bucket = max over blocks
     counts_bb = np.zeros((n_blocks, len(widths_all)), dtype=np.int64)
     np.add.at(counts_bb, (block_of, bucket_of), 1)
@@ -283,10 +314,14 @@ def prepare_blocked(
     ratings: np.ndarray,
     n_blocks: int,
     dtype=np.float32,
+    bucket_ratio: Optional[float] = None,
 ) -> BlockedProblem:
     """Build the blocked layout: dense-reindex raw ids, split entities into
     D contiguous blocks, degree-sort within blocks, and emit the bucketed
-    pad layout per block in both orientations."""
+    pad layout per block in both orientations.  ``bucket_ratio`` pins the
+    width-ladder growth factor (default: validated
+    FLINK_MS_ALS_BUCKET_RATIO env, 1.5) — multi-process launchers should
+    pass it explicitly so every host builds identical shapes."""
     users = np.asarray(users)
     items = np.asarray(items)
     ratings = np.asarray(ratings, dtype=np.float64)
@@ -298,8 +333,9 @@ def prepare_blocked(
 
     # slot orders first: each side's idx arrays point at the OPPOSITE side's
     # slots, so both perms must exist before either fill
-    u_order = _side_order(u_idx, len(user_ids), n_blocks)
-    i_order = _side_order(i_idx, len(item_ids), n_blocks)
+    ratio = bucket_ratio if bucket_ratio is not None else _bucket_ratio()
+    u_order = _side_order(u_idx, len(user_ids), n_blocks, ratio)
+    i_order = _side_order(i_idx, len(item_ids), n_blocks, ratio)
     u_perm, i_perm = u_order[3], i_order[3]
     # each side's pad gathers target the opposite side's guaranteed dummy
     # (last slot of block 0 — every block's last slot is a dummy)

@@ -420,3 +420,27 @@ def test_staged_mismatched_snapshot_ignored(rng, tmp_path):
     np.testing.assert_allclose(
         fresh.user_factors, plain.user_factors, rtol=2e-4, atol=2e-5
     )
+
+def test_bucket_ladder_bounds_padding(rng, monkeypatch):
+    """The geometric width ladder bounds per-list padding by ~ratio: every
+    entity lands in the smallest rung >= its degree, and rungs are 8-round
+    so the worst-case pad is ratio * degree + 8."""
+    import os
+    u = np.repeat(np.arange(200), rng.integers(1, 300, 200))
+    i = rng.integers(0, 50, len(u))
+    r = rng.uniform(1, 5, len(u)).astype(np.float64)
+    for ratio in ("1.5", "2.0"):
+        monkeypatch.setenv("FLINK_MS_ALS_BUCKET_RATIO", ratio)
+        p = A.prepare_blocked(u, i, r, 2)
+        deg = np.bincount(u, minlength=200)
+        widths = np.asarray(p.u.widths)
+        for uu in range(200):
+            slot = p.u.perm[uu]
+            # find the bucket whose slot range holds this entity
+            block = slot // p.u.per_block
+            local = slot - block * p.u.per_block
+            offsets = np.concatenate([[0], np.cumsum(p.u.rows)])
+            j = int(np.searchsorted(offsets, local, side="right") - 1)
+            w = widths[j]
+            assert w >= deg[uu]
+            assert w <= float(ratio) * max(deg[uu], 8) + 8, (w, deg[uu])
