@@ -489,8 +489,78 @@ def _chol_solve_unrolled(A, b):
     return jnp.stack(xs, axis=-1)
 
 
-# solver selection: "unrolled" (default for k <= _UNROLL_MAX_K) or "lax";
-# override with FLINK_MS_ALS_SOLVER for benchmarking either path
+def _chol_solve_panel(A, b, P: int = 8):
+    """Batched SPD solve by PANEL-blocked right-looking Cholesky.
+
+    The fully unrolled variant's k rank-1 downdates each stream the whole
+    (n, k, k) tensor — ~k full HBM passes.  Blocking the elimination into
+    panels of P columns keeps the rank-1 work inside an (n, k-p0, P) slab
+    and applies ONE rank-P downdate of the trailing submatrix per panel
+    (a batched matmul — MXU work), so the big tensor is streamed ~k/P
+    times instead of k.  Same numerics, reassociated.  A (n, k, k),
+    b (n, k) -> x (n, k)."""
+    n, k = b.shape
+    T = A
+    col_blocks = []  # per panel: L rows [p0:k), cols [p0:p0+pw)
+    for p0 in range(0, k, P):
+        pw = min(P, k - p0)
+        kr = k - p0
+        panel = T[:, :, :pw]                       # (n, kr, pw)
+        row_idx = jnp.arange(kr)
+        cols = []
+        for j in range(pw):
+            d = jax.lax.rsqrt(panel[:, j, j])
+            col = panel[:, :, j] * d[:, None] * (row_idx >= j)[None, :]
+            cols.append(col)
+            panel = panel - col[:, :, None] * col[:, None, :pw]
+        Lp = jnp.stack(cols, axis=-1)              # (n, kr, pw)
+        col_blocks.append(Lp)
+        if pw < kr:
+            Lt = Lp[:, pw:, :]                     # (n, kr-pw, pw)
+            # HIGHEST: the downdate must not lose mantissa on the MXU —
+            # errors compound across the k/P panels (same reasoning as
+            # the assembly einsums)
+            T = T[:, pw:, pw:] - jnp.einsum(
+                "nip,njp->nij", Lt, Lt, precision="highest"
+            )
+    # forward solve L z = b (block column sweep)
+    rhs = b
+    z_parts = []
+    for Lp in col_blocks:
+        pw = Lp.shape[2]
+        r = rhs                                    # (n, kr)
+        zb = []
+        for j in range(pw):
+            zj = r[:, j] / Lp[:, j, j]
+            zb.append(zj)
+            r = r - Lp[:, :, j] * zj[:, None]
+        z_parts.append(jnp.stack(zb, axis=-1))
+        rhs = r[:, pw:]
+    # back solve Lᵀ x = z (reverse block sweep)
+    x_parts: list = [None] * len(col_blocks)
+    x_below = jnp.zeros((n, 0), dtype=b.dtype)
+    for bi in reversed(range(len(col_blocks))):
+        Lp = col_blocks[bi]
+        pw = Lp.shape[2]
+        zb = z_parts[bi]
+        if x_below.shape[1]:
+            zb = zb - jnp.einsum(
+                "nrp,nr->np", Lp[:, pw:, :], x_below, precision="highest"
+            )
+        xb = [None] * pw
+        for j in reversed(range(pw)):
+            acc = zb[:, j]
+            for jj in range(j + 1, pw):
+                acc = acc - Lp[:, jj, j] * xb[jj]
+            xb[j] = acc / Lp[:, j, j]
+        x_parts[bi] = jnp.stack(xb, axis=-1)
+        x_below = jnp.concatenate([x_parts[bi], x_below], axis=-1)
+    return jnp.concatenate(x_parts, axis=-1)
+
+
+# solver selection: "unrolled" (default for k <= _UNROLL_MAX_K), "panel"
+# (blocked unroll, fewer HBM passes), "pallas", or "lax"; override with
+# FLINK_MS_ALS_SOLVER for benchmarking
 _UNROLL_MAX_K = 64
 
 
@@ -505,6 +575,8 @@ def _chol_solve(A, b, platform: Optional[str] = None):
         from .cholesky_pallas import cholesky_solve_batched
 
         return cholesky_solve_batched(A, b).astype(A.dtype)
+    if choice == "panel":
+        return _chol_solve_panel(A, b)
     if choice == "auto" and platform == "cpu":
         # LAPACK-backed lax.linalg: on the host backend it both compiles
         # orders of magnitude faster than the k-step unroll (whose rank-50

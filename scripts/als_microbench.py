@@ -182,7 +182,7 @@ def main():
         )
         return samples[1]
 
-    valid_solvers = {"unrolled", "lax", "pallas", "auto"}
+    valid_solvers = {"unrolled", "panel", "lax", "pallas", "auto"}
     solvers = args.solvers.split(",")
     unknown = [s for s in solvers if s not in valid_solvers]
     if unknown:

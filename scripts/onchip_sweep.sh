@@ -23,7 +23,7 @@ run() {
 
 run als_matrix python scripts/als_microbench.py \
   --nnz 5000000 --users 60000 --items 12000 --rank 50 \
-  --solvers unrolled,lax,pallas --precisions highest,high,default
+  --solvers unrolled,panel,lax,pallas --precisions highest,high,default
 
 run als_breakdown python scripts/als_microbench.py \
   --nnz 5000000 --users 60000 --items 12000 --rank 50 \

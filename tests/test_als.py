@@ -103,6 +103,39 @@ def test_chol_solve_unrolled_matches_numpy(rng, k):
     np.testing.assert_allclose(x, x_ref, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.parametrize("k", [3, 8, 16, 50])
+def test_chol_solve_panel_matches_numpy(rng, k):
+    n = 257
+    G = rng.standard_normal((n, k, k)).astype(np.float32)
+    A_ = G @ G.transpose(0, 2, 1) + 5.0 * np.eye(k, dtype=np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    x = np.asarray(
+        jax.jit(A._chol_solve_panel)(jnp.asarray(A_), jnp.asarray(b))
+    )
+    x_ref = np.linalg.solve(
+        A_.astype(np.float64), b.astype(np.float64)[..., None]
+    )[..., 0]
+    np.testing.assert_allclose(x, x_ref, rtol=2e-3, atol=2e-4)
+
+
+def test_fit_with_panel_solver_matches_default(rng, monkeypatch):
+    u, i, r = _synthetic(rng, n_users=30, n_items=20)
+    k = 5
+    uf0 = rng.normal(size=(30, k)).astype(np.float32)
+    itf0 = rng.normal(size=(20, k)).astype(np.float32)
+    cfg = A.ALSConfig(num_factors=k, iterations=2, lambda_=0.1)
+    mesh = make_mesh(1)
+    base = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    monkeypatch.setenv("FLINK_MS_ALS_SOLVER", "panel")
+    panel = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    np.testing.assert_allclose(
+        panel.user_factors, base.user_factors, rtol=1e-3, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        panel.item_factors, base.item_factors, rtol=1e-3, atol=1e-5
+    )
+
+
 @pytest.mark.parametrize("weighted", [True, False])
 def test_one_iteration_matches_numpy(rng, weighted):
     u, i, r = _synthetic(rng, n_users=15, n_items=11)
