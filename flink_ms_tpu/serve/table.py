@@ -34,6 +34,41 @@ def _fnv1a(s: str) -> int:
     return h
 
 
+def _fnv1a_batch(keys) -> "np.ndarray":
+    """Vectorized FNV-1a over a batch of keys (uint32 wrap = the & mask).
+
+    Byte-identical to ``_fnv1a`` per key; the per-character loop runs over
+    the LONGEST key only, with shorter keys masked out — ~10x less Python
+    bytecode per key at ingest batch sizes.  Returns uint32 hashes."""
+    import numpy as np
+
+    bs = [k.encode("utf-8") for k in keys]
+    n = len(bs)
+    L = max((len(b) for b in bs), default=0)
+    if L == 0:
+        return np.full(n, 0x811C9DC5, np.uint32)
+    if L > 256:
+        # one oversized key must only cost itself, not an (n, L) buffer
+        # and an L-deep masked loop for the whole batch
+        return np.fromiter(
+            (_fnv1a(k) for k in keys), np.uint32, n
+        )
+    buf = np.zeros((n, L), np.uint8)
+    lens = np.fromiter((len(b) for b in bs), np.int64, n)
+    flat = np.frombuffer(b"".join(bs), np.uint8)
+    # scatter each key's bytes into its padded row
+    row = np.repeat(np.arange(n), lens)
+    col = np.arange(flat.size) - np.repeat(np.cumsum(lens) - lens, lens)
+    buf[row, col] = flat
+    h = np.full(n, 0x811C9DC5, np.uint32)
+    prime = np.uint32(0x01000193)
+    for j in range(L):
+        active = j < lens
+        hx = (h ^ buf[:, j]) * prime
+        h = np.where(active, hx, h)
+    return h
+
+
 class ModelTable:
     def __init__(self, n_shards: int = 8):
         if n_shards < 1:
@@ -62,11 +97,21 @@ class ModelTable:
                 fn(key)
 
     def put_many(self, pairs) -> None:
-        """Batched ingest: one outer lock acquisition per batch (the
-        re-entrant per-put acquire is then uncontended and cheap)."""
+        """Batched ingest: one lock acquisition and one vectorized hash
+        pass per batch — the ingest hot path (at 1M-row replays the
+        per-key Python FNV loop was the measured pipeline bottleneck)."""
+        pairs = list(pairs)
+        if not pairs:
+            return
+        shard_ids = _fnv1a_batch([k for k, _ in pairs]) % self.n_shards
         with self._lock:
-            for key, value in pairs:
-                self.put(key, value)
+            shards = self._shards
+            listeners = self._listeners
+            for (key, value), sid in zip(pairs, shard_ids):
+                shards[sid][key] = value
+                for fn in listeners:
+                    fn(key)
+            self.puts += len(pairs)
 
     def get(self, key: str) -> Optional[str]:
         return self._shards[self.shard_of(key)].get(key)

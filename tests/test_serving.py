@@ -360,3 +360,12 @@ def test_mse_live_batched_one_roundtrip_per_group(als_job, rng):
         [(1.0 - (1.0 * 0.5 + u * i)) ** 2 for u in range(3) for i in range(3)]
     ))
     assert out == pytest.approx(expected)
+
+def test_fnv1a_batch_matches_scalar():
+    from flink_ms_tpu.serve.table import _fnv1a, _fnv1a_batch
+
+    keys = ["1-U", "12345-I", "MEAN-U", "", "x" * 40, "bucket", "7",
+            "ünïcödé-I"]
+    batch = _fnv1a_batch(keys)
+    for k, h in zip(keys, batch):
+        assert int(h) == _fnv1a(k), k
