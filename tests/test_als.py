@@ -477,3 +477,35 @@ def test_bucket_ladder_bounds_padding(rng, monkeypatch):
             w = widths[j]
             assert w >= deg[uu]
             assert w <= float(ratio) * max(deg[uu], 8) + 8, (w, deg[uu])
+
+def test_implicit_halfsweep_matches_numpy_hkv(rng):
+    """One implicit iteration vs the dense Hu-Koren-Volinsky spec:
+    x_u = (YtY + sum a*r*y y^T + lam I)^-1 sum (1+a*r) y, YtY over the
+    WHOLE catalog."""
+    u, i, r = _synthetic(rng, n_users=14, n_items=10)
+    r = np.abs(r) + 0.5  # implicit confidences must be positive
+    k, lam, alpha = 4, 0.3, 3.0
+    uf0 = rng.normal(size=(14, k)).astype(np.float32)
+    itf0 = rng.normal(size=(10, k)).astype(np.float32)
+    cfg = A.ALSConfig(num_factors=k, iterations=1, lambda_=lam,
+                      implicit=True, alpha=alpha)
+    model = A.als_fit(u, i, r, cfg, make_mesh(1), init=(uf0, itf0))
+
+    def hkv_halfsweep(row, col, rr, Y, n_rows):
+        YtY = Y.T @ Y
+        out = np.zeros((n_rows, k))
+        for e in range(n_rows):
+            sel = row == e
+            Ys = Y[col[sel]]
+            cw = alpha * rr[sel]
+            Amat = YtY + (Ys * cw[:, None]).T @ Ys + lam * np.eye(k)
+            b = ((1.0 + alpha * rr[sel])[:, None] * Ys).sum(axis=0)
+            out[e] = np.linalg.solve(Amat, b)
+        return out
+
+    uf_expect = hkv_halfsweep(u, i, r, itf0.astype(np.float64), 14)
+    np.testing.assert_allclose(model.user_factors, uf_expect,
+                               rtol=2e-3, atol=2e-4)
+    itf_expect = hkv_halfsweep(i, u, r, uf_expect, 10)
+    np.testing.assert_allclose(model.item_factors, itf_expect,
+                               rtol=2e-3, atol=2e-4)
