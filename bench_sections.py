@@ -581,8 +581,16 @@ def run_serving_section(small: bool) -> dict:
                 native_server=True,
             ).start()
             # full-ingest barrier: percentiles against a partially-loaded
-            # store would mix cheap misses into the numbers
+            # store would mix cheap misses into the numbers.  The replay
+            # runs through tpums_ingest_buf (one C++ call per chunk), so
+            # this also times the native bulk-ingest plane.
+            t0 = time.time()
             _wait_for_ingest(njob, total_rows, "native serving")
+            out["serving_native_ingest_rows_per_sec"] = round(
+                total_rows / max(time.time() - t0, 1e-9)
+            )
+            _log(f"[bench:serve] native ingest "
+                 f"{out['serving_native_ingest_rows_per_sec']} rows/s")
             rng = np.random.default_rng(3)
             with QueryClient("127.0.0.1", njob.port, timeout_s=60) as c:
                 nat = []

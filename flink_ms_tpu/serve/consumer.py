@@ -54,6 +54,12 @@ def parse_svm_record(line: str) -> Tuple[str, str]:
     return key, payload
 
 
+# native bulk-ingest mode ids (tpums_ingest_buf mirrors these parsers
+# byte-for-byte; tests pin the parity)
+parse_als_record.native_mode = 0
+parse_svm_record.native_mode = 1
+
+
 # ---------------------------------------------------------------------------
 # state backends
 # ---------------------------------------------------------------------------
@@ -288,34 +294,59 @@ class ServingJob:
     def _consume_loop(self) -> None:
         last_checkpoint = time.time()
         while not self._stop.is_set():
-            lines, next_offset = self.journal.read_from(self.offset)
-            batch = []
-            for line in lines:
-                if not line:
-                    continue
-                try:
-                    parsed = self.parse_fn(line)
-                except ValueError:
-                    # the reference would fail the task and burn a restart on
-                    # a malformed row; skip-and-count is the deliberate fix
-                    # (SURVEY.md Appendix C decision)
-                    self.parse_errors += 1
-                    continue
-                if parsed is None:
-                    continue  # row owned by another sharded worker
-                batch.append(parsed)
-            # one lock acquisition per chunk, not per row — but chunked so
-            # a cold-start replay of a big journal can't starve concurrent
-            # queries behind one multi-second critical section
-            for s in range(0, len(batch), 10_000):
-                self.table.put_many(batch[s:s + 10_000])
+            # native fast path: rocksdb-parity table + a standard parser +
+            # no change listeners -> the whole chunk (parse, key-derive,
+            # put) runs in ONE C++ call; listeners (top-k dirty tracking)
+            # force the Python path so they keep seeing every key.  The
+            # chunk is capped at 2 MiB (~15k rows) because the ingest call
+            # holds the store mutex the C++ lookup server's reads take —
+            # same starvation bound as the Python path's 10k-row chunks.
+            native_mode = getattr(self.parse_fn, "native_mode", None)
+            if (
+                native_mode is not None
+                and hasattr(self.table, "ingest_lines")
+                and not getattr(self.table, "_listeners", True)
+            ):
+                chunk, next_offset = self.journal.read_bytes_from(
+                    self.offset, max_bytes=2 << 20
+                )
+                got_any = bool(chunk)
+                if chunk:
+                    rows, errs = self.table.ingest_lines(chunk, native_mode)
+                    self.parse_errors += errs
+            else:
+                lines, next_offset = self.journal.read_from(self.offset)
+                got_any = bool(lines)
+                self._apply_lines(lines)
             self.offset = next_offset
             now = time.time()
             if now - last_checkpoint >= self.checkpoint_interval_s:
                 self.backend.snapshot(self.table, self.offset)
                 last_checkpoint = now
-            if not lines:
+            if not got_any:
                 self._stop.wait(self.poll_interval_s)
+
+    def _apply_lines(self, lines) -> None:
+        batch = []
+        for line in lines:
+            if not line:
+                continue
+            try:
+                parsed = self.parse_fn(line)
+            except ValueError:
+                # the reference would fail the task and burn a restart on
+                # a malformed row; skip-and-count is the deliberate fix
+                # (SURVEY.md Appendix C decision)
+                self.parse_errors += 1
+                continue
+            if parsed is None:
+                continue  # row owned by another sharded worker
+            batch.append(parsed)
+        # one lock acquisition per chunk, not per row — but chunked so
+        # a cold-start replay of a big journal can't starve concurrent
+        # queries behind one multi-second critical section
+        for s in range(0, len(batch), 10_000):
+            self.table.put_many(batch[s:s + 10_000])
 
 
 # ---------------------------------------------------------------------------

@@ -67,22 +67,32 @@ class Journal:
         except FileNotFoundError:
             return 0
 
+    def read_bytes_from(
+        self, offset: int, max_bytes: int = 1 << 24
+    ) -> Tuple[bytes, int]:
+        """Poll the raw complete-lines byte chunk after ``offset`` —
+        (chunk ending at its last newline, next_offset).  The zero-decode
+        variant of ``read_from`` for native bulk ingest."""
+        if not os.path.exists(self.path):
+            return b"", offset
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            chunk = f.read(max_bytes)
+        if not chunk:
+            return b"", offset
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return b"", offset
+        complete = chunk[: last_nl + 1]
+        return complete, offset + len(complete)
+
     def read_from(self, offset: int, max_bytes: int = 1 << 24) -> Tuple[List[str], int]:
         """Poll records after `offset`; returns (lines, next_offset).
 
         Only complete lines are returned; a torn tail (producer mid-append)
         stays unconsumed until its newline lands.
         """
-        if not os.path.exists(self.path):
+        complete, next_offset = self.read_bytes_from(offset, max_bytes)
+        if not complete:
             return [], offset
-        with open(self.path, "rb") as f:
-            f.seek(offset)
-            chunk = f.read(max_bytes)
-        if not chunk:
-            return [], offset
-        last_nl = chunk.rfind(b"\n")
-        if last_nl < 0:
-            return [], offset
-        complete = chunk[: last_nl + 1]
-        lines = complete.decode("utf-8").splitlines()
-        return lines, offset + len(complete)
+        return complete.decode("utf-8").splitlines(), next_offset

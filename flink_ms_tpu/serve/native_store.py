@@ -112,6 +112,11 @@ def _declare_abi(lib):
     lib.tpums_compact.restype = ctypes.c_int
     lib.tpums_compact.argtypes = [ctypes.c_void_p]
     lib.tpums_close.argtypes = [ctypes.c_void_p]
+    lib.tpums_ingest_buf.restype = ctypes.c_int
+    lib.tpums_ingest_buf.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.tpums_server_start.restype = ctypes.c_void_p
     lib.tpums_server_start.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -167,6 +172,21 @@ class NativeStore:
         v = value.encode("utf-8")
         if self._lib.tpums_put(self._h, k, len(k), v, len(v)) != 0:
             raise OSError("tpums_put failed")
+
+    def ingest_buf(self, data: bytes, mode: int) -> Tuple[int, int]:
+        """Bulk-ingest a chunk of complete journal lines natively.
+
+        mode 0 = ALS rows (key ``id-T``), 1 = SVM rows (key = first comma
+        token).  -> (rows ingested, parse errors)."""
+        rows = ctypes.c_uint64(0)
+        errs = ctypes.c_uint64(0)
+        rc = self._lib.tpums_ingest_buf(
+            self._h, data, len(data), mode,
+            ctypes.byref(rows), ctypes.byref(errs),
+        )
+        if rc != 0:
+            raise OSError("tpums_ingest_buf failed")
+        return int(rows.value), int(errs.value)
 
     def get(self, key: str) -> Optional[str]:
         k = key.encode("utf-8")
@@ -279,6 +299,17 @@ class NativeModelTable:
         with self._lock:
             for key, value in pairs:
                 self.put(key, value)
+
+    def ingest_lines(self, data: bytes, mode: int) -> Tuple[int, int]:
+        """Native bulk ingest of a journal chunk — ONE FFI call instead of
+        a Python parse + ctypes put per row.  Only valid when no change
+        listeners are registered (the consumer checks and falls back to
+        the Python path otherwise, so e.g. top-k dirty tracking keeps
+        seeing every key).  -> (rows, parse errors)."""
+        with self._lock:
+            rows, errs = self.store.ingest_buf(data, mode)
+            self.puts += rows
+            return rows, errs
 
     def get(self, key: str) -> Optional[str]:
         return self.store.get(key)

@@ -214,6 +214,112 @@ int tpums_delete(void* h, const char* k, uint32_t klen) {
   return append_record(s, k, klen, nullptr, kTombstone);
 }
 
+int tpums_ingest_buf(void* h, const char* buf, uint64_t len, int mode,
+                     uint64_t* rows_out, uint64_t* errs_out) {
+  // The serving consumer's hot loop, natively: parse journal lines,
+  // serialize every record into ONE buffer, and commit it with ONE
+  // write() — replaces a per-row Python->ctypes round trip plus three
+  // syscalls per record (the measured ingest bottleneck).  Malformed
+  // rows (and key/value-limit violations) are counted and skipped, the
+  // deliberate skip-and-count policy of the serving loop.
+  if (!h || (mode != 0 && mode != 1)) return -1;
+  Store* s = static_cast<Store*>(h);
+  uint64_t rows = 0, errs = 0;
+  std::string key;  // reused across rows (ALS key is id + '-' + type)
+  struct Pending {
+    uint64_t key_rel;  // key offset within the chunk buffer (no per-row
+    uint32_t klen;     // heap copy — the bytes already live in outbuf)
+    uint64_t val_rel;  // value offset within the chunk buffer
+    uint32_t vlen;
+  };
+  std::vector<char> outbuf;
+  outbuf.reserve(static_cast<size_t>(len) + (len >> 3) + 64);
+  std::vector<Pending> pend;
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (s->wedged) return -1;
+
+  auto emit = [&](const char* k, uint32_t klen, const char* v,
+                  uint32_t vlen) {
+    if (klen > kMaxKeyLen || vlen > kMaxValueLen) {
+      errs++;
+      return;
+    }
+    uint32_t hdr[2] = {klen, vlen};
+    const char* hp = reinterpret_cast<const char*>(hdr);
+    outbuf.insert(outbuf.end(), hp, hp + 8);
+    uint64_t key_rel = outbuf.size();
+    outbuf.insert(outbuf.end(), k, k + klen);
+    uint64_t val_rel = outbuf.size();
+    outbuf.insert(outbuf.end(), v, v + vlen);
+    pend.push_back(Pending{key_rel, klen, val_rel, vlen});
+    rows++;
+  };
+
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!nl) break;  // caller sends complete lines; ignore a torn tail
+    const char* line = p;
+    uint64_t n = static_cast<uint64_t>(nl - p);
+    p = nl + 1;
+    if (n == 0) continue;  // blank line, same as the Python loop's skip
+    const char* c1 = static_cast<const char*>(memchr(line, ',', n));
+    if (mode == 0) {
+      // "id,T,payload" -> key "id-T", value payload; fewer than two
+      // commas is a parse error (Python split(",", 2) raises)
+      if (!c1) {
+        errs++;
+        continue;
+      }
+      uint64_t rest = n - (c1 + 1 - line);
+      const char* c2 =
+          static_cast<const char*>(memchr(c1 + 1, ',', rest));
+      if (!c2) {
+        errs++;
+        continue;
+      }
+      key.assign(line, c1 - line);
+      key.push_back('-');
+      key.append(c1 + 1, c2 - (c1 + 1));
+      const char* val = c2 + 1;
+      emit(key.data(), static_cast<uint32_t>(key.size()), val,
+           static_cast<uint32_t>(n - (val - line)));
+    } else {
+      // SVM: key = first comma token; no comma -> whole line, empty value
+      const char* val = c1 ? c1 + 1 : line + n;
+      emit(line, static_cast<uint32_t>(c1 ? c1 - line : n), val,
+           static_cast<uint32_t>(n - (val - line)));
+    }
+  }
+
+  if (!outbuf.empty()) {
+    if (!write_all(s->fd, outbuf.data(), outbuf.size())) {
+      // partial chunk append: roll back to the last complete record so
+      // indexed offsets stay valid (same invariant as append_record)
+      if (ftruncate(s->fd, static_cast<off_t>(s->end)) != 0)
+        s->wedged = true;
+      return -1;
+    }
+    uint64_t base = s->end;
+    std::string idx_key;  // one buffer reused across the commit loop
+    for (const Pending& pr : pend) {
+      idx_key.assign(outbuf.data() + pr.key_rel, pr.klen);
+      auto it = s->index.find(idx_key);
+      if (it != s->index.end()) {
+        s->live_bytes -= 8 + idx_key.size() + it->second.length;
+        s->index.erase(it);
+      }
+      s->index[idx_key] = Entry{base + pr.val_rel, pr.vlen};
+      s->live_bytes += 8 + idx_key.size() + pr.vlen;
+    }
+    s->end += outbuf.size();
+  }
+  if (rows_out) *rows_out = rows;
+  if (errs_out) *errs_out = errs;
+  return 0;
+}
+
 // Returns a malloc'd value buffer (caller frees via tpums_free_buf) or null.
 // A null return with *err_out != 0 is an I/O failure on an EXISTING key —
 // callers must surface it as an error, not as "key not found".

@@ -23,6 +23,13 @@ char* tpums_get(void* h, const char* k, uint32_t klen, uint32_t* vlen_out,
                 int* err_out);
 void tpums_free_buf(char* p);
 int tpums_delete(void* h, const char* k, uint32_t klen);
+// Bulk-ingest a journal chunk (complete '\n'-terminated lines).  mode 0 =
+// ALS rows "id,T,payload" keyed "id-T"; mode 1 = SVM rows keyed by the
+// first comma token (no comma: whole line keys an empty payload — the
+// Python parser's semantics).  Malformed ALS rows are counted in
+// *errs_out and skipped.  Returns 0, or -1 on write failure.
+int tpums_ingest_buf(void* h, const char* buf, uint64_t len, int mode,
+                     uint64_t* rows_out, uint64_t* errs_out);
 uint64_t tpums_count(void* h);
 int tpums_flush(void* h);
 typedef void (*tpums_key_cb)(const char* key, uint32_t klen, void* ctx);

@@ -216,3 +216,86 @@ def test_oversized_record_rejected_at_write(tmp_path):
         with pytest.raises(OSError):
             s.put("k" * ((1 << 20) + 1), "v")  # key > 1MiB
         assert s.get("fits") == "x"
+
+def test_native_ingest_buf_matches_python_parsers(tmp_path):
+    """tpums_ingest_buf must mirror parse_als_record/parse_svm_record
+    byte-for-byte, including malformed-row counting and the SVM
+    no-comma rule."""
+    from flink_ms_tpu.serve.consumer import parse_als_record, parse_svm_record
+    from flink_ms_tpu.serve.native_store import NativeStore
+    from flink_ms_tpu.serve.table import ModelTable
+
+    als_lines = [
+        "1,U,0.5;0.25;",
+        "2,I,1.0",
+        "MEAN,U,0.1;0.2",
+        "badrow",           # no comma: parse error
+        "alsoBad",          # no comma
+        "3,U",              # ONE comma: parse error (split(',', 2) raises? no)
+        "1,U,9.9",          # overwrite
+        "",                 # blank: skipped, not an error
+    ]
+    # Python-path oracle
+    oracle = ModelTable(4)
+    py_errs = 0
+    for line in als_lines:
+        if not line:
+            continue
+        try:
+            oracle.put(*parse_als_record(line))
+        except ValueError:
+            py_errs += 1
+    store = NativeStore(str(tmp_path / "als"))
+    data = "".join(l + "\n" for l in als_lines).encode()
+    rows, errs = store.ingest_buf(data, 0)
+    assert errs == py_errs == 3
+    assert rows == 4  # valid rows, overwrites counted per row
+    for key, val in oracle.items():
+        assert store.get(key) == val, key
+    assert len(store) == len(oracle)
+    store.close()
+
+    svm_lines = ["7,0.5", "12,", "nocomma", "7,0.75"]
+    oracle2 = ModelTable(4)
+    for line in svm_lines:
+        oracle2.put(*parse_svm_record(line))
+    store2 = NativeStore(str(tmp_path / "svm"))
+    rows2, errs2 = store2.ingest_buf(
+        "".join(l + "\n" for l in svm_lines).encode(), 1)
+    assert (rows2, errs2) == (4, 0)
+    for key, val in oracle2.items():
+        assert store2.get(key) == val, key
+    store2.close()
+
+def test_serving_job_uses_native_bulk_ingest(tmp_path):
+    """With the rocksdb backend and no listeners, the consume loop takes
+    the one-FFI-call-per-chunk path (parse errors still surface); a
+    registered listener forces the per-row Python path."""
+    bus = str(tmp_path / "bus")
+    j = Journal(bus, "m")
+    j.append(["1,U,0.5;1.5", "junk-no-comma", "2,I,2.5"], flush=True)
+    backend = make_backend("rocksdb", str(tmp_path / "store"))
+    # native_server=True: the Python topk handler (which registers a
+    # change listener and would force the per-row path) is not created
+    job = ServingJob(
+        Journal(bus, "m"), ALS_STATE, parse_als_record, backend,
+        host="127.0.0.1", port=0, poll_interval_s=0.01,
+        native_server=True,
+    )
+    calls = []
+    real_ingest = job.table.ingest_lines
+    job.table.ingest_lines = lambda data, mode: (
+        calls.append(mode) or real_ingest(data, mode)
+    )
+    job.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(job.table) < 2:
+            time.sleep(0.02)
+        assert job.table.get("1-U") == "0.5;1.5"
+        assert job.table.get("2-I") == "2.5"
+        assert job.parse_errors == 1
+        assert job.table.puts == 2
+        assert calls and all(m == 0 for m in calls), "fast path did not run"
+    finally:
+        job.stop()
