@@ -37,6 +37,13 @@ with NativeLookupServer(store, "ALS_MODEL", job_id="san", port=0) as srv:
         i = 0
         while not stop.is_set():
             store.put(f"{i % 100}-U", f"{i};{i + 1}")
+            if i % 7 == 0:
+                # the bulk-ingest path shares the mutex with reads: keep
+                # it under the race gate too
+                chunk = "".join(
+                    f"{j % 100},U,{i};{j}\n" for j in range(20)
+                ).encode()
+                store.ingest_buf(chunk, 0)
             i += 1
         store.compact()
 
