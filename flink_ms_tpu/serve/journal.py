@@ -1,44 +1,138 @@
 """Replayable ingest journal — the TPU-native stand-in for the reference's
 Kafka 0.10 + ZooKeeper model bus (SURVEY.md §2.5).
 
-A topic is an append-only log file under a journal directory.  Producers
-append model rows (``ALSKafkaProducer.java:29-37`` writes with
+A topic is an append-only log under a journal directory.  Producers append
+model rows (``ALSKafkaProducer.java:29-37`` writes with
 ``flushOnCheckpoint`` = at-least-once); consumers poll from a byte offset
 and commit that offset in their checkpoints, so replay after failure
 re-delivers rows — duplicates are tolerated by design because the serving
 table is last-writer-wins, exactly like the reference's ``ValueState``
 (``ALSKafkaConsumer.java:85-92``).
 
+Topics are SEGMENTED like Kafka's log: the active segment receives
+appends; when ``segment_bytes`` is configured, a full segment is sealed
+and a new one starts at the current end offset, and ``retain_segments``
+bounds disk by deleting the oldest sealed segments.  Offsets are global
+byte positions (segment base + position), contiguous across rotation, so
+consumer checkpoints are unaffected.  A consumer whose committed offset
+has been expired by retention resumes at the earliest retained offset
+(Kafka's ``auto.offset.reset=earliest`` semantics) and the skipped byte
+count is surfaced on the journal object.
+
 The log format is plain text lines, so journals are interoperable with the
-reference's model files and greppable during ops.
+reference's model files and greppable during ops.  Segment files are
+``<topic>.log`` (base offset 0) and ``<topic>.log.<base>``.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import Iterable, List, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 
 class Journal:
     """One topic inside a journal directory."""
 
-    def __init__(self, journal_dir: str, topic: str):
+    def __init__(
+        self,
+        journal_dir: str,
+        topic: str,
+        segment_bytes: Optional[int] = None,
+        retain_segments: Optional[int] = None,
+    ):
         if not topic or "/" in topic or topic.startswith("."):
             raise ValueError(f"invalid topic name: {topic!r}")
+        if segment_bytes is not None and segment_bytes < 1:
+            raise ValueError("segment_bytes must be >= 1")
+        if retain_segments is not None and retain_segments < 1:
+            raise ValueError("retain_segments must be >= 1")
         self.dir = journal_dir
         self.topic = topic
+        self.segment_bytes = segment_bytes
+        self.retain_segments = retain_segments
         os.makedirs(journal_dir, exist_ok=True)
-        self.path = os.path.join(journal_dir, f"{topic}.log")
+        self.path = os.path.join(journal_dir, f"{topic}.log")  # base-0 segment
         self._lock = threading.Lock()
+        self.expired_bytes_skipped = 0  # consumer-side observability
+        self.torn_bytes_skipped = 0     # newline-less tails of sealed segments
+        self._seg_cache: Optional[List[Tuple[int, str]]] = None
 
-    # -- producer side -----------------------------------------------------
+    # -- segment layout ------------------------------------------------------
+
+    def _segments(self) -> List[Tuple[int, str]]:
+        """Sorted [(base_offset, path)] of existing segments."""
+        prefix = f"{self.topic}.log"
+        out: List[Tuple[int, str]] = []
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if name == prefix:
+                out.append((0, os.path.join(self.dir, name)))
+            elif name.startswith(prefix + "."):
+                suffix = name[len(prefix) + 1:]
+                try:
+                    out.append((int(suffix), os.path.join(self.dir, name)))
+                except ValueError:
+                    continue  # unrelated file
+        out.sort()
+        return out
+
+    def _active_segment(self) -> Tuple[int, str]:
+        segs = self._segments()
+        if not segs:
+            return 0, self.path
+        return segs[-1]
+
+    def _segments_cached(self, refresh: bool = False) -> List[Tuple[int, str]]:
+        """Consumer-side segment list; one os.listdir only when the cache
+        is cold, explicitly refreshed, or the topic has no known segments
+        (a poll on the hot path must not list the whole journal dir)."""
+        if refresh or not self._seg_cache:
+            self._seg_cache = self._segments()
+        return self._seg_cache
+
+    # -- producer side -------------------------------------------------------
 
     def append(self, lines: Iterable[str], flush: bool = True) -> int:
         """Append lines; returns the end offset.  ``flush`` fsyncs — the
         analog of the producer's flushOnCheckpoint (at-least-once)."""
         with self._lock:
-            with open(self.path, "a") as f:
+            base, path = self._active_segment()
+            try:
+                size = os.path.getsize(path)
+            except FileNotFoundError:
+                size = 0
+            if (
+                self.segment_bytes is not None
+                and size >= self.segment_bytes
+            ):
+                # Seal the segment.  Two invariants are established here:
+                # (1) durability — sync()/flush=True only reach the ACTIVE
+                # segment, so the sealed one must be fsynced now or a crash
+                # could drop its page-cache tail while later segments
+                # survive; (2) newline termination — a torn tail from a
+                # crashed producer can never complete once sealed, so it
+                # is terminated into a malformed row the consumer's
+                # skip-and-count policy handles, instead of wedging every
+                # consumer at a line that never ends.
+                with open(path, "rb+") as sf:
+                    sf.seek(0, os.SEEK_END)
+                    if sf.tell() > 0:
+                        sf.seek(-1, os.SEEK_END)
+                        if sf.read(1) != b"\n":
+                            sf.write(b"\n")
+                    sf.flush()
+                    os.fsync(sf.fileno())
+                    size = sf.tell()
+                base = base + size
+                path = os.path.join(
+                    self.dir, f"{self.topic}.log.{base}"
+                )
+                self._apply_retention_locked()
+            with open(path, "a") as f:
                 for line in lines:
                     if "\n" in line:
                         raise ValueError("journal records are single lines")
@@ -47,41 +141,101 @@ class Journal:
                 f.flush()
                 if flush:
                     os.fsync(f.fileno())
-                return f.tell()
+                return base + f.tell()
+
+    def _apply_retention_locked(self) -> None:
+        if self.retain_segments is None:
+            return
+        segs = self._segments()
+        # +1: the about-to-be-created active segment counts toward the bound
+        excess = len(segs) + 1 - self.retain_segments
+        for base, path in segs[:max(excess, 0)]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     def sync(self) -> None:
-        """fsync the topic file without writing — the checkpoint-boundary
+        """fsync the active segment without writing — the checkpoint-boundary
         flush for producers appending with ``flush=False``."""
         with self._lock:
+            _, path = self._active_segment()
             try:
-                with open(self.path, "a") as f:
+                with open(path, "a") as f:
                     os.fsync(f.fileno())
             except FileNotFoundError:
                 pass
 
-    # -- consumer side -----------------------------------------------------
+    # -- consumer side -------------------------------------------------------
+
+    def start_offset(self) -> int:
+        """Earliest retained offset (0 unless retention expired segments)."""
+        segs = self._segments()
+        return segs[0][0] if segs else 0
 
     def end_offset(self) -> int:
+        base, path = self._active_segment()
         try:
-            return os.path.getsize(self.path)
+            return base + os.path.getsize(path)
         except FileNotFoundError:
-            return 0
+            return base
 
     def read_bytes_from(
         self, offset: int, max_bytes: int = 1 << 24
     ) -> Tuple[bytes, int]:
         """Poll the raw complete-lines byte chunk after ``offset`` —
         (chunk ending at its last newline, next_offset).  The zero-decode
-        variant of ``read_from`` for native bulk ingest."""
-        if not os.path.exists(self.path):
-            return b"", offset
-        with open(self.path, "rb") as f:
-            f.seek(offset)
-            chunk = f.read(max_bytes)
+        variant of ``read_from`` for native bulk ingest.  An offset inside
+        an expired segment skips forward to the earliest retained offset
+        (counted in ``expired_bytes_skipped``)."""
+        out = self._try_read(offset, max_bytes, refresh=False)
+        if out is not None and (out[0] or out[1] != offset):
+            return out
+        # nothing advanced with the cached layout: rescan once — a new
+        # segment may have been rolled, or retention may have moved the
+        # earliest base — then report whatever the fresh view yields
+        out = self._try_read(offset, max_bytes, refresh=True)
+        return out if out is not None else (b"", offset)
+
+    def _try_read(
+        self, offset: int, max_bytes: int, refresh: bool
+    ) -> Optional[Tuple[bytes, int]]:
+        segs = self._segments_cached(refresh)
+        if not segs:
+            return None
+        base, path = segs[0]
+        for b, p in reversed(segs):
+            if offset >= b:
+                base, path = b, p
+                break
+        if offset < base:  # expired by retention: reset to earliest
+            self.expired_bytes_skipped += base - offset
+            offset = base
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(offset - base)
+                chunk = f.read(max_bytes)
+        except FileNotFoundError:  # expired between scan and read
+            return None
+        sealed_end = next(
+            (b for b, _ in segs if b > base), None
+        )  # this segment is sealed iff a later one exists
         if not chunk:
+            if sealed_end is not None and offset >= base + size:
+                # end of a sealed segment: roll into the next
+                return self._try_read(sealed_end, max_bytes, False)
             return b"", offset
         last_nl = chunk.rfind(b"\n")
         if last_nl < 0:
+            if sealed_end is not None and offset - base + len(chunk) >= size:
+                # newline-less tail of a SEALED segment (e.g. sealed by an
+                # external writer): it can never complete — skip it with a
+                # counter rather than wedging at it forever.  (Rotation in
+                # append() newline-terminates before sealing, so this is
+                # the defensive path.)
+                self.torn_bytes_skipped += len(chunk)
+                return self._try_read(sealed_end, max_bytes, False)
             return b"", offset
         complete = chunk[: last_nl + 1]
         return complete, offset + len(complete)
@@ -94,5 +248,5 @@ class Journal:
         """
         complete, next_offset = self.read_bytes_from(offset, max_bytes)
         if not complete:
-            return [], offset
+            return [], next_offset
         return complete.decode("utf-8").splitlines(), next_offset

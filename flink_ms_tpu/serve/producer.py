@@ -23,8 +23,13 @@ _BATCH = 10_000
 
 
 def run(params: Params, label: str = "ALS") -> int:
+    # optional Kafka-parity log bounding: --segmentBytes rolls the topic
+    # into sealed segments, --retainSegments deletes the oldest beyond N
+    seg = params.get_int("segmentBytes", 0) or None
+    retain = params.get_int("retainSegments", 0) or None
     journal = Journal(
-        params.get_required("journalDir"), params.get_required("topic")
+        params.get_required("journalDir"), params.get_required("topic"),
+        segment_bytes=seg, retain_segments=retain,
     )
     input_path = params.get_required("input")
     n = 0
