@@ -63,6 +63,7 @@ def acquire_devices():
     backoff = float(os.environ.get("BENCH_INIT_BACKOFF_S", 10))
     probe_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", 240))
     last_err = None
+    hangs = 0
     for i in range(attempts):
         try:
             probe = subprocess.run(
@@ -76,8 +77,14 @@ def acquire_devices():
             )
         except subprocess.TimeoutExpired:
             last_err = f"backend init hung >{probe_timeout:.0f}s"
+            hangs += 1
             _log(f"[bench] init attempt {i + 1}/{attempts}: {last_err}")
-            continue  # a hang is transient by assumption: tunnel may recover
+            if hangs >= 2:
+                # a wedged transport hangs (it does not error): two hung
+                # probes already cost 2x the probe timeout — degrade now
+                # rather than burn the round's wall-clock on more
+                break
+            continue
         if probe.returncode == 0:
             # healthy backend: in-process init should take the same fast
             # path — but the tunnel can still drop in the gap, so failures
@@ -103,12 +110,16 @@ def acquire_devices():
     # degrade: the CPU backend registers independently of the accelerator
     # plugin, so it survives an accelerator init failure — but only if no
     # JAX_PLATFORMS pin excludes it (the ambient launcher export is exactly
-    # what pins the failed accelerator in the first place)
+    # what pins the failed accelerator in the first place).  Crucially the
+    # remote plugin's FACTORY must be dropped before the first backend
+    # init: jax initializes every registered plugin even for
+    # jax.devices("cpu"), and a wedged tunnel HANGS that init rather than
+    # erroring — pin_host_backend() is the difference between a degraded
+    # CPU artifact and a bench that never returns.
     os.environ.pop("JAX_PLATFORMS", None)
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass  # config already consumed; jax.devices("cpu") may still work
+    from flink_ms_tpu.parallel.mesh import pin_host_backend
+
+    pin_host_backend()
     cpu = jax.devices("cpu")
     _log(f"[bench] degrading to CPU backend after: {last_err}")
     return cpu, "cpu", last_err
@@ -371,6 +382,13 @@ def _run_all() -> dict:
     result["device_kind"] = getattr(devices[0], "device_kind", "unknown")
     if backend_error:
         result["backend_error"] = backend_error
+        if platform == "cpu" and not small:
+            # degraded artifact: cap the DEFAULT full-scale ALS config so
+            # the CPU fallback finishes in minutes, not the better part
+            # of an hour (explicit BENCH_* env still wins; small mode is
+            # already small; als_nnz in the JSON records what ran)
+            os.environ.setdefault("BENCH_NNZ", "2000000")
+            os.environ.setdefault("BENCH_ITERS", "2")
 
     try:
         if "als" in sections:
