@@ -156,7 +156,10 @@ class ServingJob:
         restart_attempts: int = 3,
         restart_delay_s: float = 10.0,
         native_server: bool = False,
+        start_from: str = "earliest",
     ):
+        if start_from not in ("earliest", "latest"):
+            raise ValueError("start_from must be earliest|latest")
         self.journal = journal
         self.state_name = state_name
         self.parse_fn = parse_fn
@@ -172,7 +175,15 @@ class ServingJob:
         self.job_id = job_id or uuid.uuid4().hex
         self.restart_attempts = restart_attempts
         self.restart_delay_s = restart_delay_s
-        self.offset = 0
+        # Kafka auto.offset.reset parity for a consumer with no committed
+        # checkpoint: earliest replays the whole retained topic, latest
+        # serves only rows published after this job came up (aligned to
+        # the last record boundary — a producer mid-append must not make
+        # the first poll start inside its torn line).  A restored
+        # checkpoint always wins (start() overwrites).
+        self.offset = (
+            journal.aligned_end_offset() if start_from == "latest" else 0
+        )
         self.parse_errors = 0
         self._stop = threading.Event()
         self._consumer_thread: Optional[threading.Thread] = None
@@ -410,6 +421,7 @@ def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
         port=params.get_int("port", 6123),
         job_id=params.get("jobId"),
         native_server=params.get_bool("nativeServer", False),
+        start_from=params.get("startFrom", "earliest"),
     )
     print(
         f"[serve] {state_name} serving topic '{journal.topic}' on port "

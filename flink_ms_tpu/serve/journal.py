@@ -180,6 +180,28 @@ class Journal:
         except FileNotFoundError:
             return base
 
+    def aligned_end_offset(self) -> int:
+        """End offset clamped to the last record boundary: a producer
+        mid-append leaves a newline-less tail that ``end_offset`` counts
+        but no reader may start inside (consumers seeded at ``latest``
+        use this so their first poll is line-aligned)."""
+        base, path = self._active_segment()
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                pos = size
+                while pos > 0:
+                    step = min(1 << 16, pos)
+                    f.seek(pos - step)
+                    chunk = f.read(step)
+                    nl = chunk.rfind(b"\n")
+                    if nl >= 0:
+                        return base + pos - step + nl + 1
+                    pos -= step
+        except FileNotFoundError:
+            pass
+        return base
+
     def read_bytes_from(
         self, offset: int, max_bytes: int = 1 << 24
     ) -> Tuple[bytes, int]:

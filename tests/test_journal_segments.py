@@ -97,3 +97,13 @@ def test_reader_skips_torn_tail_of_externally_sealed_segment(tmp_path):
     got, _ = _drain(j)
     assert got == ["good-row", "later-row"]
     assert j.torn_bytes_skipped == len("torn-no-newline")
+
+def test_aligned_end_offset_excludes_torn_tail(tmp_path):
+    j = Journal(str(tmp_path), "t")
+    end = j.append(["complete"], flush=False)
+    assert j.aligned_end_offset() == end == j.end_offset()
+    with open(j.path, "a") as f:
+        f.write("torn-mid-append")
+    assert j.end_offset() == end + len("torn-mid-append")
+    assert j.aligned_end_offset() == end  # clamped to the record boundary
+    assert Journal(str(tmp_path), "empty").aligned_end_offset() == 0

@@ -369,3 +369,25 @@ def test_fnv1a_batch_matches_scalar():
     batch = _fnv1a_batch(keys)
     for k, h in zip(keys, batch):
         assert int(h) == _fnv1a(k), k
+
+def test_start_from_latest_skips_backlog(tmp_path):
+    """--startFrom latest (auto.offset.reset=latest parity): a consumer
+    with no checkpoint serves only rows published after it came up."""
+    from flink_ms_tpu.serve.consumer import (
+        ALS_STATE, MemoryStateBackend, ServingJob, parse_als_record,
+    )
+    from flink_ms_tpu.serve.journal import Journal
+
+    bus = str(tmp_path)
+    j = Journal(bus, "m")
+    j.append(["1,U,old-row"], flush=True)
+    job = ServingJob(
+        Journal(bus, "m"), ALS_STATE, parse_als_record, MemoryStateBackend(),
+        host="127.0.0.1", port=0, poll_interval_s=0.01, start_from="latest",
+    ).start()
+    try:
+        j.append(["2,U,new-row"], flush=True)
+        assert _wait_until(lambda: job.table.get("2-U") == "new-row")
+        assert job.table.get("1-U") is None  # backlog skipped
+    finally:
+        job.stop()
