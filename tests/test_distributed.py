@@ -118,3 +118,60 @@ def test_two_process_als_train_matches_single_process(tmp_path):
     _assert_matches_local(
         tmp_path, tmp_path / "res0", users, items, ratings, iterations=3
     )
+
+@pytest.mark.slow
+def test_two_process_svm_train_matches_single_process(tmp_path):
+    """CoCoA SVM over a 2-process x 2-device DCN mesh == the same fit on a
+    4-device local mesh (chains split by the same deterministic layout,
+    deltas combined by the same psum)."""
+    rng = np.random.default_rng(3)
+    n, d, nnz_row = 200, 40, 5
+    lines = []
+    w_true = rng.normal(size=d)
+    for _ in range(n):
+        idx = np.sort(rng.choice(d, nnz_row, replace=False))
+        val = rng.normal(size=nnz_row)
+        y = 1 if val @ w_true[idx] >= 0 else -1
+        lines.append(
+            f"{y} " + " ".join(f"{j + 1}:{v}" for j, v in zip(idx, val))
+        )
+    train = tmp_path / "train.libsvm"
+    train.write_text("\n".join(lines) + "\n")
+
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = []
+    for pid in (0, 1):
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, "-m", "flink_ms_tpu.train.svm_train",
+                "--training", str(train),
+                "--blocks", "4", "--iteration", "3",
+                "--coordinatorAddress", f"127.0.0.1:{port}",
+                "--numProcesses", "2", "--processId", str(pid),
+                "--output", str(tmp_path / f"w{pid}"),
+            ],
+            env=env_base, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outputs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o
+    assert (tmp_path / "w0").exists()
+    assert not (tmp_path / "w1").exists()  # single-writer output
+
+    from flink_ms_tpu.ops.svm import SVMConfig, prepare_svm_blocked, svm_fit
+    from flink_ms_tpu.parallel.mesh import make_mesh
+
+    data = F.read_libsvm(str(train))
+    # svm_train defaults local_iterations to rows_per_block: mirror it
+    problem = prepare_svm_blocked(data, 4, seed=0)
+    cfg = SVMConfig(iterations=3, local_iterations=problem.rows_per_block,
+                    regularization=1.0)
+    local = svm_fit(data, cfg, make_mesh(4), problem=problem)
+    got = F.read_svm_model(str(tmp_path / "w0"), n_features=d)
+    np.testing.assert_allclose(got, local.weights, rtol=1e-4, atol=1e-6)
