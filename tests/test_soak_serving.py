@@ -1,0 +1,140 @@
+"""Serving-plane soak: every moving part at once, for several seconds.
+
+One serving job ingests a journal that an online-SGD loop is concurrently
+appending to (the closed loop), while reader threads hammer MGET and TOPK
+and the checkpoint timer snapshots — then the consumer process-state is
+lost mid-soak and a fresh job must restore + replay and keep serving.
+The reference's only quality story is operational (SURVEY.md §4); this is
+that story as a repeatable gate."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.core.params import Params
+from flink_ms_tpu.online import sgd as online_sgd
+from flink_ms_tpu.serve.client import QueryClient
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    ServingJob,
+    make_backend,
+    parse_als_record,
+)
+from flink_ms_tpu.serve.journal import Journal
+
+
+def _wait_until(pred, timeout=20.0, interval=0.02):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.mark.slow
+def test_serving_soak_with_restart(tmp_path):
+    rng = np.random.default_rng(0)
+    k, n_users, n_items = 4, 40, 60
+    bus = str(tmp_path / "bus")
+    j = Journal(bus, "m", segment_bytes=1 << 14, retain_segments=64)
+    rows = [
+        F.format_als_row(i, t, rng.normal(size=k))
+        for t in ("U", "I")
+        for i in range(n_users if t == "U" else n_items)
+    ]
+    rows += ["MEAN,U," + ";".join(["0.0"] * k),
+             "MEAN,I," + ";".join(["0.0"] * k)]
+    j.append(rows, flush=True)
+
+    chk = str(tmp_path / "chk")
+    job = ServingJob(
+        Journal(bus, "m"), ALS_STATE, parse_als_record,
+        make_backend("fs", chk), host="127.0.0.1", port=0,
+        poll_interval_s=0.01, checkpoint_interval_ms=200,
+    ).start()
+    assert _wait_until(lambda: len(job.table) >= len(rows))
+
+    stop = threading.Event()
+    errors: list = []
+    reads = {"mget": 0, "topk": 0}
+
+    def sgd_writer():
+        """Closed loop: continuous ratings stream -> MGET -> journal."""
+        ratings = tmp_path / "ratings.tsv"
+        recs = [(int(rng.integers(0, n_users)), int(rng.integers(0, n_items)),
+                 float(rng.uniform(1, 5))) for _ in range(3000)]
+        ratings.write_text("".join(f"{u}\t{i}\t{r}\n" for u, i, r in recs))
+        try:
+            online_sgd.run(Params.from_dict({
+                "input": str(ratings), "mode": "continuous", "interval": 50,
+                "outputMode": "journal", "journalDir": bus, "topic": "m",
+                "jobId": job.job_id, "jobManagerHost": "127.0.0.1",
+                "jobManagerPort": job.port, "queryTimeout": 30,
+                "batchSize": 16, "flushEveryUpdate": False,
+            }), stop=stop.is_set)
+        except Exception as e:  # noqa: BLE001
+            if not stop.is_set():
+                errors.append(f"sgd: {e!r}")
+
+    def reader(kind):
+        try:
+            while not stop.is_set():
+                with QueryClient("127.0.0.1", job.port, timeout_s=30) as c:
+                    for _ in range(50):
+                        if stop.is_set():
+                            return
+                        u = int(rng.integers(0, n_users))
+                        i = int(rng.integers(0, n_items))
+                        if kind == "mget":
+                            ps = c.query_states(
+                                ALS_STATE, [f"{u}-U", f"{i}-I"]
+                            )
+                            assert len(ps) == 2
+                            reads["mget"] += 1
+                        else:
+                            res = c.topk(ALS_STATE, str(u), 5)
+                            assert res is None or len(res) <= 5
+                            reads["topk"] += 1
+        except Exception as e:  # noqa: BLE001
+            if not stop.is_set():
+                errors.append(f"{kind}: {e!r}")
+
+    threads = [
+        threading.Thread(target=sgd_writer, daemon=True),
+        threading.Thread(target=reader, args=("mget",), daemon=True),
+        threading.Thread(target=reader, args=("topk",), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(4.0)
+
+    # a checkpoint must have landed under load
+    assert _wait_until(lambda: job.backend.restore(job.table) is not None)
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert reads["mget"] > 50 and reads["topk"] > 5, reads
+    job.stop()
+
+    # "process loss": a fresh job over the same checkpoint dir must restore
+    # and replay only the journal tail, then serve every key
+    job2 = ServingJob(
+        Journal(bus, "m"), ALS_STATE, parse_als_record,
+        make_backend("fs", chk), host="127.0.0.1", port=0,
+        poll_interval_s=0.01,
+    ).start()
+    try:
+        assert job2.offset > 0 or len(job2.table) > 0  # restored something
+        end = Journal(bus, "m").end_offset()
+        assert _wait_until(lambda: job2.offset >= end)
+        with QueryClient("127.0.0.1", job2.port, timeout_s=30) as c:
+            for u in range(n_users):
+                assert c.query_state(ALS_STATE, f"{u}-U") is not None
+    finally:
+        job2.stop()
