@@ -266,9 +266,18 @@ def _fill_side(
     # ratings sorted by owning entity -> contiguous per-entity runs; the
     # secondary sort by opposite slot makes each rating list's factor
     # gather walk HBM in ascending address order (contractions are
-    # order-invariant, so this only changes DMA locality)
+    # order-invariant, so this only changes DMA locality).  One argsort of
+    # a fused (row << 32 | col) key is ~4x faster than lexsort at ML-20M
+    # scale; both dimensions are dense indices so they fit the key by
+    # construction — the guard only trips on absurd (2^31 entities) inputs
     col_global = opp_perm[col_idx].astype(np.int64)
-    order_r = np.lexsort((col_global, row_idx))
+    if n_rows < (1 << 31) and col_global.size and int(col_global.max()) < (1 << 32):
+        key = (row_idx.astype(np.uint64) << np.uint64(32)) | col_global.astype(
+            np.uint64
+        )
+        order_r = np.argsort(key)
+    else:  # pragma: no cover - beyond any realistic id space
+        order_r = np.lexsort((col_global, row_idx))
     ent_start = np.searchsorted(row_idx[order_r], np.arange(n_rows + 1))
     col_sorted = col_global[order_r]
     val_sorted = vals[order_r]
