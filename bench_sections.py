@@ -128,7 +128,7 @@ def run_svm_section(devices, platform, small: bool) -> dict:
     _log(f"[bench:svm] {platform}: {sec_per_round:.4f} s/round, "
          f"{wall:.2f}s wall for {rounds} rounds, objective={hinge:.4f}")
     prefix = "svm_small" if small else "svm_rcv1"
-    return {
+    out = {
         f"{prefix}_sec_per_round": round(sec_per_round, 6),
         f"{prefix}_wall_clock_s": round(wall, 3),
         f"{prefix}_hinge_objective": round(hinge, 6),
@@ -136,6 +136,45 @@ def run_svm_section(devices, platform, small: bool) -> dict:
         f"{prefix}_blocks": K,
         f"{prefix}_examples": n,
     }
+    # CPU stand-in comparison (mirrors the ALS section's vs_baseline): the
+    # identical program on the host backend at reduced examples, scaled
+    # linearly to the full n.  >1 = the accelerator is that much faster.
+    if platform != "cpu" and os.environ.get("BENCH_SKIP_CPU") != "1":
+        try:
+            import jax
+
+            cpu_n = min(n - n % K if n > K else n, 13 * K)  # divisible by
+            # K: the padded-slot count then scales exactly with n
+            cpu_n = max(cpu_n, K)
+            cpu_data = synth_rcv1(cpu_n, d, nnz_row)
+            cpu_problem = prepare_svm_blocked(cpu_data, K)
+            # trip count is the CALL argument below; config.iterations is
+            # not part of the compiled program
+            cpu_cfg = SVMConfig(
+                local_iterations=cpu_problem.rows_per_block,
+                regularization=lam, mode="add", sigma_prime=sigma,
+            )
+            cpu_mesh = make_mesh(devices=jax.devices("cpu")[:1])
+            cpu_fit, cpu_args = compile_svm_fit(cpu_problem, cpu_cfg, cpu_mesh)
+
+            def cpu_run(r):
+                t0 = time.time()
+                w, _ = cpu_fit(jnp.asarray(r, jnp.int32), *cpu_args)
+                hard_sync(w)
+                return time.time() - t0
+
+            cpu_run(1)  # compile + warmup
+            t1, t3 = cpu_run(1), cpu_run(3)
+            # two-point protocol, same as the accelerator number: the
+            # difference strips per-call dispatch + fetch overhead
+            cpu_spr = max((t3 - t1) / 2, 1e-9) * (n / cpu_n)
+            out[f"{prefix}_vs_baseline"] = round(cpu_spr / sec_per_round, 3)
+            _log(f"[bench:svm] CPU stand-in: {cpu_spr:.3f} s/round scaled "
+                 f"-> vs_baseline {out[f'{prefix}_vs_baseline']}")
+        except Exception:
+            _log(traceback.format_exc())
+            out[f"{prefix}_baseline_error"] = traceback.format_exc(limit=3)
+    return out
 
 
 def _write_ratings_tsv(path: str, n: int, n_users: int, n_items: int,
