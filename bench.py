@@ -302,6 +302,11 @@ def run_als_section(devices, platform, small: bool) -> dict:
         "als_nnz": nnz,
         "als_rank": rank,
         "workload_skew": skew,
+        # kernel config forensics: which solver/precision/ladder produced
+        # this number (env-driven knobs, baked in at trace time)
+        "als_solver": os.environ.get("FLINK_MS_ALS_SOLVER", "auto"),
+        "als_assembly_precision": cfg.assembly_precision,
+        "als_bucket_ratio": os.environ.get("FLINK_MS_ALS_BUCKET_RATIO", "1.5"),
     }
 
     # BASELINE.json config "als-ms implicit-feedback ALS (confidence-
