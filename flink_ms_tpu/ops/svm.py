@@ -44,7 +44,7 @@ OutputDecisionFunction live client-side (SVMPredict.java:33-34,80-86).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -37,7 +37,6 @@ one that answers TOPK.
 
 from __future__ import annotations
 
-import socket
 import socketserver
 import threading
 from typing import Dict, Optional
