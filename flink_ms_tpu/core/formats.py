@@ -191,8 +191,14 @@ def read_libsvm(path: str, n_features: int = 0) -> SparseData:
 # ---------------------------------------------------------------------------
 
 def format_als_row(id_: object, factor_type: str, factors: Sequence[float]) -> str:
-    """``OutputFactor.toString`` parity (ALSImpl.scala:83-85)."""
-    return f"{id_},{factor_type},{';'.join(_fmt(f) for f in factors)}"
+    """``OutputFactor.toString`` parity (ALSImpl.scala:83-85).
+
+    ``tolist`` first: iterating a numpy row boxes one array scalar per
+    element (~3x the repr cost itself) — this formatter is the online-SGD
+    emit hot path."""
+    if isinstance(factors, np.ndarray):
+        factors = factors.tolist()
+    return f"{id_},{factor_type},{';'.join([_fmt(f) for f in factors])}"
 
 
 def parse_als_row(line: str) -> Tuple[str, str, np.ndarray]:

@@ -424,3 +424,27 @@ def test_run_with_batch_size_closed_loop(tmp_path, rng):
         ))
     finally:
         job.stop()
+
+def test_vectorized_batch_matches_sequential_no_dups(rng):
+    """A duplicate-free chunk takes the vectorized path; results must be
+    bit-comparable to per-rating process() on the same snapshot."""
+    k = 4
+    snap = {f"{u}-U": ";".join(repr(float(x)) for x in rng.normal(size=k))
+            for u in range(6)}
+    snap.update({f"{i}-I": ";".join(repr(float(x)) for x in rng.normal(size=k))
+                 for i in range(6)})
+    ratings = [(u, u, 2.0 + u) for u in range(6)]  # all keys distinct
+    for version in ("v1", "v0"):
+        seq = SGDStep(snap.get, "0;0;0;0", "0;0;0;0", learning_rate=0.1,
+                      user_reg=0.01, item_reg=0.02, version=version)
+        want = []
+        for u, i, r in ratings:
+            want.extend(seq.process(u, i, r))
+        batch = SGDStep(snap.get, "0;0;0;0", "0;0;0;0", learning_rate=0.1,
+                        user_reg=0.01, item_reg=0.02, version=version,
+                        lookup_many=lambda keys: [snap.get(k2) for k2 in keys])
+        got = batch.process_batch(ratings)
+        assert batch.vectorized_chunks == 1, "fast path did not engage"
+        # byte-identical rows: batchSize N and batchSize 1 must emit the
+        # same journal text (per-row BLAS dot + elementwise broadcast)
+        assert got == want
