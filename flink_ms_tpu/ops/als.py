@@ -72,6 +72,12 @@ class ALSConfig:
     # (6-pass bf16), "high" = 3-pass, "default" = single-pass bf16 (fastest,
     # shifts the normal equations ~1e-3 relative) — benchmark knob
     assembly_precision: str = "highest"
+    # Factor-EXCHANGE dtype: "bfloat16" halves both the all_gather bytes
+    # over ICI and the random-row gather's HBM traffic (a different lever
+    # than assembly_precision — that one changes MXU passes, this one
+    # changes the bytes moved).  Normal equations still accumulate in the
+    # solve dtype via preferred_element_type.  None = full precision.
+    exchange_dtype: Optional[str] = None
 
 
 _MIN_BUCKET_W = 8  # smallest rating-list pad width (sublane-friendly)
@@ -400,26 +406,31 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
             w = (alpha * val_c).astype(dtype)       # pads: val 0 -> w 0
             t = (1.0 + alpha * val_c).astype(dtype)  # pads: y row is zero
             yw = y * w[..., None]
-            A = jnp.einsum("rwk,rwl->rkl", yw, y, precision=precision)
+            A = jnp.einsum("rwk,rwl->rkl", yw, y, precision=precision,
+                           preferred_element_type=dtype)
         else:
-            A = jnp.einsum("rwk,rwl->rkl", y, y, precision=precision)
+            A = jnp.einsum("rwk,rwl->rkl", y, y, precision=precision,
+                           preferred_element_type=dtype)
             t = val_c.astype(dtype)                  # pads: val 0
-        b = jnp.einsum("rwk,rw->rk", y, t, precision=precision)
+        b = jnp.einsum("rwk,rw->rk", y, t, precision=precision,
+                       preferred_element_type=dtype)
         return A, b
 
     r, w = idx.shape
     k = y_all.shape[1]
-    # peak transient: the gather itself, plus the same-size yw
-    # intermediate in implicit mode (TPU dots don't fuse elementwise
-    # producers into operands)
-    transients = 2 if implicit else 1
-    need = transients * r * w * k * 4
+    # peak transient: the gather itself (at the EXCHANGE dtype's width),
+    # plus the same-size solve-dtype yw intermediate in implicit mode
+    # (TPU dots don't fuse elementwise producers into operands)
+    per_elem = y_all.dtype.itemsize + (
+        np.dtype(dtype).itemsize if implicit else 0
+    )
+    need = r * w * k * per_elem
     limit = _assembly_chunk_bytes()
     if need <= limit:
         return contract(idx, val)
     # chunked: lax.map with batch_size runs vmapped row chunks sequentially,
     # so only one chunk's transients are ever live
-    C = max(min(int(limit // (transients * w * k * 4)), r), 1)
+    C = max(min(int(limit // (w * k * per_elem)), r), 1)
 
     def one_row(args):
         A, b = contract(*(a[None] for a in args))
@@ -642,10 +653,20 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     n_i_buckets = len(problem.i.widths)
     platform = mesh.devices.flat[0].platform
 
+    exchange_dtype = (
+        jnp.dtype(config.exchange_dtype) if config.exchange_dtype else None
+    )
+
     def half_sweep(y_shard, flat):
         # y_shard: (1, opp_pb, k) this device's shard of the opposite factors
         *bucket_args, counts = flat
-        y_all = jax.lax.all_gather(y_shard[0], BLOCK_AXIS, axis=0, tiled=True)
+        y_send = y_shard[0]
+        if exchange_dtype is not None:
+            # cast BEFORE the collective: the all_gather moves half the
+            # bytes over ICI and every downstream gather reads half the
+            # bytes from HBM; accumulation stays in the solve dtype
+            y_send = y_send.astype(exchange_dtype)
+        y_all = jax.lax.all_gather(y_send, BLOCK_AXIS, axis=0, tiled=True)
         buckets = [
             (bucket_args[2 * j][0], bucket_args[2 * j + 1][0])
             for j in range(len(bucket_args) // 2)
@@ -717,6 +738,7 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         config.weighted_reg,
         str(config.dtype),
         config.assembly_precision,
+        config.exchange_dtype,
         _solver_choice(),          # env overrides are baked in at trace
         _assembly_chunk_bytes(),   # time, so they key the executable
     )
@@ -765,6 +787,7 @@ def _staging_meta(problem: "BlockedProblem", config: "ALSConfig",
         "alpha": config.alpha,
         "weighted_reg": config.weighted_reg,
         "assembly_precision": config.assembly_precision,
+        "exchange_dtype": config.exchange_dtype,
         "seed": config.seed,
         "dtype": str(np.dtype(config.dtype)),
         "init": init_id,
@@ -824,6 +847,8 @@ def load_staged(path: str, meta: dict, max_iteration: Optional[int] = None):
                 # existed were produced with hard-coded HIGHEST — backfill
                 # so they keep resuming
                 saved.setdefault("assembly_precision", "highest")
+                # ... and before the exchange_dtype field (full precision)
+                saved.setdefault("exchange_dtype", None)
                 if saved != meta:
                     continue
                 return iteration, z["user_factors"], z["item_factors"]

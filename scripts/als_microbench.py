@@ -123,6 +123,8 @@ def main():
                     help="time gather/assembly/solve phases separately")
     ap.add_argument("--solvers", default="unrolled,lax")
     ap.add_argument("--precisions", default="highest,high,default")
+    ap.add_argument("--exchange", default="f32", choices=["f32", "bf16"],
+                    help="factor-exchange dtype (bf16 halves gather bytes)")
     args = ap.parse_args()
 
     small = args.small
@@ -193,13 +195,17 @@ def main():
             cfg = A.ALSConfig(
                 num_factors=rank, iterations=1, lambda_=0.1,
                 assembly_precision=precision,
+                exchange_dtype=(
+                    "bfloat16" if args.exchange == "bf16" else None
+                ),
             )
             spi = steady(cfg)
             flops = 2 * nnz * (2 * rank * rank + 2 * rank) + (
                 n_users + n_items
             ) * (rank ** 3 / 3 + 4 * rank * rank)
             print(
-                f"solver={solver:8s} precision={precision:8s}: "
+                f"solver={solver:8s} precision={precision:8s} "
+                f"exch={args.exchange}: "
                 f"{spi * 1e3:9.2f} ms/iter  "
                 f"({flops / spi / 1e12:6.2f} TFLOP/s analytic)"
             )

@@ -29,6 +29,10 @@ run als_breakdown python scripts/als_microbench.py \
   --nnz 5000000 --users 60000 --items 12000 --rank 50 \
   --breakdown --solvers auto --precisions default
 
+run als_bf16_exchange python scripts/als_microbench.py \
+  --nnz 5000000 --users 60000 --items 12000 --rank 50 \
+  --solvers auto --precisions highest,default --exchange bf16
+
 run topk_profile python scripts/topk_profile.py --items 26000 1000000 --rank 50
 
 BENCH_SECTIONS=als,svm,serving,svmserve \

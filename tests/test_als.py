@@ -509,3 +509,24 @@ def test_implicit_halfsweep_matches_numpy_hkv(rng):
     itf_expect = hkv_halfsweep(i, u, r, uf_expect, 10)
     np.testing.assert_allclose(model.item_factors, itf_expect,
                                rtol=2e-3, atol=2e-4)
+
+def test_bf16_exchange_converges_close_to_f32(rng):
+    """exchange_dtype=bfloat16 (half the all_gather + gather bytes) must
+    train to nearly the same factors as full-precision exchange."""
+    u, i, r = _synthetic(rng, n_users=40, n_items=30)
+    k = 5
+    uf0 = rng.normal(size=(40, k)).astype(np.float32)
+    itf0 = rng.normal(size=(30, k)).astype(np.float32)
+    full = A.als_fit(u, i, r, A.ALSConfig(num_factors=k, iterations=3,
+                                          lambda_=0.1),
+                     make_mesh(2), init=(uf0, itf0))
+    bf16 = A.als_fit(u, i, r, A.ALSConfig(num_factors=k, iterations=3,
+                                          lambda_=0.1,
+                                          exchange_dtype="bfloat16"),
+                     make_mesh(2), init=(uf0, itf0))
+    # bf16 has ~3 decimal digits: same solution to ~1e-2 relative
+    np.testing.assert_allclose(bf16.user_factors, full.user_factors,
+                               rtol=5e-2, atol=5e-3)
+    r_full = A.rmse(full, u, i, r)
+    r_bf16 = A.rmse(bf16, u, i, r)
+    assert abs(r_full - r_bf16) < 0.05
