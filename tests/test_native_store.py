@@ -299,3 +299,26 @@ def test_serving_job_uses_native_bulk_ingest(tmp_path):
         assert calls and all(m == 0 for m in calls), "fast path did not run"
     finally:
         job.stop()
+
+def test_native_bulk_ingest_over_rotating_journal(tmp_path):
+    """The C++ bulk-ingest path reads through segment rolls: rows written
+    across several sealed segments all land in the store, offsets commit
+    past segment boundaries."""
+    bus = str(tmp_path / "bus")
+    j = Journal(bus, "m", segment_bytes=256)
+    rows = [F.format_als_row(i, "U", [float(i), 0.5]) for i in range(60)]
+    for s in range(0, len(rows), 10):
+        j.append(rows[s:s + 10], flush=False)
+    j.sync()
+    assert len(j._segments()) > 1, "rotation must have occurred"
+    job = ServingJob(
+        Journal(bus, "m", segment_bytes=256), ALS_STATE, parse_als_record,
+        make_backend("rocksdb", str(tmp_path / "store")),
+        host="127.0.0.1", port=0, poll_interval_s=0.01, native_server=True,
+    ).start()
+    try:
+        assert _wait_until(lambda: len(job.table) == 60)
+        assert job.table.get("59-U") == "59.0;0.5"
+        assert job.offset == j.end_offset()
+    finally:
+        job.stop()
