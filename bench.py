@@ -3,15 +3,26 @@
 MovieLens-20M scale, rank 50 — the BASELINE.md north-star config — plus
 roofline (MFU) accounting.
 
-Prints ONE JSON line:
+Prints ONE COMPACT JSON line (headline keys only):
   {"metric": "als_ml20m_sec_per_iter", "value": N, "unit": "s/iter",
-   "vs_baseline": R, "mfu": F, "platform": "...", ...extra sections...}
+   "vs_baseline": R, "mfu": F, "platform": "...", "degraded": bool, ...}
+and writes every section key to the BENCH_DETAIL.json sidecar next to this
+file.  The split exists because the round-2 driver recorded only a ~2 KB
+TAIL of stdout: the full 2.3 KB line lost its head ("{\"metric\"...",
+"platform", "backend_error") and recorded as parsed=null.  The compact
+line stays well under the tail window; the sidecar carries the rest.
 
 Failure policy (VERDICT r1 "what's weak" #1): a flaky accelerator backend
 must never cost the round its number.  Backend init is retried with backoff
 on UNAVAILABLE; on final failure the benchmark *degrades to the CPU backend*
 and the JSON line carries the captured error in "backend_error" — loud in
-the artifact, not an rc=1 traceback.
+the artifact, not an rc=1 traceback.  A degraded run does not give up on
+the chip (VERDICT r2 missing #1): between sections it re-probes the tunnel
+(cheap relay-socket fingerprint first, full subprocess jax probe only when
+the relay looks alive) and, on recovery, re-runs the ALS+SVM sections at
+FULL scale on the accelerator in a fresh subprocess (this process popped
+the remote plugin factories and cannot re-init the backend), merging the
+recovered numbers into the artifact with recovered=true.
 
 The reference publishes no numbers (BASELINE.md), so the comparison baseline
 is measured in-process: the identical XLA program on the host CPU backend
@@ -123,6 +134,153 @@ def acquire_devices():
     cpu = jax.devices("cpu")
     _log(f"[bench] degrading to CPU backend after: {last_err}")
     return cpu, "cpu", last_err
+
+
+# ---------------------------------------------------------------------------
+# mid-run tunnel recovery (degraded artifact -> accelerator artifact)
+# ---------------------------------------------------------------------------
+
+def relay_looks_wedged() -> bool:
+    """Cheap (<5 s) classifier for the loopback relay the tunneled chip sits
+    behind: a wedged relay accepts the TCP connect and immediately EOFs
+    (observed fingerprint, rounds 2-3).  True = definitely wedged/absent, so
+    the expensive jax probe can be skipped; False = worth a real probe."""
+    import socket
+
+    host = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")[0].strip()
+    if not host:
+        return True  # no tunnel configured at all
+    port = int(os.environ.get("PALLAS_AXON_RELAY_PORT", 2024))
+    try:
+        s = socket.create_connection((host, port), timeout=5)
+    except OSError:
+        return True
+    try:
+        s.settimeout(3)
+        try:
+            return s.recv(16) == b""  # instant EOF = wedge
+        except socket.timeout:
+            return False  # held the connection open: maybe healthy
+    finally:
+        s.close()
+
+
+def _accel_probe_ok(orig_env: dict, timeout_s: float) -> bool:
+    """One subprocess jax probe under the ORIGINAL env (pre-degrade caps and
+    pins must not leak in).  True iff a non-cpu backend initializes."""
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "from flink_ms_tpu.parallel.mesh import honor_platform_env;"
+             "honor_platform_env();"
+             "import jax; import sys;"
+             "sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)"],
+            timeout=timeout_s, env=orig_env, capture_output=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except Exception:
+        return False
+    return probe.returncode == 0
+
+
+ACCEL_SECTIONS = ("als", "svm")  # the only sections that run on the chip
+
+
+def try_recover_accelerator(result: dict, orig_env: dict, deadline: float,
+                            requested_sections=ACCEL_SECTIONS) -> None:
+    """If this run degraded to CPU, check whether the tunnel has come back
+    and — if so — re-run the accelerator-bound sections the operator asked
+    for (BENCH_SECTIONS ∩ {als, svm}) at full scale in a fresh subprocess,
+    merging its JSON over the degraded values.  Called between sections; a
+    successful recovery flips degraded -> false.  No-op once recovered,
+    when not degraded, past the deadline, or when no accelerator-bound
+    section was requested."""
+    import subprocess
+
+    if not result.get("degraded") or result.get("recovered"):
+        return
+    sections = [s for s in ACCEL_SECTIONS if s in requested_sections]
+    if not sections:
+        return
+    if time.time() > deadline:
+        return
+    if relay_looks_wedged():
+        return
+    _log("[bench] relay answered — probing accelerator for mid-run recovery")
+    if not _accel_probe_ok(orig_env, float(
+            os.environ.get("BENCH_INIT_TIMEOUT_S", 240))):
+        _log("[bench] recovery probe failed; staying degraded")
+        return
+    budget = float(os.environ.get("BENCH_RECOVER_TIMEOUT_S", 2400))
+    budget = max(min(budget, deadline - time.time() + 600), 300)
+    _log(f"[bench] accelerator is back — re-running {'+'.join(sections)} "
+         f"in a subprocess (budget {budget:.0f}s)")
+    env = dict(orig_env)
+    env["BENCH_INIT_ATTEMPTS"] = "2"
+    try:
+        sub = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sections-json",
+             ",".join(sections)],
+            timeout=budget, env=env, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        result["recovery_error"] = f"recovery subprocess hit {budget:.0f}s cap"
+        _log("[bench] " + result["recovery_error"])
+        return
+    for line in (sub.stderr or "").splitlines():
+        _log("[recover] " + line)
+    try:
+        sub_json = json.loads((sub.stdout or "").strip().splitlines()[-1])
+    except Exception:
+        result["recovery_error"] = (
+            f"recovery rc={sub.returncode}, unparseable stdout"
+        )
+        _log("[bench] " + result["recovery_error"])
+        return
+    # acceptance mirrors the normal artifact's section-isolation policy:
+    # the HEADLINE must have run on the accelerator; soft per-subsection
+    # *_error keys (implicit mode, quality anchor, ...) ride along exactly
+    # as they would in a healthy run
+    if (sub.returncode != 0 or sub_json.get("platform") == "cpu"
+            or sub_json.get("degraded")
+            or ("als" in sections and sub_json.get("value") is None)):
+        result["recovery_error"] = (
+            f"recovery rc={sub.returncode}, "
+            f"platform={sub_json.get('platform')}, "
+            f"value={sub_json.get('value')}"
+        )
+        _log("[bench] " + result["recovery_error"])
+        return
+    # the degraded ALS/SVM keys are overwritten by accelerator values; the
+    # serving sections are host-side planes either way, so the artifact's
+    # headline platform is the recovered one.  Stale error keys from the
+    # degraded attempt must not survive into a recovered artifact.
+    result["backend_error_initial"] = result.pop("backend_error", None)
+    result.pop("degraded_skipped_config", None)
+    for k in [k for k in result
+              if k.endswith("_error") and k.startswith(ACCEL_SECTIONS)]:
+        del result[k]
+    result.update(sub_json)
+    result["degraded"] = False
+    result["recovered"] = True
+    _log("[bench] mid-run recovery succeeded: headline sections re-ran on "
+         + str(sub_json.get("platform")))
+
+
+def run_sections_json(sections: str) -> None:
+    """`bench.py --sections-json als,svm`: run only the named sections and
+    print their FULL merged JSON (one line, stdout) — the recovery
+    subprocess entry point.  rc=0 when a backend initialized and the run
+    completed; per-subsection *_error keys are soft (same policy as the
+    normal artifact) and the CALLER judges the headline keys."""
+    real_stdout = sys.stdout
+    with contextlib.redirect_stdout(sys.stderr):
+        os.environ["BENCH_SECTIONS"] = sections
+        result = _run_all(recovery_enabled=False)
+    print(json.dumps(result), file=real_stdout, flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -325,6 +483,16 @@ def run_als_section(devices, platform, small: bool) -> dict:
             _log(traceback.format_exc())
             out["als_implicit_error"] = traceback.format_exc(limit=3)
 
+    # quality anchor: the timed config's convergence, full scale + parity
+    # delta vs the f64 reference (skippable: BENCH_SKIP_QUALITY=1)
+    if os.environ.get("BENCH_SKIP_QUALITY") != "1":
+        try:
+            out.update(als_quality_anchor(
+                mesh, problem, users, items, ratings, cfg, iters))
+        except Exception:
+            _log(traceback.format_exc())
+            out["als_quality_error"] = traceback.format_exc(limit=3)
+
     # BASELINE.json config "flink-als explicit ALS rank=10 on
     # MovieLens-100K (single-node CPU)": the reference's own smallest
     # config shape, timed on one host-CPU device as the single-node
@@ -349,25 +517,185 @@ def run_als_section(devices, platform, small: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# ALS quality anchor (VERDICT r3 #3): the north star is faster *at identical
+# RMSE* — record the timed config's train RMSE, and its delta vs a float64
+# reference solve on the same data + init at a capped parity scale
+# ---------------------------------------------------------------------------
+
+def run_rmse_ref(npz_path: str) -> None:
+    """`bench.py --rmse-ref problem.npz`: float64 CPU reference fit.
+
+    Runs in a subprocess because float64 needs jax_enable_x64, which must
+    not leak into the benchmark process (it changes promotion semantics
+    everywhere).  The caller sets JAX_ENABLE_X64=1, JAX_PLATFORMS=cpu and
+    blanks the tunnel env.  Prints one JSON line {"rmse_ref": x}."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ms_tpu.ops.als import ALSConfig, als_fit, rmse
+    from flink_ms_tpu.parallel.mesh import make_mesh, pin_host_backend
+
+    pin_host_backend()
+    assert jax.config.jax_enable_x64, "--rmse-ref requires JAX_ENABLE_X64=1"
+    d = np.load(npz_path)
+    cfg = ALSConfig(
+        num_factors=int(d["k"]), iterations=int(d["iters"]),
+        lambda_=float(d["lam"]), dtype=jnp.float64,
+        assembly_precision="highest", exchange_dtype=None,
+    )
+    os.environ["FLINK_MS_ALS_SOLVER"] = "unrolled"  # the spec-tested solver
+    mesh = make_mesh(devices=jax.devices("cpu")[:1])
+    model = als_fit(
+        d["users"], d["items"], d["ratings"], cfg, mesh,
+        init=(d["u0"].astype(np.float64), d["i0"].astype(np.float64)),
+    )
+    val = rmse(model, d["users"], d["items"], d["ratings"])
+    print(json.dumps({"rmse_ref": val}), flush=True)
+
+
+def als_quality_anchor(mesh, problem, users, items, ratings, cfg_base,
+                       iters: int) -> dict:
+    """-> {als_rmse_at_iters, als_rmse_ref_delta, ...}.
+
+    als_rmse_at_iters: train RMSE of the TIMED configuration after the
+    timed iteration count at full scale — the number that would move if a
+    solver/precision/exchange default silently regressed convergence.
+
+    als_rmse_ref_delta: relative RMSE gap, bench config vs the float64
+    reference solve (same data slice, same init, equal iterations) at a
+    capped parity scale (BENCH_RMSE_REF_NNZ; a full-scale f64 CPU fit
+    would cost the round minutes for no extra signal)."""
+    import dataclasses
+    import subprocess
+    import tempfile
+
+    from flink_ms_tpu.ops.als import ALSConfig, als_fit, prepare_blocked, rmse
+
+    out = {}
+    k = cfg_base.num_factors
+    t0 = time.time()
+    cfg_n = dataclasses.replace(cfg_base, iterations=iters)
+    model = als_fit(users, items, ratings, cfg_n, mesh, problem=problem)
+    out["als_rmse_at_iters"] = round(rmse(model, users, items, ratings), 6)
+    out["als_rmse_iters"] = iters
+    _log(f"[bench] train RMSE after {iters} iters: "
+         f"{out['als_rmse_at_iters']} ({time.time() - t0:.1f}s)")
+
+    if os.environ.get("BENCH_SKIP_CPU") == "1":
+        return out
+    ref_nnz = min(int(os.environ.get("BENCH_RMSE_REF_NNZ", 1_000_000)),
+                  len(ratings))
+    iters_p = min(iters, int(os.environ.get("BENCH_RMSE_REF_ITERS", 3)))
+    ru, ri, rr = users[:ref_nnz], items[:ref_nnz], ratings[:ref_nnz]
+    p_bench = prepare_blocked(ru, ri, rr, mesh.devices.size)
+    rng = np.random.default_rng(cfg_base.seed)
+    init = (0.1 * rng.standard_normal((p_bench.n_users, k)),
+            0.1 * rng.standard_normal((p_bench.n_items, k)))
+    cfg_p = dataclasses.replace(cfg_n, iterations=iters_p)
+    t0 = time.time()
+    m_bench = als_fit(ru, ri, rr, cfg_p, mesh, problem=p_bench, init=init)
+    rmse_bench = rmse(m_bench, ru, ri, rr)
+    _log(f"[bench] parity fit (bench cfg, {ref_nnz} nnz, {iters_p} iters): "
+         f"RMSE {rmse_bench:.6f} ({time.time() - t0:.1f}s)")
+
+    with tempfile.TemporaryDirectory(prefix="bench_rmse_") as td:
+        npz = os.path.join(td, "problem.npz")
+        np.savez(npz, users=ru, items=ri, ratings=rr, u0=init[0], i0=init[1],
+                 k=k, lam=cfg_base.lambda_, iters=iters_p)
+        env = dict(os.environ)
+        env.update(JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")  # host-pinned: the reference
+        # solve must complete even while the accelerator tunnel is wedged
+        t0 = time.time()
+        sub = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rmse-ref", npz],
+            capture_output=True, text=True, env=env,
+            timeout=float(os.environ.get("BENCH_RMSE_REF_TIMEOUT_S", 900)),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    if sub.returncode != 0:
+        raise RuntimeError(
+            f"rmse-ref subprocess rc={sub.returncode}: {sub.stderr[-800:]}"
+        )
+    rmse_ref = json.loads(sub.stdout.strip().splitlines()[-1])["rmse_ref"]
+    out["als_rmse_ref_delta"] = round((rmse_bench - rmse_ref) / rmse_ref, 6)
+    out["als_rmse_ref_nnz"] = ref_nnz
+    _log(f"[bench] f64 reference RMSE {rmse_ref:.6f} "
+         f"({time.time() - t0:.1f}s) -> delta {out['als_rmse_ref_delta']}")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
 
+_DETAIL_PATH = os.environ.get("BENCH_DETAIL_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+)
+
+# stdout-artifact keys, in emit order.  Everything else lives only in the
+# sidecar.  Budget: the driver's observed stdout-tail window is ~2 KB; this
+# set renders well under half of it at realistic values.
+_COMPACT_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "mfu", "platform", "n_devices",
+    "als_nnz", "als_rank", "als_tflops_per_sec", "als_solver",
+    "als_rmse_at_iters", "als_rmse_ref_delta",
+    "svm_rcv1_sec_per_round", "svm_rcv1_vs_baseline", "svm_secs_to_target",
+    "serving_mget_p50_ms", "serving_topk_p50_ms", "serving_shard_mget_p50_ms",
+    "mse_live_value", "degraded", "recovered",
+)
+
+
+def emit_artifact(result: dict) -> str:
+    """Write the full result to the BENCH_DETAIL.json sidecar and return the
+    compact single-line JSON for stdout (see module docstring for why the
+    stdout artifact must stay small)."""
+    try:
+        with open(_DETAIL_PATH, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        result["detail"] = os.path.basename(_DETAIL_PATH)
+    except OSError as e:
+        result["detail"] = f"unwritable: {e}"
+    compact = {k: result[k] for k in _COMPACT_KEYS if k in result}
+    err_keys = sorted(k for k in result if k.endswith("_error"))
+    if err_keys:
+        compact["section_errors"] = err_keys
+    if result.get("backend_error"):
+        compact["backend_error"] = str(result["backend_error"])[:100]
+    compact["detail"] = result["detail"]
+    line = json.dumps(compact)
+    if len(line) > 1800:  # belt-and-braces: never outgrow the tail window
+        for k in ("section_errors", "backend_error", "als_solver",
+                  "serving_shard_mget_p50_ms", "serving_topk_p50_ms"):
+            compact.pop(k, None)
+        line = json.dumps(compact)
+    return line
+
+
 def main() -> None:
-    # stdout is the artifact: exactly ONE JSON line.  Section code calls
-    # CLI mains in-process (producer, SGD, MSE) whose job summaries print
-    # to stdout — reroute everything but the final JSON to stderr.
+    # stdout is the artifact: exactly ONE compact JSON line.  Section code
+    # calls CLI mains in-process (producer, SGD, MSE) whose job summaries
+    # print to stdout — reroute everything but the final line to stderr.
     real_stdout = sys.stdout
     with contextlib.redirect_stdout(sys.stderr):
         result = _run_all()
-    print(json.dumps(result), file=real_stdout)
+        line = emit_artifact(result)
+    print(line, file=real_stdout, flush=True)
 
 
-def _run_all() -> dict:
+def _run_all(recovery_enabled: bool = True) -> dict:
     small = os.environ.get("BENCH_SMALL") == "1"
     sections = os.environ.get(
         "BENCH_SECTIONS", "als,svm,serving,svmserve"
     ).split(",")
     result: dict = {}
+    # the pre-degrade environment: recovery subprocesses must see the
+    # operator's config, not the caps/pins the degrade path writes below
+    orig_env = dict(os.environ)
+    deadline = time.time() + float(
+        os.environ.get("BENCH_RECOVER_DEADLINE_S", 3000)
+    )
 
     from flink_ms_tpu.parallel.mesh import honor_platform_env
 
@@ -379,7 +707,7 @@ def _run_all() -> dict:
         _log(traceback.format_exc())
         return {
             "metric": "als_ml20m_sec_per_iter", "value": None,
-            "unit": "s/iter", "vs_baseline": None,
+            "unit": "s/iter", "vs_baseline": None, "degraded": True,
             "backend_error": f"no backend at all: {e}",
         }
     result["platform"] = platform
@@ -387,11 +715,19 @@ def _run_all() -> dict:
     result["device_kind"] = getattr(devices[0], "device_kind", "unknown")
     if backend_error:
         result["backend_error"] = backend_error
+        result["degraded"] = True
         if platform == "cpu" and not small:
             # degraded artifact: cap the DEFAULT full-scale ALS config so
             # the CPU fallback finishes in minutes, not the better part
             # of an hour (explicit BENCH_* env still wins; small mode is
-            # already small; als_nnz in the JSON records what ran)
+            # already small).  The config this run therefore did NOT
+            # measure is recorded explicitly — a degraded artifact must
+            # name the question it failed to answer, not imply it.
+            result["degraded_skipped_config"] = {
+                "als_nnz": int(os.environ.get("BENCH_NNZ", 20_000_000)),
+                "als_iters": int(os.environ.get("BENCH_ITERS", 5)),
+                "platform_wanted": orig_env.get("JAX_PLATFORMS", "axon"),
+            }
             os.environ.setdefault("BENCH_NNZ", "2000000")
             os.environ.setdefault("BENCH_ITERS", "2")
 
@@ -403,15 +739,24 @@ def _run_all() -> dict:
         result["als_error"] = traceback.format_exc(limit=3)
 
     # every extra section degrades independently: a failure records its
-    # <name>_error key without costing the others their metrics
+    # <name>_error key without costing the others their metrics.  Between
+    # sections a degraded run re-probes the tunnel (cheap) and re-runs the
+    # accelerator-bound sections on recovery.
     extra = (
         ("svm", "run_svm_section", lambda f: f(devices, platform, small)),
         ("serving", "run_serving_section", lambda f: f(small)),
         ("svmserve", "run_svm_serving_section", lambda f: f(small)),
     )
     for name, fn_name, call in extra:
+        if recovery_enabled:
+            try:
+                try_recover_accelerator(result, orig_env, deadline, sections)
+            except Exception:
+                _log(traceback.format_exc())
         if name not in sections:
             continue
+        if name == "svm" and result.get("recovered"):
+            continue  # already re-ran on the accelerator
         try:
             import bench_sections
         except ImportError:
@@ -426,6 +771,11 @@ def _run_all() -> dict:
         except Exception:
             _log(traceback.format_exc())
             result[f"{name}_error"] = traceback.format_exc(limit=3)
+    if recovery_enabled:
+        try:
+            try_recover_accelerator(result, orig_env, deadline, sections)
+        except Exception:
+            _log(traceback.format_exc())
 
     if "metric" not in result:
         # headline section failed: still emit a valid, loud artifact
@@ -438,4 +788,9 @@ def _run_all() -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    if "--rmse-ref" in sys.argv:
+        run_rmse_ref(sys.argv[sys.argv.index("--rmse-ref") + 1])
+    elif "--sections-json" in sys.argv:
+        run_sections_json(sys.argv[sys.argv.index("--sections-json") + 1])
+    else:
+        main()

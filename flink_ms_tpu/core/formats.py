@@ -297,7 +297,15 @@ class RangePayloadCache:
             return hit
         idx, w = parse_svm_range_payload(payload)
         order = np.argsort(idx, kind="stable")
-        entry = (idx[order], w[order])
+        si, sw = idx[order], w[order]
+        if si.size:
+            # duplicate feature ids in one payload resolve LAST-wins, the
+            # dict-based parse semantics every other consumer has (stable
+            # sort keeps payload order within a run of equal ids, so the
+            # last element of each run is the last occurrence)
+            keep = np.concatenate([si[1:] != si[:-1], [True]])
+            si, sw = si[keep], sw[keep]
+        entry = (si, sw)
         if len(self._cache) >= self.max_entries:
             self._cache.pop(next(iter(self._cache)))
         self._cache[payload] = entry
@@ -342,13 +350,24 @@ def parse_svm_range_payload(payload: str) -> Tuple[np.ndarray, np.ndarray]:
             and (spos < cpos[1:]).all()
         )
         if structured:
-            flat = np.array(
-                stripped.replace(":", ";").split(";"), dtype=np.float64
-            )
-            idx = flat[0::2]
-            idx_i = idx.astype(np.int64)
-            if (idx_i == idx).all():
-                return idx_i, flat[1::2]
+            # the index regions must be INTEGER-shaped bytes, not merely
+            # integer-valued floats: "3.0:w" or "3e0:w" must fail here and
+            # raise on the per-token int() path below, exactly like the
+            # exact path always did (ADVICE r2).  Region [start, colon) is
+            # clean iff it contains only digits/sign — checked in one
+            # cumulative-sum pass, no per-token work.
+            digit = (buf >= ord("0")) & (buf <= ord("9"))
+            sign = (buf == ord("-")) | (buf == ord("+"))
+            bad = np.concatenate([[0], np.cumsum(~(digit | sign))])
+            starts = np.concatenate([[0], spos + 1])
+            if (bad[cpos] == bad[starts]).all():
+                flat = np.array(
+                    stripped.replace(":", ";").split(";"), dtype=np.float64
+                )
+                idx = flat[0::2]
+                idx_i = idx.astype(np.int64)
+                if (idx_i == idx).all():
+                    return idx_i, flat[1::2]
     except Exception:
         pass  # non-ascii / non-numeric: the exact path decides below
     idxs, ws = [], []

@@ -95,11 +95,30 @@ def pin_host_backend() -> None:
     try:
         from jax._src import xla_bridge as _xb
 
+        factories = getattr(_xb, "_backend_factories", None)
+        if factories is None:
+            # private attribute (known-good jax 0.4.x-0.6.x) moved in a
+            # jax upgrade — see the warning below
+            raise AttributeError("jax._src.xla_bridge._backend_factories")
         if not getattr(_xb, "_backends", None):
             for name in _remote_plugins():
-                _xb._backend_factories.pop(name, None)
+                factories.pop(name, None)
             jax.config.update("jax_platforms", "cpu")
-    except Exception:
+    except Exception as e:
+        # The jax_platforms pin alone does NOT protect against a wedged
+        # remote plugin: jax initializes every registered plugin, and a
+        # dead transport HANGS that init rather than erroring.  Losing the
+        # factory-pop path therefore degrades the wedge protection — warn
+        # loudly instead of silently (ADVICE r2).
+        import sys as _sys
+
+        print(
+            f"[mesh] pin_host_backend factory-pop failed on jax "
+            f"{jax.__version__} ({type(e).__name__}: {e}); wedged-tunnel "
+            "hang protection is INACTIVE — host-only init may block if the "
+            "remote transport is down",
+            file=_sys.stderr,
+        )
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:

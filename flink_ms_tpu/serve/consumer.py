@@ -184,6 +184,10 @@ class ServingJob:
         self.offset = (
             journal.aligned_end_offset() if start_from == "latest" else 0
         )
+        # the supervised-restart fallback replays from here when no
+        # checkpoint exists yet: a startFrom=latest job must not reset to 0
+        # and replay the whole retained backlog it was configured to skip
+        self._seed_offset = self.offset
         self.parse_errors = 0
         self._stop = threading.Event()
         self._consumer_thread: Optional[threading.Thread] = None
@@ -290,7 +294,9 @@ class ServingJob:
                     return
                 try:
                     restored = self.backend.restore(self.table)
-                    self.offset = restored if restored is not None else 0
+                    self.offset = (
+                        restored if restored is not None else self._seed_offset
+                    )
                 except Exception as re:
                     # a corrupt/missing checkpoint must not kill the
                     # supervisor thread; continue from the in-memory state

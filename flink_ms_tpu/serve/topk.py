@@ -209,13 +209,12 @@ class DeviceFactorIndex:
         if not ids or width <= 0:
             return [], np.zeros((0, 0), np.float32), None
         try:
-            import warnings
-
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore", DeprecationWarning)
-                flat = np.fromstring(
-                    ";".join(payloads), sep=";", dtype=np.float64
-                )
+            # one C-level parse of every payload (np.array over one big
+            # split — same pattern as formats.parse_svm_range_payload;
+            # np.fromstring's text mode is deprecated and its removal
+            # would have silently dropped this vectorized path into the
+            # 25x-slower per-row fallback below)
+            flat = np.array(";".join(payloads).split(";"), dtype=np.float64)
             if flat.size == len(ids) * width:
                 return ids, flat.reshape(len(ids), width).astype(np.float32), width
         except Exception:

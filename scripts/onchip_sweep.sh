@@ -35,13 +35,26 @@ run als_bf16_exchange python scripts/als_microbench.py \
 
 run topk_profile python scripts/topk_profile.py --items 26000 1000000 --rank 50
 
+# CoCoA chain-count sweep on chip (VERDICT r2 #4): the 8192-chain default
+# rests on a CPU serial-depth argument that may invert on hardware.  One
+# full SVM section per K; sec/round + rounds-to-target land in each log.
+for K in 1024 4096 8192 16384; do
+  BENCH_SECTIONS=svm BENCH_SVM_BLOCKS=$K BENCH_SKIP_CPU=1 \
+    BENCH_DETAIL_PATH="$OUT/svm_k$K.detail.json" \
+    timeout "${STEP_TIMEOUT:-1200}" python bench.py \
+    > "$OUT/svm_k$K.json" 2> "$OUT/svm_k$K.log"
+  echo "svm_k$K rc=$?" | tee -a "$OUT/sweep.log"
+done
+
 BENCH_SECTIONS=als,svm,serving,svmserve \
-  timeout "${STEP_TIMEOUT:-1200}" python bench.py \
+  BENCH_DETAIL_PATH="$OUT/bench_uniform.detail.json" \
+  timeout "${STEP_TIMEOUT:-2400}" python bench.py \
   > "$OUT/bench_uniform.json" 2> "$OUT/bench_uniform.log"
 echo "bench_uniform rc=$?" | tee -a "$OUT/sweep.log"
 
 BENCH_SKEW=zipf BENCH_SECTIONS=als \
-  timeout "${STEP_TIMEOUT:-1200}" python bench.py \
+  BENCH_DETAIL_PATH="$OUT/bench_zipf.detail.json" \
+  timeout "${STEP_TIMEOUT:-2400}" python bench.py \
   > "$OUT/bench_zipf.json" 2> "$OUT/bench_zipf.log"
 echo "bench_zipf rc=$?" | tee -a "$OUT/sweep.log"
 

@@ -29,17 +29,21 @@ def test_section_table_names_resolve():
 
 
 @pytest.mark.slow
-def test_stdout_is_exactly_one_json_line():
-    """The driver parses bench.py stdout as THE artifact; in-process CLI
-    mains (producer/SGD/MSE job summaries) must not leak onto it."""
+def test_stdout_is_exactly_one_json_line(tmp_path):
+    """The driver parses bench.py stdout as THE artifact — and records only
+    a ~2 KB TAIL of it (BENCH_r02.json lost the head of a 2.3 KB line and
+    recorded parsed=null).  So: exactly one line, parseable, COMPACT, with
+    the full section detail in the BENCH_DETAIL.json sidecar."""
     import json
     import subprocess
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    detail = tmp_path / "BENCH_DETAIL.json"
     ambient = {k: v for k, v in os.environ.items()
                if not k.startswith("BENCH_")}
     env = dict(ambient,
                BENCH_SECTIONS="als,svm,serving,svmserve",
+               BENCH_DETAIL_PATH=str(detail),
                JAX_PLATFORMS="cpu", BENCH_SMALL="1", BENCH_SKIP_CPU="1",
                BENCH_NNZ="2000", BENCH_USERS="100", BENCH_ITEMS="50",
                BENCH_RANK="4", BENCH_SVM_EXAMPLES="400",
@@ -56,8 +60,64 @@ def test_stdout_is_exactly_one_json_line():
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, f"stdout polluted: {lines[:5]}"
+    assert len(lines[0]) <= 1800, (
+        f"compact line {len(lines[0])}B outgrew the driver tail window"
+    )
     parsed = json.loads(lines[0])
     assert "metric" in parsed and "value" in parsed
+    assert "platform" in parsed  # invisible in r02's truncated artifact
+    # a JAX_PLATFORMS=cpu pin is an operator choice, not a failed backend
+    assert not parsed.get("degraded")
+    full = json.loads(detail.read_text())
+    assert parsed["detail"] == "BENCH_DETAIL.json"
+    # the sidecar is a superset of the compact line
+    for k, v in parsed.items():
+        if k not in ("detail", "section_errors", "backend_error"):
+            assert full[k] == v, k
+    assert "serving_get_p50_ms" in full  # detail-only key
+
+
+def test_emit_artifact_compact_even_when_result_is_huge(tmp_path, monkeypatch):
+    """A result dict far bigger than the driver's stdout-tail window must
+    still render to a short parseable line, with everything in the sidecar."""
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_DETAIL_PATH", str(tmp_path / "d.json"))
+    result = {"metric": "als_ml20m_sec_per_iter", "value": 1.0,
+              "unit": "s/iter", "vs_baseline": 2.0, "platform": "tpu",
+              "degraded": False}
+    result.update({f"extra_key_{i}": i * 0.123 for i in range(200)})
+    result["svm_error"] = "boom\n" * 50
+    line = bench.emit_artifact(result)
+    assert len(line) <= 1800
+    parsed = json.loads(line)
+    assert parsed["metric"] == "als_ml20m_sec_per_iter"
+    assert parsed["section_errors"] == ["svm_error"]
+    full = json.loads((tmp_path / "d.json").read_text())
+    assert full["extra_key_199"] == 199 * 0.123
+
+
+def test_recovery_gating_is_cheap_and_safe(monkeypatch):
+    """try_recover_accelerator must no-op (without probing) when the run is
+    not degraded / already recovered / past deadline, and the relay
+    classifier must call an unconfigured tunnel wedged."""
+    import time as _time
+
+    import bench
+
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    assert bench.relay_looks_wedged() is True
+
+    def boom(*a, **k):  # any probe attempt is a test failure
+        raise AssertionError("probe should not run")
+
+    monkeypatch.setattr(bench, "relay_looks_wedged", boom)
+    bench.try_recover_accelerator({}, {}, _time.time() + 100)
+    bench.try_recover_accelerator(
+        {"degraded": True, "recovered": True}, {}, _time.time() + 100)
+    bench.try_recover_accelerator({"degraded": True}, {}, _time.time() - 1)
 
 
 @pytest.mark.slow
@@ -83,3 +143,122 @@ def test_tiny_serving_section_clean(monkeypatch):
         "serving_native_mget_p50_ms", "serving_shard_mget_p50_ms",
     ):
         assert prefix in out, (prefix, sorted(out))
+    # the live MSE runs against a bounded-factor plane: predictions land in
+    # [0,5), so against 1..5 ratings the value is a bounded sanity signal
+    # (the r2 artifact recorded 9.5e154 off the heavy-tailed plane)
+    import math
+
+    assert math.isfinite(out["mse_live_value"])
+    assert 0.0 <= out["mse_live_value"] < 30.0, out["mse_live_value"]
+
+
+def test_recovery_merge_flips_degraded_and_keeps_initial_error(monkeypatch):
+    """On a successful mid-run recovery the accelerator sections overwrite
+    the degraded values, degraded flips false, and the original backend
+    error is preserved under backend_error_initial."""
+    import json as _json
+    import subprocess as _sp
+    import time as _time
+
+    import bench
+
+    monkeypatch.setattr(bench, "relay_looks_wedged", lambda: False)
+    monkeypatch.setattr(bench, "_accel_probe_ok", lambda env, t: True)
+    sub_json = {"platform": "tpu", "n_devices": 1, "value": 0.5,
+                "metric": "als_ml20m_sec_per_iter", "als_nnz": 20_000_000,
+                # soft sub-section errors must NOT veto a valid headline
+                "als_implicit_error": "soft failure, rides along"}
+
+    class FakeProc:
+        returncode = 0
+        stdout = _json.dumps(sub_json) + "\n"
+        stderr = "[bench] recovered run\n"
+
+    captured = {}
+
+    def fake_run(cmd, **kw):
+        captured["env"] = kw.get("env")
+        return FakeProc()
+
+    monkeypatch.setattr(_sp, "run", fake_run)
+    result = {"degraded": True, "backend_error": "init hung",
+              "degraded_skipped_config": {"als_nnz": 20_000_000},
+              "als_quality_error": "stale degraded-run failure",
+              "value": 4.8, "als_nnz": 2_000_000, "platform": "cpu"}
+    orig_env = {"PATH": "/usr/bin", "BENCH_ITERS": "5"}
+    # a section list without als/svm must not trigger any probe
+    bench.try_recover_accelerator(result, orig_env, _time.time() + 600,
+                                  ["serving"])
+    assert not result.get("recovered")
+    bench.try_recover_accelerator(result, orig_env, _time.time() + 600)
+    assert result["recovered"] is True and result["degraded"] is False
+    assert result["platform"] == "tpu"
+    assert result["value"] == 0.5 and result["als_nnz"] == 20_000_000
+    assert result["backend_error_initial"] == "init hung"
+    assert "backend_error" not in result
+    assert "degraded_skipped_config" not in result
+    # stale degraded-run section errors must not survive the merge, while
+    # the recovered run's own soft errors do
+    assert "als_quality_error" not in result
+    assert result["als_implicit_error"] == "soft failure, rides along"
+    # the subprocess must see the PRE-degrade environment, not the caps
+    assert captured["env"]["BENCH_ITERS"] == "5"
+    # second call is a no-op (already recovered)
+    monkeypatch.setattr(bench, "relay_looks_wedged",
+                        lambda: (_ for _ in ()).throw(AssertionError))
+    bench.try_recover_accelerator(result, orig_env, _time.time() + 600)
+
+
+def test_recovery_rejects_cpu_subprocess(monkeypatch):
+    """A recovery subprocess that itself degraded to CPU must not flip the
+    artifact to recovered."""
+    import json as _json
+    import subprocess as _sp
+    import time as _time
+
+    import bench
+
+    monkeypatch.setattr(bench, "relay_looks_wedged", lambda: False)
+    monkeypatch.setattr(bench, "_accel_probe_ok", lambda env, t: True)
+
+    class FakeProc:
+        returncode = 0
+        stdout = _json.dumps({"platform": "cpu", "value": 9.9}) + "\n"
+        stderr = ""
+
+    monkeypatch.setattr(_sp, "run", lambda cmd, **kw: FakeProc())
+    result = {"degraded": True, "backend_error": "init hung", "value": 4.8}
+    bench.try_recover_accelerator(result, {}, _time.time() + 600)
+    assert not result.get("recovered")
+    assert result["degraded"] is True and result["value"] == 4.8
+    assert "recovery_error" in result
+
+
+@pytest.mark.slow
+def test_als_quality_anchor_small(monkeypatch):
+    """The quality anchor must produce a small bench-vs-f64 RMSE delta at
+    toy scale (equal iterations, same init) and survive the x64 subprocess
+    round trip."""
+    import jax
+    import numpy as np
+
+    import bench
+    from flink_ms_tpu.ops.als import ALSConfig, prepare_blocked
+    from flink_ms_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setenv("BENCH_RMSE_REF_NNZ", "3000")
+    monkeypatch.setenv("BENCH_RMSE_REF_ITERS", "3")
+    monkeypatch.delenv("BENCH_SKIP_CPU", raising=False)
+    rng = np.random.default_rng(0)
+    users = rng.integers(0, 50, 3000)
+    items = rng.integers(0, 40, 3000)
+    ratings = rng.uniform(1, 5, 3000)
+    mesh = make_mesh(devices=jax.devices("cpu")[:1])
+    problem = prepare_blocked(users, items, ratings, 1)
+    cfg = ALSConfig(num_factors=4, iterations=1, lambda_=0.1, seed=42)
+    out = bench.als_quality_anchor(
+        mesh, problem, users, items, ratings, cfg, iters=3)
+    assert out["als_rmse_iters"] == 3
+    assert 0.0 < out["als_rmse_at_iters"] < 5.0
+    # f32 bench config vs f64 reference: sub-percent at toy scale
+    assert abs(out["als_rmse_ref_delta"]) < 0.01, out

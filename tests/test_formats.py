@@ -167,3 +167,30 @@ def test_range_payload_malformed_still_raises():
     for bad in ("5,1;2", "5,1:2:3;4", "5,:1;2:3", "5,1:2;3"):
         with pytest.raises(ValueError):
             parse_svm_range_row(bad)
+
+
+def test_float_formatted_index_rejected_like_exact_path():
+    """ADVICE r2: the fast path must agree with the per-token path on what
+    is malformed — a float-shaped index ("3.0:w", "3e0:w") raises, while
+    negative/plus-signed integer indices still take the fast path."""
+    from flink_ms_tpu.core.formats import parse_svm_range_payload
+
+    for bad in ("3.0:1.5;4:2.0", "3e0:1.5", "4:2.0;0x3:1.0"):
+        with pytest.raises(ValueError):
+            parse_svm_range_payload(bad)
+    # exponent/decimal in the VALUE region stays fast-path legal
+    idx, w = parse_svm_range_payload("3:1.5e-2;-4:2.0;+5:.25")
+    assert idx.tolist() == [3, -4, 5]
+    assert w.tolist() == [0.015, 2.0, 0.25]
+
+
+def test_range_cache_duplicate_index_last_wins():
+    """ADVICE r2: duplicate feature ids within one payload resolve to the
+    LAST occurrence — the dict-parse semantics the range client had before
+    the vectorized cache."""
+    from flink_ms_tpu.core.formats import RangePayloadCache
+
+    cache = RangePayloadCache()
+    w, hit = cache.gather("5:1.0;7:2.0;5:9.0", [5, 7])
+    assert w.tolist() == [9.0, 2.0]
+    assert hit.all()

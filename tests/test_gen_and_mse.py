@@ -37,6 +37,31 @@ def test_als_generator_parallel_parts(tmp_path):
     assert mat.shape == (10, 2)
 
 
+def test_als_generator_bounded_distribution(tmp_path):
+    """--distribution bounded: every factor entry in [0, sqrt(5/latent)),
+    so served dot products (and a live MSE against 1..5 ratings) stay in a
+    sanity-checkable range.  Both the single-process and multi-process
+    writers honor it."""
+    for parallelism in (1, 2):
+        out = str(tmp_path / f"model_{parallelism}")
+        als_model_generator.run(Params.from_args(
+            ["--numUsers", "40", "--numItems", "30", "--latentFactors", "4",
+             "--parallelism", str(parallelism), "--output", out,
+             "--distribution", "bounded"]
+        ))
+        _, _, mat = F.read_als_model(out)
+        bound = np.sqrt(5.0 / 4)
+        assert mat.shape == (70, 4)
+        assert (mat >= 0).all() and (mat < bound).all()
+        # dot products (predictions) are bounded by construction
+        assert (mat[:40] @ mat[40:].T).max() < 5.0
+    with pytest.raises(ValueError):
+        als_model_generator.run(Params.from_args(
+            ["--numUsers", "2", "--numItems", "2", "--latentFactors", "2",
+             "--output", str(tmp_path / "bad"), "--distribution", "weird"]
+        ))
+
+
 def test_svm_generator_buckets(tmp_path):
     out = str(tmp_path / "svm_model")
     svm_model_generator.run(

@@ -220,6 +220,42 @@ def test_checkpoint_restart_replays_from_offset(tmp_path):
         job.stop()
 
 
+def test_latest_restart_without_checkpoint_keeps_seed_offset(tmp_path):
+    """ADVICE r2: a startFrom=latest consumer that fails before its first
+    checkpoint must restart from the seeded end-of-journal offset, not 0 —
+    resetting to 0 replays the whole backlog the job was configured to
+    skip."""
+    journal = Journal(str(tmp_path / "j"), "t")
+    journal.append(
+        [F.format_als_row(i, "U", [1.0]) for i in range(10)]
+    )  # pre-existing backlog this job must never serve
+    job = ServingJob(
+        journal, ALS_STATE, parse_als_record, MemoryStateBackend(),
+        host="127.0.0.1", port=0, poll_interval_s=0.01,
+        restart_delay_s=0.05, start_from="latest",
+    )
+    original = journal.read_from
+    calls = {"n": 0}
+
+    def flaky(offset, max_bytes=1 << 24):
+        if calls["n"] == 0:
+            calls["n"] += 1
+            raise OSError("injected failure")
+        return original(offset, max_bytes)
+
+    journal.read_from = flaky
+    job.start()
+    try:
+        journal.append([F.format_als_row(99, "U", [4.2])])
+        assert _wait_until(lambda: job.table.get("99-U") is not None,
+                           timeout=15)
+        assert calls["n"] == 1  # the failure (and restart) really happened
+        assert job.table.get("0-U") is None, "skipped backlog was replayed"
+        assert len(job.table) == 1
+    finally:
+        job.stop()
+
+
 def test_restart_budget_exhaustion_stops_job(tmp_path):
     journal = Journal(str(tmp_path / "j"), "t")
     job = ServingJob(
