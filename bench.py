@@ -412,7 +412,15 @@ def run_als_section(devices, platform, small: bool) -> dict:
 
     skew = os.environ.get("BENCH_SKEW", "") or "uniform"
     users, items, ratings = synth_ratings(n_users, n_items, nnz)
-    cfg = ALSConfig(num_factors=rank, iterations=1, lambda_=0.1, seed=42)
+    # kernel-config A/B knobs (the solver knob is FLINK_MS_ALS_SOLVER, read
+    # inside the kernel): the on-chip sweep flips these per run, and the
+    # quality anchor inherits them so a flipped default is convergence-
+    # checked in the same artifact that times it
+    cfg = ALSConfig(
+        num_factors=rank, iterations=1, lambda_=0.1, seed=42,
+        assembly_precision=os.environ.get("BENCH_ALS_PRECISION", "highest"),
+        exchange_dtype=os.environ.get("BENCH_ALS_EXCHANGE") or None,
+    )
     mesh = make_mesh(devices=devices)
     _log(f"[bench] ALS devices: {devices}, nnz={nnz}, rank={rank}")
 
@@ -474,8 +482,9 @@ def run_als_section(devices, platform, small: bool) -> dict:
     # section would double the quick run's wall-clock.
     if not small:
         try:
-            cfg_imp = ALSConfig(num_factors=rank, iterations=1, lambda_=0.1,
-                                seed=42, implicit=True, alpha=40.0)
+            import dataclasses as _dc
+
+            cfg_imp = _dc.replace(cfg, implicit=True, alpha=40.0)
             spi_imp = time_fit(mesh, problem, cfg_imp, iters)
             out["als_implicit_sec_per_iter"] = round(spi_imp, 6)
             _log(f"[bench] implicit mode: {spi_imp:.3f} s/iter")
