@@ -390,15 +390,24 @@ def _assembly_chunk_bytes() -> int:
 
 
 def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
-                       precision):
+                       precision, post=None, extra=None):
     """One bucket's (A, b): gather the opposite factors for each row's
     rating list and contract over the rating axis on the MXU.
 
     No mask arrays exist: pad entries gather the opposite side's dummy
     slot, whose factor row is zero by construction, so every pad term
     vanishes through y itself (explicit A needs no weighting at all —
-    one fewer (r, w, k) transient and multiply on the hot path)."""
-    def contract(idx_c, val_c):
+    one fewer (r, w, k) transient and multiply on the hot path).
+
+    ``post`` (fused mode): a per-chunk (A, b, extra_chunk) -> out stage
+    applied INSIDE each lax.map chunk — the fused assembly+solve path
+    hands the solve in here so the bucket's (rows, k, k) normal equations
+    never exist beyond one chunk's transient.  ``extra`` is an optional
+    (rows, ...) operand sliced alongside idx/val (the per-slot counts).
+    Chunking is over the batch row axis only (the contraction axis w is
+    untouched), so chunked and unchunked results are arithmetically
+    identical per row."""
+    def compute(idx_c, val_c, extra_c):
         y = jnp.take(y_all, idx_c, axis=0)                   # (r, w, k)
         # HIGHEST keeps f32 products (bf16 single-pass shifts the normal
         # equations enough to slow convergence at small lambda)
@@ -414,29 +423,39 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
             t = val_c.astype(dtype)                  # pads: val 0
         b = jnp.einsum("rwk,rw->rk", y, t, precision=precision,
                        preferred_element_type=dtype)
-        return A, b
+        if post is None:
+            return A, b
+        return post(A, b, extra_c)
 
     r, w = idx.shape
     k = y_all.shape[1]
     # peak transient: the gather itself (at the EXCHANGE dtype's width),
     # plus the same-size solve-dtype yw intermediate in implicit mode
     # (TPU dots don't fuse elementwise producers into operands)
-    per_elem = y_all.dtype.itemsize + (
-        np.dtype(dtype).itemsize if implicit else 0
+    row_bytes = w * k * (
+        y_all.dtype.itemsize
+        + (np.dtype(dtype).itemsize if implicit else 0)
     )
-    need = r * w * k * per_elem
+    if post is not None:
+        # the fused solve holds the chunk's (C, k, k) system plus
+        # factorization intermediates in the same transient budget
+        row_bytes += 3 * k * k * np.dtype(dtype).itemsize
+    need = r * row_bytes
     limit = _assembly_chunk_bytes()
+    operands = (idx, val) if extra is None else (idx, val, extra)
     if need <= limit:
-        return contract(idx, val)
+        return compute(idx, val, extra)
     # chunked: lax.map with batch_size runs vmapped row chunks sequentially,
     # so only one chunk's transients are ever live
-    C = max(min(int(limit // (w * k * per_elem)), r), 1)
+    C = max(min(int(limit // row_bytes), r), 1)
 
     def one_row(args):
-        A, b = contract(*(a[None] for a in args))
-        return A[0], b[0]
+        idx_r, val_r = args[0], args[1]
+        extra_r = args[2][None] if extra is not None else None
+        out = compute(idx_r[None], val_r[None], extra_r)
+        return jax.tree.map(lambda t: t[0], out)
 
-    return jax.lax.map(one_row, (idx, val), batch_size=C)
+    return jax.lax.map(one_row, operands, batch_size=C)
 
 
 def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
@@ -588,6 +607,15 @@ def _solver_choice() -> str:
     return os.environ.get("FLINK_MS_ALS_SOLVER", "auto")
 
 
+def _fused_solve() -> bool:
+    """FLINK_MS_ALS_FUSED=1: solve each bucket chunk inside the assembly
+    lax.map, so the (per_block, k, k) normal-equation tensor never
+    materializes (the roofline's dominant HBM term, BASELINE.md) and the
+    half-sweep's peak transient stops scaling with the catalog size —
+    required for the 10M-user scale envelope, opt-in until chip-validated."""
+    return os.environ.get("FLINK_MS_ALS_FUSED", "0") == "1"
+
+
 def _chol_solve(A, b, platform: Optional[str] = None):
     k = A.shape[-1]
     choice = _solver_choice()
@@ -671,14 +699,42 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
             (bucket_args[2 * j][0], bucket_args[2 * j + 1][0])
             for j in range(len(bucket_args) // 2)
         ]
+        yty = None
+        if implicit:
+            yty = jax.lax.psum(
+                jnp.einsum("nk,nm->km", y_shard[0], y_shard[0]), BLOCK_AXIS
+            )
+        if _fused_solve():
+            # per-bucket fused assembly+solve: bucket outputs are
+            # contiguous slot ranges, so each bucket's factor rows are
+            # solved straight out of its assembly chunks and concatenated
+            # in slot order — the full (per_block, k, k) tensor never
+            # exists.  The block's guaranteed dummy last slot gets its
+            # zero row appended explicitly (the unfused path routes it
+            # through a zero system + count mask).
+            def solve_chunk(A, bb, cnt):
+                if yty is not None:
+                    A = A + yty[None, :, :]
+                return _solve_factors(A, bb, cnt, lam, weighted, dtype,
+                                      platform)
+
+            xs = []
+            off = 0
+            for idx_b, val_b in buckets:
+                rows_j = idx_b.shape[0]
+                xs.append(_bucket_normal_eqs(
+                    y_all, idx_b, val_b, implicit, alpha, dtype,
+                    config.assembly_precision,
+                    post=solve_chunk, extra=counts[0][off:off + rows_j],
+                ))
+                off += rows_j
+            xs.append(jnp.zeros((1, k), dtype))
+            return jnp.concatenate(xs, axis=0)[None]
         A, b = _assemble_normal_eqs(
             y_all, buckets, implicit, alpha, dtype,
             precision=config.assembly_precision,
         )
         if implicit:
-            yty = jax.lax.psum(
-                jnp.einsum("nk,nm->km", y_shard[0], y_shard[0]), BLOCK_AXIS
-            )
             A = A + yty[None, :, :]
         x = _solve_factors(A, b, counts[0], lam, weighted, dtype, platform)
         return x[None]  # (1, per_block, k)
@@ -741,6 +797,7 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         config.exchange_dtype,
         _solver_choice(),          # env overrides are baked in at trace
         _assembly_chunk_bytes(),   # time, so they key the executable
+        _fused_solve(),
     )
     fn = _SWEEP_CACHE.pop(key, None)
     if fn is None:

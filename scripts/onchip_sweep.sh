@@ -33,6 +33,13 @@ run als_bf16_exchange python scripts/als_microbench.py \
   --nnz 5000000 --users 60000 --items 12000 --rank 50 \
   --solvers auto --precisions highest,default --exchange bf16
 
+# fused assembly+solve (FLINK_MS_ALS_FUSED=1): the (n,k,k) tensor never
+# hits HBM — the roofline's dominant term.  26% faster on CPU; expected
+# larger on chip.  Solver matrix again under fusion.
+FLINK_MS_ALS_FUSED=1 run als_fused python scripts/als_microbench.py \
+  --nnz 5000000 --users 60000 --items 12000 --rank 50 \
+  --solvers unrolled,panel,lax,pallas --precisions highest,default
+
 run topk_profile python scripts/topk_profile.py --items 26000 1000000 --rank 50
 
 # CoCoA chain-count sweep on chip (VERDICT r2 #4): the 8192-chain default

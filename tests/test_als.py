@@ -206,6 +206,56 @@ def test_chunked_assembly_matches_unchunked(rng, monkeypatch):
     )
 
 
+def test_fused_solve_matches_unfused(rng, monkeypatch):
+    """FLINK_MS_ALS_FUSED=1 solves each bucket straight out of its
+    assembly chunks (the (per_block, k, k) tensor never materializes);
+    multi-block factors must match the unfused path — chunking is over
+    the batch row axis only, so the per-row arithmetic is identical."""
+    u, i, r = _synthetic(rng, n_users=60, n_items=45, k_true=3, noise=0.05)
+    k = 5
+    uf0 = rng.normal(size=(60, k)).astype(np.float32)
+    itf0 = rng.normal(size=(45, k)).astype(np.float32)
+    cfg = A.ALSConfig(num_factors=k, iterations=3, lambda_=0.1)
+    mesh = make_mesh()
+    plain = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    monkeypatch.setenv("FLINK_MS_ALS_FUSED", "1")
+    fused = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    np.testing.assert_allclose(
+        fused.user_factors, plain.user_factors, rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        fused.item_factors, plain.item_factors, rtol=1e-4, atol=1e-6
+    )
+    # fused + forced lax.map chunking (the scale-envelope configuration)
+    monkeypatch.setenv("FLINK_MS_ALS_ASSEMBLY_CHUNK_BYTES", "2048")
+    fused_c = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    np.testing.assert_allclose(
+        fused_c.user_factors, plain.user_factors, rtol=1e-4, atol=1e-6
+    )
+
+
+def test_fused_solve_matches_unfused_implicit(rng, monkeypatch):
+    """Fused mode in implicit/HKV mode: the psum'd Gramian is added per
+    chunk instead of to the materialized tensor — same factors."""
+    u, i, r = _synthetic(rng, n_users=40, n_items=30, k_true=3)
+    r = np.abs(r)  # implicit confidence weights are nonnegative counts
+    k = 4
+    uf0 = rng.normal(size=(40, k)).astype(np.float32)
+    itf0 = rng.normal(size=(30, k)).astype(np.float32)
+    cfg = A.ALSConfig(num_factors=k, iterations=2, lambda_=0.1,
+                      implicit=True, alpha=10.0)
+    mesh = make_mesh(4)
+    plain = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    monkeypatch.setenv("FLINK_MS_ALS_FUSED", "1")
+    fused = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    np.testing.assert_allclose(
+        fused.user_factors, plain.user_factors, rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        fused.item_factors, plain.item_factors, rtol=1e-4, atol=1e-6
+    )
+
+
 def test_skewed_degrees_match_numpy(rng):
     """Power-law degree distribution (one super-popular item, many
     degree-1 users — the ML-20M shape) must bucket correctly: one
