@@ -235,6 +235,32 @@ def test_recovery_rejects_cpu_subprocess(monkeypatch):
 
 
 @pytest.mark.slow
+def test_sections_json_entry_point(tmp_path):
+    """`bench.py --sections-json svm` (the recovery subprocess entry
+    point): full JSON on the last stdout line, platform recorded, no
+    sidecar writing (that's the parent's job)."""
+    import json
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ambient = {k: v for k, v in os.environ.items()
+               if not k.startswith("BENCH_")}
+    env = dict(ambient, JAX_PLATFORMS="cpu", BENCH_SMALL="1",
+               BENCH_SKIP_CPU="1", BENCH_SVM_EXAMPLES="400",
+               BENCH_SVM_FEATURES="60", BENCH_SVM_ROUNDS="2",
+               BENCH_DETAIL_PATH=str(tmp_path / "should_not_exist.json"))
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--sections-json", "svm"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    parsed = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert parsed["platform"] == "cpu"
+    assert "svm_small_sec_per_round" in parsed
+    assert not (tmp_path / "should_not_exist.json").exists()
+
+
+@pytest.mark.slow
 def test_als_quality_anchor_small(monkeypatch):
     """The quality anchor must produce a small bench-vs-f64 RMSE delta at
     toy scale (equal iterations, same init) and survive the x64 subprocess
