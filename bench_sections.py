@@ -721,32 +721,57 @@ def run_serving_section(small: bool) -> dict:
                 if store is not None:
                     store.close()
 
-        # 8. sharded plane (ALSKafkaConsumer.java:85-92 scale-out): W
-        # workers each own a hash slice of the same journal; the client
-        # routes MGET to owners and fans TOPK out with a score merge
-        sjobs = []
+        # 8. sharded plane (ALSKafkaConsumer.java:85-92 scale-out): W REAL
+        # worker PROCESSES — the deployment shape, one process per shard
+        # (`python -m flink_ms_tpu.serve.sharded`) — each owning a hash
+        # slice of the same journal; the client routes MGET to owners and
+        # fans TOPK out with a score merge.  Rounds 1-2 ran the workers
+        # in-process, which shared one GIL + one XLA runtime and therefore
+        # serialized the TOPKV fan-out; process workers measure the plane
+        # the docs/tests actually claim.  Ingest barrier via the COUNT
+        # verb (shards are disjoint, so the sum is the table size).
+        procs = []
         try:
             from flink_ms_tpu.serve.sharded import (
                 ShardedQueryClient,
-                run_worker,
+                spawn_worker_procs,
+                stop_worker_procs,
             )
 
             W = int(os.environ.get("BENCH_SHARD_WORKERS", 3))
-            for widx in range(W):
-                sjobs.append(run_worker(Params.from_dict({
-                    "workerIndex": widx, "numWorkers": W,
-                    "journalDir": os.path.join(tmp, "bus"),
-                    "topic": "als-models", "stateBackend": "memory",
-                    "host": "127.0.0.1", "port": 0,
-                })))
-            _wait_for_ingest(sjobs, total_rows, "sharded serving")
+            procs, ports = spawn_worker_procs(
+                W, os.path.join(tmp, "bus"), "als-models", port_dir=tmp,
+            )
             rng = np.random.default_rng(5)
             sh = []
             # 600s timeout: the first TOPK pays every worker's index build,
             # like the single-node build in section 5
             with ShardedQueryClient(
-                [("127.0.0.1", j.port) for j in sjobs], timeout_s=600
+                [("127.0.0.1", pt) for pt in ports], timeout_s=600
             ) as c:
+                deadline = time.time() + 600
+                while c.total_count(ALS_STATE) < total_rows:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"sharded ingest stalled: "
+                            f"{c.total_count(ALS_STATE)}/{total_rows}"
+                        )
+                    time.sleep(0.2)
+                # active warmup, uncounted: the seconds after worker
+                # startup carry a scheduler/cache transient on small hosts
+                # that would otherwise dominate a short timing window
+                # (scripts/shard_profile.py attribution); warm until the
+                # path is demonstrably settled or 3 s, whichever first
+                wdeadline = time.time() + 3.0
+                fast = 0
+                while time.time() < wdeadline and fast < 20:
+                    u = int(rng.integers(1, n_users + 1))
+                    t0 = time.perf_counter()
+                    c.query_states(ALS_STATE, [f"{u}-U"])
+                    fast = (
+                        fast + 1
+                        if (time.perf_counter() - t0) < 0.001 else 0
+                    )
                 for _ in range(n_get):
                     u = int(rng.integers(1, n_users + 1))
                     i = int(rng.integers(1, n_items + 1))
@@ -770,14 +795,15 @@ def run_serving_section(small: bool) -> dict:
             out.update(
                 {f"serving_shard_topk_{q}_ms": v for q, v in _pcts(tk).items()}
             )
-            _log(f"[bench:serve] sharded({W}) MGET {_pcts(sh)} ms, "
+            _log(f"[bench:serve] sharded({W} procs) MGET {_pcts(sh)} ms, "
                  f"TOPK {_pcts(tk)} ms")
         except Exception:
             _log(traceback.format_exc())
             out["shard_error"] = traceback.format_exc(limit=3)
         finally:
-            for j in sjobs:
-                j.stop()
+            from flink_ms_tpu.serve.sharded import stop_worker_procs
+
+            stop_worker_procs(procs)
         return out
     finally:
         if job is not None:

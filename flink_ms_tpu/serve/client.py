@@ -124,6 +124,15 @@ class QueryClient:
                 out.append((item, float(score)))
         return out
 
+    def count(self, name: str) -> int:
+        """Key count of a state (the COUNT verb) — the ops/metrics surface,
+        and the full-ingest barrier for harnesses that cannot reach into a
+        remote worker's table."""
+        reply = self._roundtrip(f"COUNT\t{name}")
+        if reply.startswith("C\t"):
+            return int(reply[2:])
+        raise RuntimeError(f"count failed: {reply}")
+
     def ping(self) -> str:
         return self._roundtrip("PING")
 

@@ -11,6 +11,8 @@ Line protocol over TCP (persistent connections, thread per client):
                                   explicit query vector — lets a sharded
                                   client fan out across workers that only
                                   hold a slice of the catalog)
+              ``COUNT\\t<state_name>\\n``  (key count — ops/metrics surface
+                                  and multi-process ingest barrier)
               ``PING\\n``
     response: ``V\\t<value>\\n``   key found / top-k payload ``item:score;...``
               ``N\\n``            unknown key (client maps to Optional.empty,
@@ -20,6 +22,7 @@ Line protocol over TCP (persistent connections, thread per client):
                                   found (values are tab-free by contract —
                                   model rows are CSV/semicolon text)
               ``E\\t<msg>\\n``    error (unknown state name, bad request)
+              ``C\\t<n>\\n``      COUNT reply
               ``PONG\\t<job_id>\\t<state_name>\\n``
 
 The batched verb exists to beat the reference's serving hot spot: its online
@@ -87,6 +90,15 @@ class LookupServer:
         parts = line.split("\t")
         if parts[0] == "PING":
             return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
+        if parts[0] == "COUNT" and len(parts) == 2:
+            # key count of a state — the ops/metrics surface (Flink exposes
+            # state sizes the same way) and the ingest barrier multi-process
+            # harnesses use instead of reaching into a worker's table
+            _, state = parts
+            table = self.tables.get(state)
+            if table is None:
+                return f"E\tunknown state: {state}"
+            return f"C\t{len(table)}"
         if parts[0] == "GET" and len(parts) == 3:
             _, state, key = parts
             table = self.tables.get(state)

@@ -32,6 +32,7 @@ Worker CLI (one process per worker):
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -175,6 +176,11 @@ class ShardedQueryClient:
     def ping_all(self) -> List[str]:
         return [c.ping() for c in self._clients]
 
+    def total_count(self, name: str) -> int:
+        """Combined key count across every worker's slice (shards are
+        disjoint by construction, so the sum is the table size)."""
+        return sum(c.count(name) for c in self._clients)
+
     def close(self) -> None:
         # every query path joins its futures before returning, so nothing
         # is in flight here; wait=True keeps that invariant explicit
@@ -187,6 +193,95 @@ class ShardedQueryClient:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# worker-process lifecycle (harness/ops helpers around the CLI below)
+# ---------------------------------------------------------------------------
+
+def spawn_worker_procs(
+    num_workers: int,
+    journal_dir: str,
+    topic: str,
+    port_dir: str,
+    state_backend: str = "memory",
+    host: str = "127.0.0.1",
+    extra_args: Sequence[str] = (),
+    timeout_s: float = 120.0,
+    env: Optional[dict] = None,
+) -> Tuple[list, List[int]]:
+    """Spawn one ``python -m flink_ms_tpu.serve.sharded`` process per shard
+    and wait for every port file -> (procs, ports).
+
+    One owner for the spawn/port-wait/cleanup dance the bench and the
+    profiling harness both need: a worker that dies raises (rc included),
+    a worker that hangs past ``timeout_s`` raises instead of spinning, a
+    partial spawn is torn down before the exception propagates, and the
+    child PYTHONPATH gets this repo PREPENDED (not clobbered — the caller
+    may rely on an existing PYTHONPATH for its own deps)."""
+    import subprocess
+    import time
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    base_env = dict(os.environ if env is None else env)
+    prior = base_env.get("PYTHONPATH", "")
+    base_env["PYTHONPATH"] = repo + (os.pathsep + prior if prior else "")
+    procs: list = []
+    try:
+        port_files = []
+        for widx in range(num_workers):
+            pf = os.path.join(port_dir, f"shard-port-{widx}.json")
+            if os.path.exists(pf):
+                os.unlink(pf)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "flink_ms_tpu.serve.sharded",
+                 "--workerIndex", str(widx), "--numWorkers", str(num_workers),
+                 "--journalDir", journal_dir, "--topic", topic,
+                 "--stateBackend", state_backend, "--host", host,
+                 "--port", "0", "--portFile", pf, *extra_args],
+                env=base_env, cwd=repo,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+            port_files.append(pf)
+        ports = []
+        deadline = time.time() + timeout_s
+        for p, pf in zip(procs, port_files):
+            while not (os.path.exists(pf) and os.path.getsize(pf) > 0):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"shard worker died rc={p.returncode}"
+                    )
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"shard worker port wait exceeded {timeout_s:.0f}s"
+                    )
+                time.sleep(0.05)
+            with open(pf) as f:
+                ports.append(json.load(f)["port"])
+        return procs, ports
+    except Exception:
+        stop_worker_procs(procs)
+        raise
+
+
+def stop_worker_procs(procs) -> None:
+    """Terminate-then-kill every worker process (idempotent, exception-safe
+    — callers put this in a ``finally``)."""
+    for p in procs:
+        try:
+            p.terminate()
+        except Exception:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            try:
+                p.kill()
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------

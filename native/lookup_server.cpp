@@ -10,6 +10,7 @@
 //                           ->  M\t<i1>\t<i2>...\n  (per key, in order:
 //                               N missing, V<value> found — one round trip
 //                               for a whole batch of point lookups)
+//   COUNT\t<state>\n        ->  C\t<n>\n  (live key count via tpums_count)
 //   PING\n                  ->  PONG\t<job_id>\t<state>\n
 //   TOPK\t...\n             ->  E\tno topk index for state: <state>\n
 //                               (device-scored top-k stays on the Python
@@ -101,6 +102,12 @@ std::string handle_line(ServerState* s, const std::string& line) {
   int n = split_tabs(line, parts, 5);
   if (parts[0] == "PING") {  // Python matches on parts[0] alone
     return "PONG\t" + s->job_id + "\t" + s->state_name + "\n";
+  }
+  if (parts[0] == "COUNT" && n == 2) {
+    if (parts[1] != s->state_name) {
+      return "E\tunknown state: " + parts[1] + "\n";
+    }
+    return "C\t" + std::to_string(tpums_count(s->store)) + "\n";
   }
   if (parts[0] == "GET" && n == 3) {
     if (parts[1] != s->state_name) {

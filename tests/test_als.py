@@ -232,6 +232,18 @@ def test_fused_solve_matches_unfused(rng, monkeypatch):
     np.testing.assert_allclose(
         fused_c.user_factors, plain.user_factors, rtol=1e-4, atol=1e-6
     )
+    # fused composes with the bf16 exchange dtype: same answer as the
+    # UNFUSED bf16 run (bf16 vs f32 convergence itself is pinned in
+    # test_bf16_exchange_converges_close_to_f32)
+    monkeypatch.delenv("FLINK_MS_ALS_ASSEMBLY_CHUNK_BYTES")
+    cfg_bf = A.ALSConfig(num_factors=k, iterations=3, lambda_=0.1,
+                         exchange_dtype="bfloat16")
+    fused_bf = A.als_fit(u, i, r, cfg_bf, mesh, init=(uf0, itf0))
+    monkeypatch.delenv("FLINK_MS_ALS_FUSED")
+    plain_bf = A.als_fit(u, i, r, cfg_bf, mesh, init=(uf0, itf0))
+    np.testing.assert_allclose(
+        fused_bf.user_factors, plain_bf.user_factors, rtol=1e-4, atol=1e-6
+    )
 
 
 def test_fused_solve_matches_unfused_implicit(rng, monkeypatch):

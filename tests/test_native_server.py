@@ -52,6 +52,7 @@ def test_get_ping_and_misses(server):
         assert c.query_state(ALS_STATE, "1-U") == "0.5;1.5"
         assert c.query_state(ALS_STATE, "2-I") == "2.0;-1.0"
         assert c.query_state(ALS_STATE, "999-U") is None
+        assert c.count(ALS_STATE) == 2  # the fixture's two rows
         assert "jid" in c.ping()
         with pytest.raises(Exception):
             c.query_state("NO_SUCH_STATE", "1-U")
@@ -83,6 +84,9 @@ def test_protocol_matches_python_server(store):
         b"MGET\tALS_MODEL\t\n"
         b"MGET\tOTHER\t1-U\n"
         b"MGET\tALS_MODEL\ta\tb\n"
+        b"COUNT\tALS_MODEL\n"
+        b"COUNT\tOTHER\n"
+        b"COUNT\tALS_MODEL\textra\n"
         b"\n"
     )
     try:
