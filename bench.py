@@ -739,6 +739,10 @@ def _run_all(recovery_enabled: bool = True) -> dict:
             }
             os.environ.setdefault("BENCH_NNZ", "2000000")
             os.environ.setdefault("BENCH_ITERS", "2")
+            # the quality anchors cost rounds/iters too — cap their CPU
+            # budget the same way (explicit env still wins)
+            os.environ.setdefault("BENCH_RMSE_REF_NNZ", "500000")
+            os.environ.setdefault("BENCH_SVM_REF_ROUNDS", "20")
 
     try:
         if "als" in sections:

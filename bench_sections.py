@@ -37,19 +37,29 @@ def _pcts(ms: "list[float]") -> dict:
 # SVM section: RCV1-shaped CoCoA wall-clock
 # ---------------------------------------------------------------------------
 
-def synth_rcv1(n, d, nnz_row, seed=0):
+def synth_rcv1(n, d, nnz_row, seed=0, flip_p=None):
     """RCV1-binary-shaped synthetic data: ~nnz_row features per row out of
     d, unit-ish values, labels from a sparse linear teacher (the real RCV1
     is not shippable in this image; shape and sparsity match its
-    ~700k x 47k, ~70 nnz/row envelope)."""
+    ~700k x 47k, ~70 nnz/row envelope).
+
+    ``flip_p`` (env BENCH_SVM_FLIP, default 0.05): fraction of labels
+    flipped.  Noise-free teacher labels understate the risk of the
+    aggressive CoCoA+ sigma' regime (VERDICT r2 weak #3 — real labels put
+    dual variables on their box constraints); the default workload now
+    carries noise, recorded in the artifact as svm_*_label_flip."""
     from flink_ms_tpu.core.formats import SparseData
 
+    if flip_p is None:
+        flip_p = float(os.environ.get("BENCH_SVM_FLIP", 0.05))
     rng = np.random.default_rng(seed)
     idx = rng.integers(0, d, size=(n, nnz_row), dtype=np.int64)
     val = rng.normal(size=(n, nnz_row)) / np.sqrt(nnz_row)
     w_true = rng.normal(size=d)
     y = np.sign(np.einsum("nl,nl->n", val, w_true[idx]))
     y[y == 0] = 1
+    if flip_p > 0:
+        y = np.where(rng.uniform(size=n) < flip_p, -y, y)
     return SparseData(
         labels=y,
         indptr=np.arange(0, (n + 1) * nnz_row, nnz_row),
@@ -135,6 +145,7 @@ def run_svm_section(devices, platform, small: bool) -> dict:
         f"{prefix}_rounds": rounds,
         f"{prefix}_blocks": K,
         f"{prefix}_examples": n,
+        f"{prefix}_label_flip": float(os.environ.get("BENCH_SVM_FLIP", 0.05)),
     }
     # quality anchor (VERDICT r3 #3): wall-clock to reach within 1% of a
     # converged reference objective — the "identical hinge" half of the
