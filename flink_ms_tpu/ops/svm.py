@@ -44,6 +44,7 @@ OutputDecisionFunction live client-side (SVMPredict.java:33-34,80-86).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 import jax
@@ -71,12 +72,25 @@ class SVMConfig:
     # γK/σ' times more progress.  Ignored in avg mode.
     sigma_prime: Optional[float] = None
     dtype: jnp.dtype = jnp.float32
+    # Inner-loop engine.  "scatter": every SDCA step gathers/scatters a
+    # chain-local copy of the (d,)-dim weight vector — O(L) work per step
+    # but random access into (C, d) state.  "gram": precompute each chain's
+    # (H, H) row-Gram matrix once (densify-matmul on the MXU), keep a
+    # running margin vector wx[i] = w_loc·x_i, and make every step a dense
+    # (C, H) AXPY — the weight vector is touched once per ROUND (one
+    # gather for wx0, one scatter for X^T dalpha) instead of once per
+    # step.  Same update sequence (same RNG, same closed-form dual step),
+    # reassociated arithmetic.  "auto": gram when the (C, H, H) tensor
+    # fits FLINK_MS_SVM_GRAM_BYTES (default 1 GiB per device).
+    inner: str = "auto"
 
     def __post_init__(self):
         if self.mode not in ("avg", "add"):
             raise ValueError("mode must be avg or add")
         if self.sigma_prime is not None and self.sigma_prime < 1.0:
             raise ValueError("sigma_prime must be >= 1")
+        if self.inner not in ("auto", "gram", "scatter"):
+            raise ValueError("inner must be auto|gram|scatter")
 
 
 @dataclasses.dataclass
@@ -173,6 +187,22 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _resolve_inner(problem: BlockedSVMProblem, config: SVMConfig,
+                   mesh: Mesh) -> str:
+    """auto -> gram|scatter, from the per-device (C, H, H) Gram budget
+    (FLINK_MS_SVM_GRAM_BYTES, default 1 GiB).  Resolved BEFORE the fit
+    cache key is built, so the env var keys the executable exactly when it
+    can affect it."""
+    if config.inner != "auto":
+        return config.inner
+    D = num_blocks(mesh)
+    C = _round_up(problem.n_blocks, D) // D
+    H = problem.rows_per_block
+    gram_bytes = C * H * H * np.dtype(config.dtype).itemsize
+    limit = int(os.environ.get("FLINK_MS_SVM_GRAM_BYTES", 1 << 30))
+    return "gram" if gram_bytes <= limit else "scatter"
+
+
 # ---------------------------------------------------------------------------
 # device-side kernel
 # ---------------------------------------------------------------------------
@@ -195,6 +225,10 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             config.sigma_prime if config.sigma_prime is not None
             else config.stepsize * K
         )
+
+    H_rows = problem.rows_per_block
+    d = problem.n_features
+    inner = _resolve_inner(problem, config, mesh)
 
     def chain_sdca(w, idx_c, val_c, label_c, sqn_c, alpha_c, key_c):
         """H serial SDCA steps of ONE chain; vmapped over the C chains of a
@@ -229,15 +263,63 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         # Δw of this chain under the TRUE coupling: (w_loc − w)/σ'
         return (w_loc - w) / sigma_p, a - alpha_c
 
-    def block_fit(iterations, w0, idx, val, label, sq_norm, alpha0, seed_arr):
+    def chain_sdca_gram(wx0, gram_c, label_c, sqn_c, alpha_c, key_c):
+        """H serial SDCA steps of ONE chain, Gram-matrix inner loop: the
+        running margin vector wx[i] = w_loc·x_i absorbs each update via
+        one Gram row (wx += σ'·Δα_j/λn · G[j, :]), so no step touches the
+        (d,)-dim weights.  Same RNG and dual step as ``chain_sdca`` —
+        identical update sequence, reassociated arithmetic."""
+        def sdca_step(h, inner_c):
+            wx, a = inner_c
+            j = jax.random.randint(jax.random.fold_in(key_c, h), (), 0,
+                                   label_c.shape[0])
+            y = label_c[j]
+            qii = sqn_c[j]
+            a_j = a[j]
+            grad = 1.0 - y * wx[j]
+            new_dual = jnp.clip(
+                a_j * y + grad * lam_n / (sigma_p * jnp.maximum(qii, 1e-12)),
+                0.0, 1.0,
+            )
+            delta = jnp.where(qii > 0, y * new_dual - a_j, 0.0)
+            a = a.at[j].add(delta)
+            wx = wx + (sigma_p * delta / lam_n) * gram_c[j]
+            return wx, a
+
+        _, a = jax.lax.fori_loop(0, H, sdca_step, (wx0, alpha_c))
+        return a - alpha_c
+
+    def build_gram(idx_s, val_s):
+        """Per-chain row-Gram G[c] = S_c S_cᵀ via densify-matmul: scatter
+        one chain's L-padded sparse rows into an (H, d) dense staging
+        buffer and take the (H, H) product on the MXU.  lax.map chunking
+        bounds the staging transient; pad rows/slots have val 0 and
+        contribute nothing.  One-time cost per fit call."""
+        rows_ar = jnp.arange(H_rows)
+        B = max(int(
+            (256 << 20) // max(H_rows * d * np.dtype(dtype).itemsize, 1)
+        ), 1)
+
+        def one(args):
+            idx_c, val_c = args
+            dense = jnp.zeros((H_rows, d), dtype).at[
+                rows_ar[:, None], idx_c
+            ].add(val_c)
+            return jnp.einsum("id,jd->ij", dense, dense,
+                              precision="highest",
+                              preferred_element_type=dtype)
+
+        return jax.lax.map(one, (idx_s, val_s), batch_size=B)
+
+    def block_fit(iterations, w0, idx, val, label, sq_norm, alpha0, seed_arr,
+                  gram=None):
         # per-device shards: idx (C, rows, L), alpha (C, rows); w0 replicated
         device_id = jax.lax.axis_index(BLOCK_AXIS)
 
-        def outer(it, carry):
-            w, alpha = carry
+        def chain_keys(it):
             # chain RNG: globally unique (seed, global chain id, round)
             chain_ids = device_id * C + jnp.arange(C)
-            keys = jax.vmap(
+            return jax.vmap(
                 lambda c: jax.random.fold_in(
                     jax.random.fold_in(
                         jax.random.PRNGKey(seed_arr[0]), c
@@ -245,6 +327,10 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
                     it,
                 )
             )(chain_ids)
+
+        def outer(it, carry):
+            w, alpha = carry
+            keys = chain_keys(it)
             dw, dalpha = jax.vmap(
                 chain_sdca, in_axes=(None, 0, 0, 0, 0, 0, 0)
             )(w, idx, val, label, sq_norm, alpha, keys)
@@ -252,18 +338,55 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             alpha = alpha + gamma * dalpha
             return w, alpha
 
-        return jax.lax.fori_loop(0, iterations, outer, (w0, alpha0))
+        def outer_gram(it, carry):
+            w, alpha = carry
+            keys = chain_keys(it)
+            # round-start margins for every row: ONE (C, H, L) gather of w
+            # HIGHEST: the scatter path computes these margins as full-f32
+            # elementwise work; a default-precision (bf16-pass) contraction
+            # here would seed every SDCA step with ~1e-3 relative error and
+            # break the documented cross-engine equivalence on TPU
+            wx0 = jnp.einsum("chl,chl->ch", jnp.take(w, idx, axis=0), val,
+                             precision="highest",
+                             preferred_element_type=dtype)
+            dalpha = jax.vmap(chain_sdca_gram)(
+                wx0, gram, label, sq_norm, alpha, keys
+            )
+            # this device's Δw = Σ_chains X_cᵀ Δα_c / λn: ONE scatter per
+            # round (the scatter path pays one per STEP per chain)
+            contrib = (val * dalpha[:, :, None]).reshape(-1)
+            dw = jnp.zeros((d,), dtype).at[idx.reshape(-1)].add(
+                contrib
+            ) / lam_n
+            w = w + gamma * jax.lax.psum(dw, BLOCK_AXIS)
+            alpha = alpha + gamma * dalpha
+            return w, alpha
+
+        body = outer_gram if inner == "gram" else outer
+        return jax.lax.fori_loop(0, iterations, body, (w0, alpha0))
 
     spec3 = P(BLOCK_AXIS, None, None)
     spec2 = P(BLOCK_AXIS, None)
-    fit = shard_map(
+    in_specs = (P(), P(), spec3, spec3, spec2, spec2, spec2, P())
+    if inner == "gram":
+        in_specs = in_specs + (spec3,)
+    fit = jax.jit(shard_map(
         block_fit,
         mesh=mesh,
-        in_specs=(P(), P(), spec3, spec3, spec2, spec2, spec2, P()),
+        in_specs=in_specs,
         out_specs=(P(), spec2),
         check_vma=False,
-    )
-    return jax.jit(fit)
+    ))
+    # the Gram build is hoisted out of the fit: compile_svm_fit runs it
+    # once and ships the (Kp, H, H) tensor as a device arg, so repeat fit
+    # calls (benchmark loops, retrain cycles) don't pay it again
+    gram_fn = None
+    if inner == "gram":
+        gram_fn = jax.jit(shard_map(
+            build_gram, mesh=mesh,
+            in_specs=(spec3, spec3), out_specs=spec3, check_vma=False,
+        ))
+    return fit, gram_fn
 
 
 _FIT_CACHE: "dict" = {}
@@ -287,6 +410,7 @@ def _cached_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         config.mode,
         config.sigma_prime,
         str(config.dtype),
+        _resolve_inner(problem, config, mesh),
     )
     fn = _FIT_CACHE.pop(key, None)
     if fn is None:
@@ -336,7 +460,10 @@ def compile_svm_fit(
         jax.device_put(alpha0, shard2),
         jax.device_put(jnp.asarray([config.seed], dtype=jnp.uint32), rep),
     ]
-    return _cached_fit(problem, config, mesh), dev_args
+    fit, gram_fn = _cached_fit(problem, config, mesh)
+    if gram_fn is not None:
+        dev_args.append(gram_fn(dev_args[1], dev_args[2]))
+    return fit, dev_args
 
 
 def svm_fit(

@@ -251,6 +251,49 @@ def test_cocoa_plus_aggressive_sigma_wins_on_sparse_data(rng):
     assert aggr <= ref * 1.5 + 5e-2
 
 
+def test_gram_inner_matches_scatter(rng):
+    """The Gram-matrix inner loop runs the IDENTICAL update sequence as
+    the scatter loop (same RNG, same closed-form dual step) with
+    reassociated arithmetic — weights and objective must agree across
+    modes, on a multi-device mesh, in both combination modes."""
+    data = _sparse_blob(rng, n=600, d=300, nnz_row=12)
+    lam = 1e-3
+    mesh = make_mesh(8)
+    K = 32
+    p = prepare_svm_blocked(data, K, seed=0)
+    for mode, sigma in (("add", 4.0), ("avg", None)):
+        cfgs = {
+            inner: SVMConfig(
+                iterations=6, local_iterations=p.rows_per_block,
+                regularization=lam, mode=mode, sigma_prime=sigma,
+                inner=inner,
+            )
+            for inner in ("scatter", "gram")
+        }
+        w_s = svm_fit(data, cfgs["scatter"], mesh, problem=p).weights
+        w_g = svm_fit(data, cfgs["gram"], mesh, problem=p).weights
+        np.testing.assert_allclose(w_g, w_s, rtol=2e-4, atol=1e-6)
+
+
+def test_gram_auto_gating(rng, monkeypatch):
+    """inner=auto takes the Gram path only when the (C, H, H) tensor fits
+    the budget; a tiny FLINK_MS_SVM_GRAM_BYTES forces scatter.  Both
+    still converge (objective below the w=0 loss of 1)."""
+    data = _sparse_blob(rng, n=400, d=200, nnz_row=10)
+    lam = 1e-3
+    mesh = make_mesh(4)
+    p = prepare_svm_blocked(data, 16, seed=0)
+    cfg = SVMConfig(iterations=8, local_iterations=p.rows_per_block,
+                    regularization=lam, mode="add")
+    obj_auto = _sparse_objective(svm_fit(data, cfg, mesh, problem=p),
+                                 data, lam)
+    monkeypatch.setenv("FLINK_MS_SVM_GRAM_BYTES", "1")
+    obj_scatter = _sparse_objective(svm_fit(data, cfg, mesh, problem=p),
+                                    data, lam)
+    assert obj_auto < 1.0 and obj_scatter < 1.0
+    np.testing.assert_allclose(obj_auto, obj_scatter, rtol=2e-4)
+
+
 def test_aggressive_sigma_converges_with_label_noise(rng):
     """The bench-default regime (many chains, sigma' << gamma*K) was
     validated in round 2 only on noise-free synthetic labels (VERDICT r2
