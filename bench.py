@@ -667,7 +667,11 @@ def emit_artifact(result: dict) -> str:
     except OSError as e:
         result["detail"] = f"unwritable: {e}"
     compact = {k: result[k] for k in _COMPACT_KEYS if k in result}
-    err_keys = sorted(k for k in result if k.endswith("_error"))
+    err_keys = sorted(
+        k for k in result
+        if k.endswith("_error") and k != "backend_error"  # surfaced on its
+        # own line below — not a section failure
+    )
     if err_keys:
         compact["section_errors"] = err_keys
     if result.get("backend_error"):
