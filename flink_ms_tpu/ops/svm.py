@@ -187,6 +187,17 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _dw_choice() -> str:
+    """FLINK_MS_SVM_DW: how the Gram engine applies the round-end
+    Δw = Xᵀ Δα update.  "direct" (default): one unsorted scatter-add over
+    all (C·H·L) entries.  "sorted": gather the contributions through a
+    precomputed feature-sorted permutation and reduce with a sorted
+    segment-sum — same numbers, different lowering; on TPU an unsorted
+    49M-entry scatter can serialize where a sorted segment reduction
+    streams, so this is an on-chip sweep A/B knob."""
+    return os.environ.get("FLINK_MS_SVM_DW", "direct")
+
+
 def _resolve_inner(problem: BlockedSVMProblem, config: SVMConfig,
                    mesh: Mesh) -> str:
     """auto -> gram|scatter, from the per-device (C, H, H) Gram budget
@@ -312,7 +323,7 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         return jax.lax.map(one, (idx_s, val_s), batch_size=B)
 
     def block_fit(iterations, w0, idx, val, label, sq_norm, alpha0, seed_arr,
-                  gram=None):
+                  gram=None, dw_perm=None, dw_ids=None):
         # per-device shards: idx (C, rows, L), alpha (C, rows); w0 replicated
         device_id = jax.lax.axis_index(BLOCK_AXIS)
 
@@ -352,12 +363,20 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             dalpha = jax.vmap(chain_sdca_gram)(
                 wx0, gram, label, sq_norm, alpha, keys
             )
-            # this device's Δw = Σ_chains X_cᵀ Δα_c / λn: ONE scatter per
-            # round (the scatter path pays one per STEP per chain)
+            # this device's Δw = Σ_chains X_cᵀ Δα_c / λn: ONE reduction
+            # per round (the scatter engine pays one per STEP per chain) —
+            # unsorted scatter-add, or sorted segment-sum via the
+            # precomputed permutation (FLINK_MS_SVM_DW=sorted)
             contrib = (val * dalpha[:, :, None]).reshape(-1)
-            dw = jnp.zeros((d,), dtype).at[idx.reshape(-1)].add(
-                contrib
-            ) / lam_n
+            if dw_perm is not None:
+                dw = jax.ops.segment_sum(
+                    contrib[dw_perm[0]], dw_ids[0], num_segments=d,
+                    indices_are_sorted=True,
+                ) / lam_n
+            else:
+                dw = jnp.zeros((d,), dtype).at[idx.reshape(-1)].add(
+                    contrib
+                ) / lam_n
             w = w + gamma * jax.lax.psum(dw, BLOCK_AXIS)
             alpha = alpha + gamma * dalpha
             return w, alpha
@@ -368,8 +387,11 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
     spec3 = P(BLOCK_AXIS, None, None)
     spec2 = P(BLOCK_AXIS, None)
     in_specs = (P(), P(), spec3, spec3, spec2, spec2, spec2, P())
+    sorted_dw = inner == "gram" and _dw_choice() == "sorted"
     if inner == "gram":
         in_specs = in_specs + (spec3,)
+    if sorted_dw:
+        in_specs = in_specs + (spec2, spec2)
     fit = jax.jit(shard_map(
         block_fit,
         mesh=mesh,
@@ -386,7 +408,7 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             build_gram, mesh=mesh,
             in_specs=(spec3, spec3), out_specs=spec3, check_vma=False,
         ))
-    return fit, gram_fn
+    return fit, gram_fn, sorted_dw
 
 
 _FIT_CACHE: "dict" = {}
@@ -411,6 +433,7 @@ def _cached_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         config.sigma_prime,
         str(config.dtype),
         _resolve_inner(problem, config, mesh),
+        _dw_choice(),
     )
     fn = _FIT_CACHE.pop(key, None)
     if fn is None:
@@ -460,9 +483,24 @@ def compile_svm_fit(
         jax.device_put(alpha0, shard2),
         jax.device_put(jnp.asarray([config.seed], dtype=jnp.uint32), rep),
     ]
-    fit, gram_fn = _cached_fit(problem, config, mesh)
+    fit, gram_fn, sorted_dw = _cached_fit(problem, config, mesh)
     if gram_fn is not None:
         dev_args.append(gram_fn(dev_args[1], dev_args[2]))
+    if sorted_dw:
+        # per-device feature-sorted permutation of the flattened (C, H, L)
+        # entries + the sorted feature ids (host-side, once per layout)
+        idx_p = pad_blocks(problem.idx)
+        Cd = Kp // D
+        M = Cd * problem.rows_per_block * idx_p.shape[-1]
+        perm = np.empty((D, M), np.int32)
+        ids = np.empty((D, M), np.int32)
+        for dd in range(D):
+            flat = idx_p[dd * Cd:(dd + 1) * Cd].reshape(-1)
+            order = np.argsort(flat, kind="stable").astype(np.int32)
+            perm[dd] = order
+            ids[dd] = flat[order]
+        dev_args.append(jax.device_put(jnp.asarray(perm), shard2))
+        dev_args.append(jax.device_put(jnp.asarray(ids), shard2))
     return fit, dev_args
 
 

@@ -45,12 +45,26 @@ run topk_profile python scripts/topk_profile.py --items 26000 1000000 --rank 50
 # CoCoA chain-count sweep on chip (VERDICT r2 #4): the 8192-chain default
 # rests on a CPU serial-depth argument that may invert on hardware.  One
 # full SVM section per K; sec/round + rounds-to-target land in each log.
+# (Gram engine auto-selects per K; CPU shows near-flat sec/round in K.)
 for K in 1024 4096 8192 16384; do
   BENCH_SECTIONS=svm BENCH_SVM_BLOCKS=$K BENCH_SKIP_CPU=1 \
     BENCH_DETAIL_PATH="$OUT/svm_k$K.detail.json" \
     timeout "${STEP_TIMEOUT:-1200}" python bench.py \
     > "$OUT/svm_k$K.json" 2> "$OUT/svm_k$K.log"
   echo "svm_k$K rc=$?" | tee -a "$OUT/sweep.log"
+done
+
+# Gram-engine A/Bs at the default K: scatter engine baseline, and the
+# sorted segment-sum round-end reduction (an unsorted 49M-entry
+# scatter-add may serialize on TPU where a sorted reduction streams)
+for VAR in "FLINK_MS_SVM_GRAM_BYTES=1 svm_scatter_engine" \
+           "FLINK_MS_SVM_DW=sorted svm_gram_sorted_dw"; do
+  set -- $VAR
+  env "$1" BENCH_SECTIONS=svm BENCH_SKIP_CPU=1 \
+    BENCH_DETAIL_PATH="$OUT/$2.detail.json" \
+    timeout "${STEP_TIMEOUT:-1200}" python bench.py \
+    > "$OUT/$2.json" 2> "$OUT/$2.log"
+  echo "$2 rc=$?" | tee -a "$OUT/sweep.log"
 done
 
 BENCH_SECTIONS=als,svm,serving,svmserve \

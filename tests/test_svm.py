@@ -275,6 +275,23 @@ def test_gram_inner_matches_scatter(rng):
         np.testing.assert_allclose(w_g, w_s, rtol=2e-4, atol=1e-6)
 
 
+def test_gram_sorted_dw_matches_direct(rng, monkeypatch):
+    """FLINK_MS_SVM_DW=sorted reduces the round-end Xᵀ Δα through a
+    presorted segment-sum instead of an unsorted scatter-add — same
+    numbers (reassociated), multi-device."""
+    data = _sparse_blob(rng, n=500, d=250, nnz_row=10)
+    lam = 1e-3
+    mesh = make_mesh(8)
+    p = prepare_svm_blocked(data, 32, seed=0)
+    cfg = SVMConfig(iterations=6, local_iterations=p.rows_per_block,
+                    regularization=lam, mode="add", sigma_prime=4.0,
+                    inner="gram")
+    w_direct = svm_fit(data, cfg, mesh, problem=p).weights
+    monkeypatch.setenv("FLINK_MS_SVM_DW", "sorted")
+    w_sorted = svm_fit(data, cfg, mesh, problem=p).weights
+    np.testing.assert_allclose(w_sorted, w_direct, rtol=2e-4, atol=1e-6)
+
+
 def test_gram_auto_gating(rng, monkeypatch):
     """inner=auto takes the Gram path only when the (C, H, H) tensor fits
     the budget; a tiny FLINK_MS_SVM_GRAM_BYTES forces scatter.  Both
