@@ -33,7 +33,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_processes(tmp_path, iterations: int, out_tag: str):
+def _run_two_processes(tmp_path, iterations: int, out_tag: str,
+                       extra_env=None):
     """Launch als_train on a 2-process x 2-device global mesh; per-process
     temporaryPath dirs (stage0 / stage1) model per-host local disks."""
     port = _free_port()
@@ -41,6 +42,7 @@ def _run_two_processes(tmp_path, iterations: int, out_tag: str):
         **os.environ,
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
         "JAX_PLATFORMS": "cpu",
+        **(extra_env or {}),
     }
     procs = []
     for pid in (0, 1):
@@ -112,8 +114,11 @@ def test_two_process_als_train_matches_single_process(tmp_path):
     # resume: process 0 holds an iter-2 snapshot, process 1 holds nothing —
     # the resume point must come from process 0 (broadcast), both processes
     # must run the SAME remaining step count, and the result must equal a
-    # fresh 3-iteration fit
-    _run_two_processes(tmp_path, iterations=3, out_tag="res")
+    # fresh 3-iteration fit.  The resume leg runs FUSED (arithmetic-
+    # identical by contract), covering fused assembly+solve over the DCN
+    # mesh + staged resume in one shot.
+    _run_two_processes(tmp_path, iterations=3, out_tag="res",
+                       extra_env={"FLINK_MS_ALS_FUSED": "1"})
     assert (tmp_path / "res0" / "uf").exists()
     _assert_matches_local(
         tmp_path, tmp_path / "res0", users, items, ratings, iterations=3
