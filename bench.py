@@ -35,7 +35,12 @@ Env knobs: BENCH_NNZ, BENCH_USERS, BENCH_ITEMS, BENCH_RANK, BENCH_ITERS,
 BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 (per-device peak for MFU; default inferred from device_kind),
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
-BENCH_SECTIONS (comma list: als,svm,serving; default all).
+BENCH_SECTIONS (comma list: als,svm,serving,svmserve; default all),
+BENCH_ALS_PRECISION / BENCH_ALS_EXCHANGE (kernel-config A/B),
+BENCH_SKIP_QUALITY=1 / BENCH_RMSE_REF_NNZ / BENCH_RMSE_REF_ITERS (ALS
+quality anchor), BENCH_SVM_TARGET / BENCH_SVM_REF_ROUNDS / BENCH_SVM_FLIP
+(SVM anchor + label noise), BENCH_DETAIL_PATH (sidecar),
+BENCH_RECOVER_DEADLINE_S / BENCH_RECOVER_TIMEOUT_S (mid-run recovery).
 """
 
 import contextlib
