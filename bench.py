@@ -478,6 +478,8 @@ def run_als_section(devices, platform, small: bool) -> dict:
         "als_solver": os.environ.get("FLINK_MS_ALS_SOLVER", "auto"),
         "als_assembly_precision": cfg.assembly_precision,
         "als_bucket_ratio": os.environ.get("FLINK_MS_ALS_BUCKET_RATIO", "1.5"),
+        "als_fused": os.environ.get("FLINK_MS_ALS_FUSED", "0"),
+        "als_exchange_dtype": cfg.exchange_dtype or "f32",
     }
 
     # BASELINE.json config "als-ms implicit-feedback ALS (confidence-
