@@ -33,10 +33,21 @@ from .table import ModelTable
 
 
 def _default_engine() -> str:
-    """TPUMS_TOPK_ENGINE=xla|pallas; default xla (pallas is the fused
-    single-pass kernel in ops/topk_pallas.py — opt-in until profiled on the
-    target chip, interpreter-mode correctness is covered by tests)."""
-    return os.environ.get("TPUMS_TOPK_ENGINE", "xla")
+    """TPUMS_TOPK_ENGINE: only ``xla`` remains.  The fused Pallas scorer
+    was removed in round 3 (decision in PARITY.md): the serving index is
+    host-pinned in this deployment (a tunneled chip pays ~100 ms RTT per
+    dispatch), and the XLA engine already serves 1M items at ~4 ms p50 —
+    the use case the kernel targeted does not exist in the architecture.
+    A stale ``pallas`` setting degrades loudly to xla."""
+    engine = os.environ.get("TPUMS_TOPK_ENGINE", "xla")
+    if engine != "xla":
+        print(
+            f"[topk] TPUMS_TOPK_ENGINE={engine!r} is no longer available "
+            "(Pallas scorer removed in round 3 — see PARITY.md); using xla",
+            file=sys.stderr,
+        )
+        engine = "xla"
+    return engine
 
 
 def _index_platform() -> str:
@@ -127,9 +138,9 @@ class DeviceFactorIndex:
         self._lock = threading.Lock()
         self._ids: List[str] = []
         self._id_pos: dict = {}   # id -> row index in the device matrix
-        self._matrix = None  # (n, k) device array, or (k_pad, n_pad) for pallas
+        self._matrix = None  # (n, k) device array
         self._n_real = 0
-        self._k_real = 0  # real factor width (pallas pads the device array)
+        self._k_real = 0  # real factor width
         self._topk_fn = None
         self._built_once = False
         # dirty-key plumbing: the table's writer thread appends, the query
@@ -235,15 +246,6 @@ class DeviceFactorIndex:
     def _pack(self, rows):
         import jax
 
-        if self.engine == "pallas":
-            from ..ops.topk_pallas import pack_index
-
-            # the platform knob applies here too: interpreter-mode pallas
-            # against remote-device arrays would pay tunnel RTT per query
-            return jax.device_put(
-                pack_index(np.asarray(rows, dtype=np.float32)),
-                _target_device(),
-            )
         return jax.device_put(
             np.asarray(rows, dtype=np.float32), _target_device()
         )
@@ -314,13 +316,7 @@ class DeviceFactorIndex:
         updates_vec = list(updates_vec) + [updates_vec[0]] * pad
         pos = np.asarray(updates_pos, dtype=np.int32)
         vec = np.asarray(updates_vec, dtype=np.float32)
-        if self.engine == "pallas":
-            k_pad = self._matrix.shape[0]
-            vec_t = np.zeros((k_pad, len(updates_pos)), dtype=np.float32)
-            vec_t[: self._k_real] = vec.T
-            self._matrix = self._matrix.at[:, pos].set(vec_t)
-        else:
-            self._matrix = self._matrix.at[pos].set(vec)
+        self._matrix = self._matrix.at[pos].set(vec)
 
     def _start_rebuild_locked(self) -> None:
         if self._rebuild_thread is not None and self._rebuild_thread.is_alive():
@@ -341,16 +337,10 @@ class DeviceFactorIndex:
                     # warm the fixed-shape update scatter for the NEW matrix
                     # shape here, off the query path (result discarded)
                     pos = np.zeros((self.apply_cap,), dtype=np.int32)
-                    if self.engine == "pallas":
-                        vec_t = np.zeros(
-                            (matrix.shape[0], self.apply_cap), dtype=np.float32
-                        )
-                        matrix.at[:, pos].set(vec_t).block_until_ready()
-                    else:
-                        vec = np.zeros(
-                            (self.apply_cap, matrix.shape[1]), dtype=np.float32
-                        )
-                        matrix.at[pos].set(vec).block_until_ready()
+                    vec = np.zeros(
+                        (self.apply_cap, matrix.shape[1]), dtype=np.float32
+                    )
+                    matrix.at[pos].set(vec).block_until_ready()
                 with self._lock:
                     self._ids = ids
                     self._id_pos = {id_: i for i, id_ in enumerate(ids)}
@@ -426,19 +416,12 @@ class DeviceFactorIndex:
             n = self._n_real
             k_eff = min(k, n)
             q = np.asarray(user_factors, dtype=np.float32)
-            # pallas packs with sublane padding, so validate against the
-            # real factor width captured at build time, not the array shape
             n_fac = self._k_real
             if q.shape[0] != n_fac:
                 raise ValueError(
                     f"query has {q.shape[0]} factors, index has {n_fac}"
                 )
-            if self.engine == "pallas":
-                from ..ops.topk_pallas import topk_scores
-
-                scores, idx = topk_scores(self._matrix, q, k_eff, n_real=n)
-            else:
-                scores, idx = self._topk_fn(self._matrix, q, k_eff)
+            scores, idx = self._topk_fn(self._matrix, q, k_eff)
             return [
                 (self._ids[int(i)], float(s))
                 for i, s in zip(np.asarray(idx), np.asarray(scores))

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""Profile the two top-k scoring engines on the current backend.
+"""Profile the top-k scoring engine on the current backend.
 
-Times the XLA matmul+jax.lax.top_k path against the Pallas fused kernel
-(``ops/topk_pallas.py``) at serving-relevant catalog sizes (26k ≈ ML-20M
-items, 1M ≈ BASELINE scale envelope) — the measurement VERDICT r1 asked
-for to decide the Pallas kernel's fate.  Safe on CPU (Pallas runs in
-interpreter mode there, correctness only; timings meaningful on TPU).
+Times the XLA matmul + ``jax.lax.top_k`` path at serving-relevant catalog
+sizes (26k ≈ ML-20M items, 1M ≈ BASELINE scale envelope), and the device
+vs host placement question behind TPUMS_TOPK_PLATFORM.  The Pallas fused
+scorer this script originally A/B'd was removed in round 3 (decision in
+PARITY.md: the serving index is host-pinned in this deployment, and the
+XLA engine already meets the latency envelope).
 
   python scripts/topk_profile.py [--items N ...] [--rank K] [--topk T]
 """
@@ -35,20 +36,15 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from flink_ms_tpu.ops import topk_pallas as TP
     from flink_ms_tpu.utils.profiling import hard_sync
 
     dev = jax.devices()[0]
-    interpret = dev.platform == "cpu"
-    print(f"backend: {dev.platform} ({getattr(dev, 'device_kind', '?')}), "
-          f"pallas interpret={interpret}")
+    print(f"backend: {dev.platform} ({getattr(dev, 'device_kind', '?')})")
 
     rng = np.random.default_rng(0)
     for n in args.items:
         k = args.rank
         matrix = rng.standard_normal((n, k)).astype(np.float32)
-
-        # XLA path: scores = M q, then top_k
         md = jnp.asarray(matrix)
 
         @jax.jit
@@ -56,38 +52,17 @@ def main():
             scores = m @ q
             return jax.lax.top_k(scores, args.topk)
 
-        # Pallas path: packed transposed index
-        packed = TP.pack_index(matrix)
-
         def run_xla(q):
             t0 = time.time()
-            s, i = xla_topk(md, q)
-            hard_sync(s)
-            return time.time() - t0
-
-        def run_pallas(q):
-            t0 = time.time()
-            s, i = TP.topk_scores(packed, q, args.topk, n, interpret=interpret)
+            s, _ = xla_topk(md, q)
             hard_sync(s)
             return time.time() - t0
 
         qs = [jnp.asarray(rng.standard_normal(k).astype(np.float32))
               for _ in range(args.reps)]
-        # correctness cross-check on the first query
-        s0, i0 = xla_topk(md, qs[0])
-        sp, ip = TP.topk_scores(packed, qs[0], args.topk, n, interpret=interpret)
-        np.testing.assert_allclose(
-            np.sort(np.asarray(s0)), np.sort(np.asarray(sp)), rtol=2e-4, atol=1e-4
-        )
-        # indices too: matching scores with wrong item ids must fail here
-        assert set(np.asarray(i0).tolist()) == set(np.asarray(ip).tolist()), (
-            i0, ip,
-        )
-        run_xla(qs[0]); run_pallas(qs[0])  # warmup/compile
+        run_xla(qs[0])  # warmup/compile
         tx = sorted(run_xla(q) for q in qs)[len(qs) // 2]
-        tp = sorted(run_pallas(q) for q in qs)[len(qs) // 2]
-        print(f"items={n:>9,} rank={k}: xla {tx*1e3:7.3f} ms  "
-              f"pallas {tp*1e3:7.3f} ms  ({tx/tp:.2f}x)")
+        print(f"items={n:>9,} rank={k}: xla {tx * 1e3:7.3f} ms/query")
 
 
 if __name__ == "__main__":
