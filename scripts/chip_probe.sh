@@ -3,20 +3,28 @@
 # a real jax.devices() probe with a non-cpu platform, then exits 0 so the
 # invoking shell/agent gets a completion signal.  Exits 1 at the deadline.
 #
-# Probe policy (see memory: axon-tunnel-wedge-workaround):
-#   - cheap TCP probe of the loopback relay first: connect + immediate EOF
-#     is the wedge fingerprint and costs <1s, so the expensive probe is
-#     skipped while the relay is known-dead;
+# Probe policy:
+#   - cheap TCP connect of the loopback relay first; only a REFUSED connect
+#     skips the expensive probe (round 3 observed a healthy chip answering
+#     jax probes behind a relay that still EOF'd instantly, so the old
+#     connect+EOF "wedge fingerprint" is a known false positive — no byte is
+#     read, the connect result is the whole signal);
+#   - a full probe that HANGS to its timeout (rc=124) is the one reliable
+#     wedge signature: further full probes are skipped for HANG_BACKOFF_S
+#     so a long outage costs one hung probe per backoff window, not per
+#     iteration (mirrors bench.py's PROBE_HANG_BACKOFF_S memo);
 #   - every FULL_EVERY iterations run the real subprocess jax probe anyway
-#     (the wedge fingerprint is an observation, not a contract);
+#     (even refused/backoff is an observation, not a contract);
 #   - the jax probe runs in a subprocess under timeout: a wedged tunnel
 #     HANGS backend init rather than erroring.
 LOG="${LOG:-/tmp/chip_status_r3}"
 DEADLINE_S="${DEADLINE_S:-39600}"   # 11h
 SLEEP_S="${SLEEP_S:-300}"
 FULL_EVERY="${FULL_EVERY:-6}"
+HANG_BACKOFF_S="${HANG_BACKOFF_S:-900}"
 start=$(date +%s)
 i=0
+last_hang=0
 cd "$(dirname "$0")/.."
 while :; do
   now=$(date +%s)
@@ -28,32 +36,31 @@ while :; do
   cheap=$(python - <<'EOF'
 import socket
 try:
-    s = socket.create_connection(("127.0.0.1", 2024), timeout=5)
-    s.settimeout(3)
-    try:
-        data = s.recv(16)
-        print("wedged" if data == b"" else "maybe")
-    except socket.timeout:
-        print("maybe")
-    finally:
-        s.close()
+    socket.create_connection(("127.0.0.1", 2024), timeout=5).close()
+    print("open")
 except Exception:
     print("refused")
 EOF
 )
-  if [ "$cheap" = "maybe" ] || (( i % FULL_EVERY == 0 )); then
-    if timeout 120 python -c "
+  skip=""
+  [ "$cheap" = "refused" ] && skip=refused
+  (( now - last_hang < HANG_BACKOFF_S )) && skip=hang-backoff
+  if [ -z "$skip" ] || (( i % FULL_EVERY == 0 )); then
+    timeout 120 python -c "
 from flink_ms_tpu.parallel.mesh import honor_platform_env
 honor_platform_env()
 import jax
 assert jax.devices()[0].platform != 'cpu'
-" >/dev/null 2>&1; then
+" >/dev/null 2>&1
+    rc=$?
+    if (( rc == 0 )); then
       echo "$(date +%H:%M:%S) UP (cheap=$cheap)" >> "$LOG"
       exit 0
     fi
-    echo "$(date +%H:%M:%S) down (full probe failed, cheap=$cheap)" >> "$LOG"
+    (( rc == 124 )) && last_hang=$(date +%s)
+    echo "$(date +%H:%M:%S) down (full probe rc=$rc, cheap=$cheap)" >> "$LOG"
   else
-    echo "$(date +%H:%M:%S) down (cheap=$cheap)" >> "$LOG"
+    echo "$(date +%H:%M:%S) down ($skip)" >> "$LOG"
   fi
   sleep "$SLEEP_S"
 done

@@ -120,6 +120,56 @@ def test_recovery_gating_is_cheap_and_safe(monkeypatch):
     bench.try_recover_accelerator({"degraded": True}, {}, _time.time() - 1)
 
 
+def test_relay_classifier_eof_is_not_wedged(monkeypatch):
+    """Round-3 observation: a healthy chip answered jax probes behind a
+    relay that accepts the TCP connect and instantly EOFs.  The classifier
+    must therefore treat connect+EOF as probe-worthy (False) and reserve
+    True for refused/unconfigured relays."""
+    import socket
+    import threading
+
+    import bench
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("PALLAS_AXON_RELAY_PORT", str(port))
+
+    def eof_once():
+        conn, _ = srv.accept()
+        conn.close()  # instant EOF after accept — the old "wedge" shape
+
+    t = threading.Thread(target=eof_once, daemon=True)
+    t.start()
+    try:
+        assert bench.relay_looks_wedged() is False
+    finally:
+        t.join(timeout=5)
+        srv.close()
+    # listener gone -> connect refused -> definitely absent
+    assert bench.relay_looks_wedged() is True
+
+
+def test_recovery_hang_backoff_skips_probe(monkeypatch):
+    """After a probe hangs to its timeout (the one reliable wedge
+    signature), further recovery attempts inside the backoff window must
+    not touch the relay or probe again."""
+    import time as _time
+
+    import bench
+
+    def boom(*a, **k):
+        raise AssertionError("no relay/probe inside hang backoff")
+
+    monkeypatch.setattr(bench, "relay_looks_wedged", boom)
+    monkeypatch.setattr(bench, "_accel_probe_ok", boom)
+    monkeypatch.setattr(bench, "_last_probe_hang", _time.time())
+    bench.try_recover_accelerator(
+        {"degraded": True}, {}, _time.time() + 600)
+
+
 @pytest.mark.slow
 def test_tiny_serving_section_clean(monkeypatch):
     """Serving section at a tiny config: all metric families present, no
