@@ -422,7 +422,7 @@ def time_fit(mesh, problem, cfg_base, iters, repeats=5):
 def run_als_section(devices, platform, small: bool) -> dict:
     import jax
 
-    from flink_ms_tpu.ops.als import ALSConfig, prepare_blocked
+    from flink_ms_tpu.ops.als import ALSConfig, prepare_blocked, resolve_solver
     from flink_ms_tpu.parallel.mesh import make_mesh
 
     n_users = int(os.environ.get("BENCH_USERS", 20_000 if small else 138_493))
@@ -491,7 +491,7 @@ def run_als_section(devices, platform, small: bool) -> dict:
         "workload_skew": skew,
         # kernel config forensics: which solver/precision/ladder produced
         # this number (env-driven knobs, baked in at trace time)
-        "als_solver": os.environ.get("FLINK_MS_ALS_SOLVER", "auto"),
+        "als_solver": resolve_solver(platform),
         "als_assembly_precision": cfg.assembly_precision,
         "als_bucket_ratio": os.environ.get("FLINK_MS_ALS_BUCKET_RATIO", "1.5"),
         "als_fused": os.environ.get("FLINK_MS_ALS_FUSED", "0"),

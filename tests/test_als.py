@@ -118,6 +118,18 @@ def test_chol_solve_panel_matches_numpy(rng, k):
     np.testing.assert_allclose(x, x_ref, rtol=2e-3, atol=2e-4)
 
 
+def test_auto_solver_resolution(monkeypatch):
+    """"auto" resolves per backend: the round-3 on-chip matrix made pallas
+    the TPU default (62.7 vs 444.9 ms/iter unrolled at 5M nnz / k=50); CPU
+    keeps LAPACK-backed lax; explicit overrides pass through."""
+    monkeypatch.delenv("FLINK_MS_ALS_SOLVER", raising=False)
+    assert A.resolve_solver("tpu") == "pallas"
+    assert A.resolve_solver("cpu") == "lax"
+    assert A.resolve_solver(None) == "auto"  # unknown backend: k-heuristic
+    monkeypatch.setenv("FLINK_MS_ALS_SOLVER", "panel")
+    assert A.resolve_solver("tpu") == "panel"
+
+
 def test_fit_with_panel_solver_matches_default(rng, monkeypatch):
     u, i, r = _synthetic(rng, n_users=30, n_items=20)
     k = 5
