@@ -165,9 +165,27 @@ def run_svm_section(devices, platform, small: bool) -> dict:
         try:
             ref_rounds = int(os.environ.get("BENCH_SVM_REF_ROUNDS",
                                             10 if small else 40))
+            # each fit call is capped to ~BENCH_SVM_REF_MAX_S of device
+            # time: a single >~60 s dispatch through the tunneled backend
+            # can kill the TPU worker (round-3 K-sweep: every anchor whose
+            # 40-round ref fit exceeded ~60 s crashed with "TPU worker
+            # process crashed or restarted"; the ~32 s ones survived).
+            # Segments warm-start via fit(..., start=) and are
+            # bit-identical to one long fit (absolute-round RNG).
+            max_seg_s = float(os.environ.get("BENCH_SVM_REF_MAX_S", 40))
+            seg = max(1, int(max_seg_s / max(sec_per_round, 1e-9)))
 
             def obj_at(r):
-                w_r, _ = fit(jnp.asarray(r, jnp.int32), *dev_args)
+                w_r, a_r = dev_args[0], dev_args[5]
+                done = 0
+                while done < r:
+                    step = min(seg, r - done)
+                    args = list(dev_args)
+                    args[0], args[5] = w_r, a_r
+                    w_r, a_r = fit(jnp.asarray(step, jnp.int32), *args,
+                                   start=done)
+                    hard_sync(w_r)
+                    done += step
                 return SVMModel(
                     weights=to_host_array(w_r).astype(np.float64)
                 ).hinge_loss(data, lam)

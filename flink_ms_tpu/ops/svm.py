@@ -322,8 +322,13 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
 
         return jax.lax.map(one, (idx_s, val_s), batch_size=B)
 
-    def block_fit(iterations, w0, idx, val, label, sq_norm, alpha0, seed_arr,
+    def block_fit(span, w0, idx, val, label, sq_norm, alpha0, seed_arr,
                   gram=None, dw_perm=None, dw_ids=None):
+        # span = [start, stop): rounds run with ABSOLUTE indices so the
+        # per-round RNG (fold_in of the round number) is identical whether
+        # the caller runs one long fit or chains warm-started segments —
+        # segmenting exists because a single >~60 s dispatch through the
+        # tunneled backend can kill the TPU worker (round-3 anchor crashes)
         # per-device shards: idx (C, rows, L), alpha (C, rows); w0 replicated
         device_id = jax.lax.axis_index(BLOCK_AXIS)
 
@@ -382,7 +387,7 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             return w, alpha
 
         body = outer_gram if inner == "gram" else outer
-        return jax.lax.fori_loop(0, iterations, body, (w0, alpha0))
+        return jax.lax.fori_loop(span[0], span[1], body, (w0, alpha0))
 
     spec3 = P(BLOCK_AXIS, None, None)
     spec2 = P(BLOCK_AXIS, None)
@@ -392,13 +397,23 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         in_specs = in_specs + (spec3,)
     if sorted_dw:
         in_specs = in_specs + (spec2, spec2)
-    fit = jax.jit(shard_map(
+    jfit = jax.jit(shard_map(
         block_fit,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=(P(), spec2),
         check_vma=False,
     ))
+
+    def fit(rounds, *args, start=0):
+        """``fit(rounds, *dev_args)`` runs rounds from scratch; pass
+        ``start=r0`` (with the w/alpha carried out of a previous segment
+        as args[0]/args[5]) to continue EXACTLY where a prior call
+        stopped — absolute-round RNG makes chained segments bit-identical
+        to one long fit."""
+        lo = jnp.asarray(start, jnp.int32)
+        span = jnp.stack([lo, lo + jnp.asarray(rounds, jnp.int32)])
+        return jfit(span, *args)
     # the Gram build is hoisted out of the fit: compile_svm_fit runs it
     # once and ships the (Kp, H, H) tensor as a device arg, so repeat fit
     # calls (benchmark loops, retrain cycles) don't pay it again

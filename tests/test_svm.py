@@ -275,6 +275,33 @@ def test_gram_inner_matches_scatter(rng):
         np.testing.assert_allclose(w_g, w_s, rtol=2e-4, atol=1e-6)
 
 
+def test_segmented_fit_bit_identical_to_one_shot(rng):
+    """Chained warm-started fit segments (fit(n, ..., start=r0) with the
+    carried w/alpha) must be BIT-identical to one long fit: the per-round
+    RNG folds in the absolute round index, so the segmentation the bench
+    anchor uses to bound single-dispatch wall-clock cannot change the
+    trained model.  Both engines."""
+    import jax.numpy as jnp
+    from flink_ms_tpu.ops.svm import compile_svm_fit
+
+    data = _sparse_blob(rng, n=500, d=250, nnz_row=10)
+    mesh = make_mesh(4)
+    p = prepare_svm_blocked(data, 16, seed=0)
+    for inner in ("scatter", "gram"):
+        cfg = SVMConfig(iterations=9, local_iterations=p.rows_per_block,
+                        regularization=1e-3, mode="add", sigma_prime=4.0,
+                        inner=inner)
+        fit, dev_args = compile_svm_fit(p, cfg, mesh)
+        w_one, a_one = fit(jnp.asarray(9, jnp.int32), *dev_args)
+        w_r, a_r = dev_args[0], dev_args[5]
+        for start, n in ((0, 4), (4, 3), (7, 2)):
+            args = list(dev_args)
+            args[0], args[5] = w_r, a_r
+            w_r, a_r = fit(jnp.asarray(n, jnp.int32), *args, start=start)
+        np.testing.assert_array_equal(np.asarray(w_r), np.asarray(w_one))
+        np.testing.assert_array_equal(np.asarray(a_r), np.asarray(a_one))
+
+
 def test_gram_sorted_dw_matches_direct(rng, monkeypatch):
     """FLINK_MS_SVM_DW=sorted reduces the round-end Xᵀ Δα through a
     presorted segment-sum instead of an unsorted scatter-add — same
