@@ -1139,23 +1139,46 @@ def _predict_dense(uf, itf, u_idx, i_idx):
     return jnp.sum(jnp.take(uf, u_idx, axis=0) * jnp.take(itf, i_idx, axis=0), axis=-1)
 
 
+def _predict_chunk_rows() -> int:
+    # bound the two (chunk, k) gather transients: an unchunked 20M-pair
+    # predict at k=50 compiled to a 19 GB program and OOM'd 16 GB HBM
+    # (round-3 bench quality anchor); 4M rows keeps transients ~2-3 GB
+    return int(os.environ.get("FLINK_MS_PREDICT_CHUNK", 4_000_000))
+
+
 def predict(model: ALSModel, users: np.ndarray, items: np.ndarray) -> np.ndarray:
     """Batched scores for raw (user, item) id pairs; unknown ids score 0
-    (callers substitute the MEAN cold-start vector — SGD.java:219-234)."""
+    (callers substitute the MEAN cold-start vector — SGD.java:219-234).
+    Large batches run in fixed-size device chunks (one executable, padded
+    tail) so evaluation over a full ratings file never exceeds HBM."""
     u_idx = np.searchsorted(model.user_ids, users)
     u_idx_c = np.clip(u_idx, 0, len(model.user_ids) - 1)
     u_ok = model.user_ids[u_idx_c] == users
     i_idx = np.searchsorted(model.item_ids, items)
     i_idx_c = np.clip(i_idx, 0, len(model.item_ids) - 1)
     i_ok = model.item_ids[i_idx_c] == items
-    preds = np.asarray(
-        _predict_dense(
-            jnp.asarray(model.user_factors),
-            jnp.asarray(model.item_factors),
-            jnp.asarray(u_idx_c),
-            jnp.asarray(i_idx_c),
+    n = len(u_idx_c)
+    C = _predict_chunk_rows()
+    uf_d = jnp.asarray(model.user_factors)
+    itf_d = jnp.asarray(model.item_factors)
+    if n <= C:
+        preds = np.asarray(
+            _predict_dense(uf_d, itf_d, jnp.asarray(u_idx_c),
+                           jnp.asarray(i_idx_c))
         )
-    )
+    else:
+        preds = np.empty(n, model.user_factors.dtype)
+        for s in range(0, n, C):
+            e = min(s + C, n)
+            uc, ic = u_idx_c[s:e], i_idx_c[s:e]
+            if e - s < C:  # pad the tail: same shapes -> same executable
+                pad = C - (e - s)
+                uc = np.pad(uc, (0, pad))
+                ic = np.pad(ic, (0, pad))
+            preds[s:e] = np.asarray(
+                _predict_dense(uf_d, itf_d, jnp.asarray(uc),
+                               jnp.asarray(ic))
+            )[: e - s]
     return np.where(u_ok & i_ok, preds, 0.0)
 
 
