@@ -187,15 +187,45 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _dw_choice() -> str:
+def _dw_choice(platform: Optional[str] = None) -> str:
     """FLINK_MS_SVM_DW: how the Gram engine applies the round-end
-    Δw = Xᵀ Δα update.  "direct" (default): one unsorted scatter-add over
-    all (C·H·L) entries.  "sorted": gather the contributions through a
-    precomputed feature-sorted permutation and reduce with a sorted
-    segment-sum — same numbers, different lowering; on TPU an unsorted
-    49M-entry scatter can serialize where a sorted segment reduction
-    streams, so this is an on-chip sweep A/B knob."""
-    return os.environ.get("FLINK_MS_SVM_DW", "direct")
+    Δw = Xᵀ Δα update.  "direct": one unsorted scatter-add over all
+    (C·H·L) entries.  "sorted": gather the row-major contribution array
+    through a precomputed feature-sorted permutation, then a sorted
+    segment-sum.  "presorted": store val ALREADY feature-sorted at prepare
+    time, so the round end multiplies the streamed sorted values by a
+    gather from only the tiny (C·H) Δα table and segment-sums — no
+    runtime permutation of the big array.  Round-3 chip A/B at RCV1 scale
+    (49M nnz): direct 0.80 s/round, presorted 1.33, sorted 1.60 — XLA
+    lowers even a sorted segment-sum to the same serialized scatter, so
+    the rewrites only add gather cost.  "auto" (default) = direct
+    everywhere; the alternatives remain selectable for future
+    lowering/hardware changes.  (BASELINE.md carries the piecewise
+    attribution: the boundary cost is two 49M-scalar irregular ops that
+    shrink linearly with device count on a real mesh.)"""
+    choice = os.environ.get("FLINK_MS_SVM_DW", "auto")
+    if choice == "auto":
+        return "direct"
+    return choice
+
+
+def _step_choice(platform: str) -> str:
+    """FLINK_MS_SVM_STEP: how the Gram engine's SDCA step touches chain
+    state.  "dynamic": per-chain dynamic gather of the Gram row + scatter-
+    add into alpha — O(1) memory touched per step, but batched per-chain
+    gathers/scatters and a per-step threefry chain serialize inside the
+    TPU fori_loop (round 3 measured 9.3 ms/step on v5e for ~µs of math).
+    "onehot": hoist the (C, H) step-index draw out of the loop and express
+    every read/write as a dense mask/one-hot contraction — pure VPU/MXU
+    work, bit-identical results (products are exact 0s and 1s).  Round-3
+    chip A/B: neutral at RCV1 scale (0.804 vs 0.799 s/round — the round
+    BOUNDARY dominates single-chip, see _dw_choice), so "auto" = dynamic
+    everywhere; onehot stays selectable for meshes where the boundary
+    shrinks and per-step latency resurfaces."""
+    choice = os.environ.get("FLINK_MS_SVM_STEP", "auto")
+    if choice == "auto":
+        return "dynamic"
+    return choice
 
 
 def _resolve_inner(problem: BlockedSVMProblem, config: SVMConfig,
@@ -239,7 +269,10 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
 
     H_rows = problem.rows_per_block
     d = problem.n_features
+    platform = mesh.devices.flat[0].platform
     inner = _resolve_inner(problem, config, mesh)
+    step_mode = _step_choice(platform)
+    dw_mode = _dw_choice(platform) if inner == "gram" else "direct"
 
     def chain_sdca(w, idx_c, val_c, label_c, sqn_c, alpha_c, key_c):
         """H serial SDCA steps of ONE chain; vmapped over the C chains of a
@@ -300,6 +333,47 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         _, a = jax.lax.fori_loop(0, H, sdca_step, (wx0, alpha_c))
         return a - alpha_c
 
+    def chain_sdca_gram_onehot(wx0, gram_c, label_c, sqn_c, alpha_c, key_c):
+        """``chain_sdca_gram`` with every dynamic access rewritten as a
+        dense one-hot contraction and the per-step RNG hoisted out of the
+        loop: no gather, no scatter, no threefry inside the fori_loop.
+        Bit-identical to the dynamic path — the index draw is the same
+        fold_in(key, h) sequence (vectorized), and one-hot reads/writes
+        multiply by exact 1.0/0.0 so no value is ever rounded
+        (``precision="highest"`` keeps the Gram-row contraction in f32)."""
+        rows = label_c.shape[0]
+        j_all = jax.vmap(
+            lambda h: jax.random.randint(
+                jax.random.fold_in(key_c, h), (), 0, rows
+            )
+        )(jnp.arange(H))
+        iota = jnp.arange(rows)
+
+        def sdca_step(h, inner_c):
+            wx, a = inner_c
+            onehot = (iota == j_all[h]).astype(dtype)      # (rows,)
+            y = jnp.sum(label_c * onehot)
+            qii = jnp.sum(sqn_c * onehot)
+            a_j = jnp.sum(a * onehot)
+            grad = 1.0 - y * jnp.sum(wx * onehot)
+            new_dual = jnp.clip(
+                a_j * y + grad * lam_n / (sigma_p * jnp.maximum(qii, 1e-12)),
+                0.0, 1.0,
+            )
+            delta = jnp.where(qii > 0, y * new_dual - a_j, 0.0)
+            a = a + delta * onehot
+            grow = jnp.einsum("r,rk->k", onehot, gram_c,
+                              precision="highest",
+                              preferred_element_type=dtype)
+            wx = wx + (sigma_p * delta / lam_n) * grow
+            return wx, a
+
+        _, a = jax.lax.fori_loop(0, H, sdca_step, (wx0, alpha_c))
+        return a - alpha_c
+
+    sdca_gram = (chain_sdca_gram_onehot if step_mode == "onehot"
+                 else chain_sdca_gram)
+
     def build_gram(idx_s, val_s):
         """Per-chain row-Gram G[c] = S_c S_cᵀ via densify-matmul: scatter
         one chain's L-padded sparse rows into an (H, d) dense staging
@@ -323,7 +397,9 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         return jax.lax.map(one, (idx_s, val_s), batch_size=B)
 
     def block_fit(span, w0, idx, val, label, sq_norm, alpha0, seed_arr,
-                  gram=None, dw_perm=None, dw_ids=None):
+                  gram=None, dw_a=None, dw_b=None, dw_c=None):
+        # dw_* operands depend on dw_mode: sorted -> (perm, ids), presorted
+        # -> (val_sorted, ids, src_row); unused modes pass nothing
         # span = [start, stop): rounds run with ABSOLUTE indices so the
         # per-round RNG (fold_in of the round number) is identical whether
         # the caller runs one long fit or chains warm-started segments —
@@ -365,20 +441,27 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             wx0 = jnp.einsum("chl,chl->ch", jnp.take(w, idx, axis=0), val,
                              precision="highest",
                              preferred_element_type=dtype)
-            dalpha = jax.vmap(chain_sdca_gram)(
+            dalpha = jax.vmap(sdca_gram)(
                 wx0, gram, label, sq_norm, alpha, keys
             )
             # this device's Δw = Σ_chains X_cᵀ Δα_c / λn: ONE reduction
-            # per round (the scatter engine pays one per STEP per chain) —
-            # unsorted scatter-add, or sorted segment-sum via the
-            # precomputed permutation (FLINK_MS_SVM_DW=sorted)
-            contrib = (val * dalpha[:, :, None]).reshape(-1)
-            if dw_perm is not None:
+            # per round (the scatter engine pays one per STEP per chain).
+            # Mode trade-offs in _dw_choice's docstring.
+            if dw_mode == "presorted":
+                # val is stored feature-sorted (dw_a) at prepare time, so
+                # the only runtime gather reads the tiny (C·H) Δα table
                 dw = jax.ops.segment_sum(
-                    contrib[dw_perm[0]], dw_ids[0], num_segments=d,
+                    dw_a[0] * dalpha.reshape(-1)[dw_c[0]], dw_b[0],
+                    num_segments=d, indices_are_sorted=True,
+                ) / lam_n
+            elif dw_mode == "sorted":
+                contrib = (val * dalpha[:, :, None]).reshape(-1)
+                dw = jax.ops.segment_sum(
+                    contrib[dw_a[0]], dw_b[0], num_segments=d,
                     indices_are_sorted=True,
                 ) / lam_n
             else:
+                contrib = (val * dalpha[:, :, None]).reshape(-1)
                 dw = jnp.zeros((d,), dtype).at[idx.reshape(-1)].add(
                     contrib
                 ) / lam_n
@@ -392,11 +475,12 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
     spec3 = P(BLOCK_AXIS, None, None)
     spec2 = P(BLOCK_AXIS, None)
     in_specs = (P(), P(), spec3, spec3, spec2, spec2, spec2, P())
-    sorted_dw = inner == "gram" and _dw_choice() == "sorted"
     if inner == "gram":
         in_specs = in_specs + (spec3,)
-    if sorted_dw:
-        in_specs = in_specs + (spec2, spec2)
+        if dw_mode == "sorted":
+            in_specs = in_specs + (spec2, spec2)
+        elif dw_mode == "presorted":
+            in_specs = in_specs + (spec2, spec2, spec2)
     jfit = jax.jit(shard_map(
         block_fit,
         mesh=mesh,
@@ -423,7 +507,7 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             build_gram, mesh=mesh,
             in_specs=(spec3, spec3), out_specs=spec3, check_vma=False,
         ))
-    return fit, gram_fn, sorted_dw
+    return fit, gram_fn, dw_mode if inner == "gram" else "direct"
 
 
 _FIT_CACHE: "dict" = {}
@@ -448,7 +532,8 @@ def _cached_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         config.sigma_prime,
         str(config.dtype),
         _resolve_inner(problem, config, mesh),
-        _dw_choice(),
+        _dw_choice(mesh.devices.flat[0].platform),
+        _step_choice(mesh.devices.flat[0].platform),
     )
     fn = _FIT_CACHE.pop(key, None)
     if fn is None:
@@ -498,24 +583,43 @@ def compile_svm_fit(
         jax.device_put(alpha0, shard2),
         jax.device_put(jnp.asarray([config.seed], dtype=jnp.uint32), rep),
     ]
-    fit, gram_fn, sorted_dw = _cached_fit(problem, config, mesh)
+    fit, gram_fn, dw_mode = _cached_fit(problem, config, mesh)
     if gram_fn is not None:
         dev_args.append(gram_fn(dev_args[1], dev_args[2]))
-    if sorted_dw:
-        # per-device feature-sorted permutation of the flattened (C, H, L)
-        # entries + the sorted feature ids (host-side, once per layout)
+    if dw_mode in ("sorted", "presorted"):
+        # per-device feature-sorted layout of the flattened (C, H, L)
+        # entries (host-side, once per layout).  sorted ships (perm, ids):
+        # the round end gathers the big contribution array through perm.
+        # presorted ships (val_sorted, ids, src_row): values are stored
+        # already sorted, so the round end's only gather is src_row into
+        # the (C·H) Δα table.
         idx_p = pad_blocks(problem.idx)
+        L = idx_p.shape[-1]
         Cd = Kp // D
-        M = Cd * problem.rows_per_block * idx_p.shape[-1]
-        perm = np.empty((D, M), np.int32)
+        M = Cd * problem.rows_per_block * L
         ids = np.empty((D, M), np.int32)
+        if dw_mode == "sorted":
+            perm = np.empty((D, M), np.int32)
+        else:
+            val_p = pad_blocks(problem.val)
+            val_s = np.empty((D, M), np.dtype(dtype))
+            src = np.empty((D, M), np.int32)
         for dd in range(D):
             flat = idx_p[dd * Cd:(dd + 1) * Cd].reshape(-1)
             order = np.argsort(flat, kind="stable").astype(np.int32)
-            perm[dd] = order
             ids[dd] = flat[order]
-        dev_args.append(jax.device_put(jnp.asarray(perm), shard2))
-        dev_args.append(jax.device_put(jnp.asarray(ids), shard2))
+            if dw_mode == "sorted":
+                perm[dd] = order
+            else:
+                val_s[dd] = val_p[dd * Cd:(dd + 1) * Cd].reshape(-1)[order]
+                src[dd] = order // L  # device-local flat (C·H) row index
+        if dw_mode == "sorted":
+            dev_args.append(jax.device_put(jnp.asarray(perm), shard2))
+            dev_args.append(jax.device_put(jnp.asarray(ids), shard2))
+        else:
+            dev_args.append(jax.device_put(jnp.asarray(val_s), shard2))
+            dev_args.append(jax.device_put(jnp.asarray(ids), shard2))
+            dev_args.append(jax.device_put(jnp.asarray(src), shard2))
     return fit, dev_args
 
 

@@ -275,6 +275,24 @@ def test_gram_inner_matches_scatter(rng):
         np.testing.assert_allclose(w_g, w_s, rtol=2e-4, atol=1e-6)
 
 
+def test_gram_onehot_step_bit_identical_to_dynamic(rng, monkeypatch):
+    """FLINK_MS_SVM_STEP=onehot (the TPU default: dense mask/one-hot
+    contractions, RNG hoisted out of the loop) runs the identical index
+    sequence and multiplies only by exact 0s/1s, so the trained weights
+    must be BIT-identical to the dynamic gather/scatter step."""
+    data = _sparse_blob(rng, n=500, d=250, nnz_row=10)
+    mesh = make_mesh(4)
+    p = prepare_svm_blocked(data, 16, seed=0)
+    cfg = SVMConfig(iterations=6, local_iterations=p.rows_per_block,
+                    regularization=1e-3, mode="add", sigma_prime=4.0,
+                    inner="gram")
+    monkeypatch.setenv("FLINK_MS_SVM_STEP", "dynamic")
+    w_dyn = svm_fit(data, cfg, mesh, problem=p).weights
+    monkeypatch.setenv("FLINK_MS_SVM_STEP", "onehot")
+    w_oh = svm_fit(data, cfg, mesh, problem=p).weights
+    np.testing.assert_array_equal(w_oh, w_dyn)
+
+
 def test_segmented_fit_bit_identical_to_one_shot(rng):
     """Chained warm-started fit segments (fit(n, ..., start=r0) with the
     carried w/alpha) must be BIT-identical to one long fit: the per-round
@@ -317,6 +335,13 @@ def test_gram_sorted_dw_matches_direct(rng, monkeypatch):
     monkeypatch.setenv("FLINK_MS_SVM_DW", "sorted")
     w_sorted = svm_fit(data, cfg, mesh, problem=p).weights
     np.testing.assert_allclose(w_sorted, w_direct, rtol=2e-4, atol=1e-6)
+    # presorted (the TPU default): values stored feature-sorted at prepare
+    # time, runtime gathers only the (C·H) Δα table — same reduction
+    # order as "sorted", so allclose to direct and EQUAL to sorted
+    monkeypatch.setenv("FLINK_MS_SVM_DW", "presorted")
+    w_pre = svm_fit(data, cfg, mesh, problem=p).weights
+    np.testing.assert_allclose(w_pre, w_direct, rtol=2e-4, atol=1e-6)
+    np.testing.assert_array_equal(w_pre, w_sorted)
 
 
 def test_gram_auto_gating(rng, monkeypatch):
