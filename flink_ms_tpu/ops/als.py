@@ -442,20 +442,42 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
         row_bytes += 3 * k * k * np.dtype(dtype).itemsize
     need = r * row_bytes
     limit = _assembly_chunk_bytes()
-    operands = (idx, val) if extra is None else (idx, val, extra)
     if need <= limit:
         return compute(idx, val, extra)
-    # chunked: lax.map with batch_size runs vmapped row chunks sequentially,
-    # so only one chunk's transients are ever live
+    # chunked: reshape to (n_chunks, C, ...) slabs and lax.map WITHOUT
+    # batch_size, so the body genuinely computes C rows per step and only
+    # one chunk's transients are ever live.  (lax.map's batch_size vmaps a
+    # single-row body instead — in fused mode that traced the solve at
+    # batch 1, padded every row to a 128-lane kernel tile, and the vmap
+    # batched that padding into a 159 GB broadcast: the round-3 AOT OOM.)
+    # Pad rows to a chunk multiple: pad gathers hit slot 0 and the padded
+    # counts are 0, so the solve masks padded rows to zero and the slice
+    # below discards them — per-row arithmetic is untouched.
     C = max(min(int(limit // row_bytes), r), 1)
+    n_chunks = -(-r // C)
+    r_pad = n_chunks * C
 
-    def one_row(args):
-        idx_r, val_r = args[0], args[1]
-        extra_r = args[2][None] if extra is not None else None
-        out = compute(idx_r[None], val_r[None], extra_r)
-        return jax.tree.map(lambda t: t[0], out)
+    def pad_rows(a):
+        if r_pad == r:
+            return a
+        return jnp.pad(a, ((0, r_pad - r),) + ((0, 0),) * (a.ndim - 1))
 
-    return jax.lax.map(one_row, operands, batch_size=C)
+    idx_c = pad_rows(idx).reshape(n_chunks, C, w)
+    val_c = pad_rows(val).reshape(n_chunks, C, w)
+    extra_c = None
+    if extra is not None:
+        extra_c = pad_rows(extra).reshape((n_chunks, C) + extra.shape[1:])
+
+    def one_chunk(args):
+        if extra is None:
+            return compute(args[0], args[1], None)
+        return compute(args[0], args[1], args[2])
+
+    operands = (idx_c, val_c) if extra is None else (idx_c, val_c, extra_c)
+    out = jax.lax.map(one_chunk, operands)
+    return jax.tree.map(
+        lambda t: t.reshape((r_pad,) + t.shape[2:])[:r], out
+    )
 
 
 def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
@@ -638,13 +660,17 @@ def resolve_solver(platform: Optional[str]) -> str:
     return choice
 
 
-def _chol_solve(A, b, platform: Optional[str] = None):
+def _chol_solve(A, b, platform: Optional[str] = None, in_scan=False):
     k = A.shape[-1]
     choice = resolve_solver(platform)
     if choice == "pallas":
         from .cholesky_pallas import cholesky_solve_batched
 
-        return cholesky_solve_batched(A, b).astype(A.dtype)
+        # in_scan (the fused per-chunk solve inside lax.map): the kernel's
+        # lane-major operand relayout is uncompilable there (degenerate-
+        # dim copy, 62.5 GB AOT OOM) -- force the batch-major variant
+        layout = "batch_major" if in_scan else None
+        return cholesky_solve_batched(A, b, layout=layout).astype(A.dtype)
     if choice == "panel":
         return _chol_solve_panel(A, b)
     if choice == "unrolled" or (choice == "auto" and k <= _UNROLL_MAX_K):
@@ -659,7 +685,7 @@ def _chol_solve(A, b, platform: Optional[str] = None):
 
 
 def _solve_factors(A, b, counts, lam, weighted_reg, dtype,
-                   platform: Optional[str] = None):
+                   platform: Optional[str] = None, in_scan=False):
     """Batched Cholesky solve of (A + λ·reg·I) x = b with empty rows masked."""
     k = A.shape[-1]
     reg = counts if weighted_reg else jnp.ones_like(counts)
@@ -667,7 +693,7 @@ def _solve_factors(A, b, counts, lam, weighted_reg, dtype,
     # system so Cholesky stays PD, then zero the result
     diag = lam * reg + jnp.where(counts > 0, 0.0, 1.0)
     A = A + diag[:, None, None] * jnp.eye(k, dtype=dtype)
-    x = _chol_solve(A, b, platform)
+    x = _chol_solve(A, b, platform, in_scan=in_scan)
     return jnp.where((counts > 0)[:, None], x, 0.0)
 
 
@@ -733,7 +759,7 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
                 if yty is not None:
                     A = A + yty[None, :, :]
                 return _solve_factors(A, bb, cnt, lam, weighted, dtype,
-                                      platform)
+                                      platform, in_scan=True)
 
             xs = []
             off = 0

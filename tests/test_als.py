@@ -261,6 +261,16 @@ def test_fused_solve_matches_unfused(rng, monkeypatch):
     np.testing.assert_allclose(
         fused_c.user_factors, plain.user_factors, rtol=1e-4, atol=1e-6
     )
+    # fused + chunked + the pallas solver (interpret off-TPU): the exact
+    # combination whose lane-major relayout OOM'd on chip — the scan body
+    # must trace the solve at the full chunk batch (batch-major layout),
+    # not per padded row
+    monkeypatch.setenv("FLINK_MS_ALS_SOLVER", "pallas")
+    fused_cp = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    monkeypatch.delenv("FLINK_MS_ALS_SOLVER")
+    np.testing.assert_allclose(
+        fused_cp.user_factors, plain.user_factors, rtol=1e-4, atol=1e-6
+    )
     # fused composes with the bf16 exchange dtype: same answer as the
     # UNFUSED bf16 run (bf16 vs f32 convergence itself is pinned in
     # test_bf16_exchange_converges_close_to_f32)
