@@ -979,10 +979,14 @@ class ALSModel:
 def init_factors(n_pad: int, k: int, key, dtype) -> jnp.ndarray:
     """Uniform(0,1)/sqrt(k) init.  FlinkML seeds per-block uniform factors
     [dep]; bit-parity is impossible across runtimes, so parity is defined as
-    equal-or-better RMSE at equal iterations (SURVEY.md §7 'hard parts')."""
-    return jax.random.uniform(key, (n_pad, k), dtype=dtype) / jnp.sqrt(
-        jnp.asarray(k, dtype)
-    )
+    equal-or-better RMSE at equal iterations (SURVEY.md §7 'hard parts').
+    Drawn on the HOST backend — threefry is device-deterministic so the
+    values are identical, and a (10M, 64) accelerator-side draw was 2.6 GB
+    of HBM transient that the 10M×1M scale envelope could not afford."""
+    with jax.default_device(jax.devices("cpu")[0]):
+        return jax.random.uniform(key, (n_pad, k), dtype=dtype) / jnp.sqrt(
+            jnp.asarray(k, dtype)
+        )
 
 
 def _pad_factors(problem: BlockedProblem, D: int, k: int, dtype,
@@ -993,9 +997,11 @@ def _pad_factors(problem: BlockedProblem, D: int, k: int, dtype,
     uf0[problem.u.perm] = uf_raw
     itf0 = np.zeros((problem.i.per_block * D, k), dtype=dtype)
     itf0[problem.i.perm] = itf_raw
+    # stay NUMPY: jnp.asarray would stage a full unsharded copy on the
+    # default device before device_put re-shards it (2x HBM transient)
     return (
-        jnp.asarray(uf0).reshape(D, problem.u.per_block, k),
-        jnp.asarray(itf0).reshape(D, problem.i.per_block, k),
+        uf0.reshape(D, problem.u.per_block, k),
+        itf0.reshape(D, problem.i.per_block, k),
     )
 
 
@@ -1035,10 +1041,11 @@ def compile_fit(
     dev_args = [jax.device_put(uf0, shard3), jax.device_put(itf0, shard3)]
     for side in (problem.u, problem.i):
         for a in _flat_side_args(side, dtype):
+            # device_put straight from numpy: an intermediate jnp.asarray
+            # stages an unsharded default-device copy first, doubling the
+            # HBM transient for every layout array
             dev_args.append(
-                jax.device_put(
-                    jnp.asarray(a), shard2 if a.ndim == 2 else shard3
-                )
+                jax.device_put(a, shard2 if a.ndim == 2 else shard3)
             )
     return _cached_sweep(problem, config, mesh), dev_args
 

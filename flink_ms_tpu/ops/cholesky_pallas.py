@@ -169,6 +169,14 @@ def cholesky_solve_batched(A, b, tile: int = 128, interpret=None,
     if layout is None:
         layout = os.environ.get("FLINK_MS_PALLAS_LAYOUT", "lane_major")
     n, k = b.shape
+    if layout == "batch_major":
+        # the batch-major kernel keeps ~9 k²·tile f32 buffers live (input
+        # block + VMEM transpose + downdate + column/row stacks); at k=64
+        # tile=128 that measured 18.87 MB against the 16 MB scoped-vmem
+        # limit (half-scale envelope OOM).  Halve the tile until the
+        # estimate fits with headroom.
+        while tile > 8 and 9 * k * k * tile * 4 > 14 * (1 << 20):
+            tile //= 2
     n_pad = _round_up(max(n, tile), tile)
     if layout == "batch_major":
         Ab = A.astype(jnp.float32)
