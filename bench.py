@@ -515,6 +515,26 @@ def run_als_section(devices, platform, small: bool) -> dict:
             _log(traceback.format_exc())
             out["als_implicit_error"] = traceback.format_exc(limit=3)
 
+    # bf16-exchange A/B (accelerator runs only, BENCH_ALS_BF16_AB=0 to
+    # skip): the 5M-nnz probe measured bf16 at 50.2 vs 62.7 ms/iter under
+    # the pallas solver (+20%), but the kernel default stays f32 until the
+    # quality side is witnessed — so every chip artifact records the bf16
+    # speed here and its RMSE parity delta in the quality anchor, and the
+    # flip decision can be made from the artifact alone
+    if (not small and platform != "cpu" and not cfg.exchange_dtype
+            and os.environ.get("BENCH_ALS_BF16_AB", "1") != "0"):
+        try:
+            import dataclasses as _dc
+
+            cfg_bf = _dc.replace(cfg, exchange_dtype="bfloat16")
+            spi_bf = time_fit(mesh, problem, cfg_bf, max(2, iters - 2))
+            out["als_bf16_sec_per_iter"] = round(spi_bf, 6)
+            _log(f"[bench] bf16 exchange: {spi_bf:.3f} s/iter "
+                 f"(f32: {sec_per_iter:.3f})")
+        except Exception:
+            _log(traceback.format_exc())
+            out["als_bf16_error"] = traceback.format_exc(limit=3)
+
     # quality anchor: the timed config's convergence, full scale + parity
     # delta vs the f64 reference (skippable: BENCH_SKIP_QUALITY=1)
     if os.environ.get("BENCH_SKIP_QUALITY") != "1":
@@ -654,6 +674,24 @@ def als_quality_anchor(mesh, problem, users, items, ratings, cfg_base,
     out["als_rmse_ref_nnz"] = ref_nnz
     _log(f"[bench] f64 reference RMSE {rmse_ref:.6f} "
          f"({time.time() - t0:.1f}s) -> delta {out['als_rmse_ref_delta']}")
+
+    # bf16-exchange quality side of the A/B (see run_als_section): the
+    # same parity fit with bfloat16 exchange against the SAME f64
+    # reference — the delta pair is the evidence a default flip needs
+    if (mesh.devices.flat[0].platform != "cpu"
+            and not cfg_base.exchange_dtype
+            and os.environ.get("BENCH_ALS_BF16_AB", "1") != "0"):
+        try:
+            cfg_bf = dataclasses.replace(cfg_p, exchange_dtype="bfloat16")
+            m_bf = als_fit(ru, ri, rr, cfg_bf, mesh, problem=p_bench,
+                           init=init)
+            delta_bf = (rmse(m_bf, ru, ri, rr) - rmse_ref) / rmse_ref
+            out["als_bf16_rmse_ref_delta"] = round(delta_bf, 6)
+            _log(f"[bench] bf16-exchange parity fit -> delta "
+                 f"{out['als_bf16_rmse_ref_delta']}")
+        except Exception:
+            _log(traceback.format_exc())
+            out["als_bf16_quality_error"] = traceback.format_exc(limit=3)
     return out
 
 
