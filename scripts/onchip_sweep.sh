@@ -34,11 +34,17 @@ run als_bf16_exchange python scripts/als_microbench.py \
   --solvers auto --precisions highest,default --exchange bf16
 
 # fused assembly+solve (FLINK_MS_ALS_FUSED=1): the (n,k,k) tensor never
-# hits HBM — the roofline's dominant term.  26% faster on CPU; expected
-# larger on chip.  Solver matrix again under fusion.
+# hits HBM — the memory-ceiling mode (measured 2026-07-31: pallas 71.8
+# vs 62.7 ms/iter unfused; ~14% cost for the unbounded catalog).
 FLINK_MS_ALS_FUSED=1 run als_fused python scripts/als_microbench.py \
   --nnz 5000000 --users 60000 --items 12000 --rank 50 \
   --solvers unrolled,panel,lax,pallas --precisions highest,default
+
+# bf16 exchange under the pallas default (2026-07-31: 50.2 vs 62.7
+# ms/iter; quality delta auto-captured by bench.py's als section)
+run als_bf16_pallas python scripts/als_microbench.py \
+  --nnz 5000000 --users 60000 --items 12000 --rank 50 \
+  --solvers pallas --precisions highest --exchange bf16
 
 run topk_profile python scripts/topk_profile.py --items 26000 1000000 --rank 50
 
