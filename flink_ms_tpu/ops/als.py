@@ -982,8 +982,12 @@ def init_factors(n_pad: int, k: int, key, dtype) -> jnp.ndarray:
     equal-or-better RMSE at equal iterations (SURVEY.md §7 'hard parts').
     Drawn on the HOST backend — threefry is device-deterministic so the
     values are identical, and a (10M, 64) accelerator-side draw was 2.6 GB
-    of HBM transient that the 10M×1M scale envelope could not afford."""
-    with jax.default_device(jax.devices("cpu")[0]):
+    of HBM transient that the 10M×1M scale envelope could not afford.
+    local_devices, NOT jax.devices: in a multi-process run the global list
+    starts with process 0's device, and pinning another process's default
+    device to a non-addressable device wedges the whole DCN collective
+    sequence (round-3 two-process regression)."""
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
         return jax.random.uniform(key, (n_pad, k), dtype=dtype) / jnp.sqrt(
             jnp.asarray(k, dtype)
         )
@@ -1038,15 +1042,22 @@ def compile_fit(
 
     shard3 = block_sharding(mesh, rank=3)
     shard2 = block_sharding(mesh, rank=2)
-    dev_args = [jax.device_put(uf0, shard3), jax.device_put(itf0, shard3)]
+    # single-process: device_put straight from numpy — an intermediate
+    # jnp.asarray stages an unsharded default-device copy first, doubling
+    # the HBM transient for every array (the 10Mx1M envelope OOM'd on it).
+    # multi-process: device_put of raw numpy onto a multi-host sharding
+    # routes through multihost_utils.assert_equal (a cross-host allgather
+    # of the full array) and breaks under the DCN test harness — keep the
+    # committed-local-array path there.
+    def put(a, sharding):
+        if jax.process_count() > 1:
+            a = jnp.asarray(a)
+        return jax.device_put(a, sharding)
+
+    dev_args = [put(uf0, shard3), put(itf0, shard3)]
     for side in (problem.u, problem.i):
         for a in _flat_side_args(side, dtype):
-            # device_put straight from numpy: an intermediate jnp.asarray
-            # stages an unsharded default-device copy first, doubling the
-            # HBM transient for every layout array
-            dev_args.append(
-                jax.device_put(a, shard2 if a.ndim == 2 else shard3)
-            )
+            dev_args.append(put(a, shard2 if a.ndim == 2 else shard3))
     return _cached_sweep(problem, config, mesh), dev_args
 
 
