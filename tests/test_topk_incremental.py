@@ -130,13 +130,19 @@ def test_p99_flat_under_streaming_writer(rng):
 
     t = threading.Thread(target=writer)
     t.start()
+    p50_bound = min(max(0.05, 25 * p50_quiet), 0.6)
+    p99_bound = min(max(0.15, 25 * p99_quiet), 0.6)
     try:
         p50_busy, p99_busy = measure()
-        if p99_busy >= 0.6:
-            # one retry: a rebuild-on-path design breaches deterministically
-            # on every window, while an external stall (this box has ONE
-            # core — a concurrent process import can freeze a whole 60-query
-            # window) passes the second measurement
+        for _ in range(2):
+            # retry on any would-fail window: a rebuild-on-path design
+            # breaches deterministically on EVERY window (~1 s/query), while
+            # an external stall (this box has ONE core — a concurrent
+            # process import can freeze a whole 60-query window; round 3
+            # measured a 0.34 s p99 purely from a parallel bench run)
+            # passes a re-measurement
+            if p50_busy < p50_bound and p99_busy < p99_bound:
+                break
             p50_busy, p99_busy = measure()
     finally:
         stop.set()
@@ -151,8 +157,8 @@ def test_p99_flat_under_streaming_writer(rng):
     # scheduling delay — doesn't flake the assertion.
     # the 0.6 s cap keeps the relative slack below the ~1 s rebuild cost,
     # so the assertion never disarms entirely on a slow machine
-    assert p50_busy < min(max(0.05, 25 * p50_quiet), 0.6), (p50_quiet, p50_busy)
-    assert p99_busy < min(max(0.15, 25 * p99_quiet), 0.6), (p99_quiet, p99_busy)
+    assert p50_busy < p50_bound, (p50_quiet, p50_busy)
+    assert p99_busy < p99_bound, (p99_quiet, p99_busy)
 
 def test_snapshot_drops_malformed_rows_keeps_catalog(rng):
     """One truncated payload, one over-long payload, one non-numeric
