@@ -422,7 +422,8 @@ def time_fit(mesh, problem, cfg_base, iters, repeats=5):
 def run_als_section(devices, platform, small: bool) -> dict:
     import jax
 
-    from flink_ms_tpu.ops.als import ALSConfig, prepare_blocked, resolve_solver
+    from flink_ms_tpu.ops.als import (ALSConfig, prepare_blocked,
+                                      resolve_exchange, resolve_solver)
     from flink_ms_tpu.parallel.mesh import make_mesh
 
     n_users = int(os.environ.get("BENCH_USERS", 20_000 if small else 138_493))
@@ -440,7 +441,7 @@ def run_als_section(devices, platform, small: bool) -> dict:
     cfg = ALSConfig(
         num_factors=rank, iterations=1, lambda_=0.1, seed=42,
         assembly_precision=os.environ.get("BENCH_ALS_PRECISION", "highest"),
-        exchange_dtype=os.environ.get("BENCH_ALS_EXCHANGE") or None,
+        exchange_dtype=os.environ.get("BENCH_ALS_EXCHANGE") or "auto",
     )
     mesh = make_mesh(devices=devices)
     _log(f"[bench] ALS devices: {devices}, nnz={nnz}, rank={rank}")
@@ -495,7 +496,7 @@ def run_als_section(devices, platform, small: bool) -> dict:
         "als_assembly_precision": cfg.assembly_precision,
         "als_bucket_ratio": os.environ.get("FLINK_MS_ALS_BUCKET_RATIO", "1.5"),
         "als_fused": os.environ.get("FLINK_MS_ALS_FUSED", "0"),
-        "als_exchange_dtype": cfg.exchange_dtype or "f32",
+        "als_exchange_dtype": resolve_exchange(cfg.exchange_dtype, platform) or "f32",
     }
 
     # BASELINE.json config "als-ms implicit-feedback ALS (confidence-
@@ -515,25 +516,28 @@ def run_als_section(devices, platform, small: bool) -> dict:
             _log(traceback.format_exc())
             out["als_implicit_error"] = traceback.format_exc(limit=3)
 
-    # bf16-exchange A/B (accelerator runs only, BENCH_ALS_BF16_AB=0 to
-    # skip): the 5M-nnz probe measured bf16 at 50.2 vs 62.7 ms/iter under
-    # the pallas solver (+20%), but the kernel default stays f32 until the
-    # quality side is witnessed — so every chip artifact records the bf16
-    # speed here and its RMSE parity delta in the quality anchor, and the
-    # flip decision can be made from the artifact alone
-    if (not small and platform != "cpu" and not cfg.exchange_dtype
+    # exchange-dtype A/B (accelerator runs only, BENCH_ALS_BF16_AB=0 to
+    # skip): time the OPPOSITE exchange dtype of whatever the timed config
+    # resolved to — with the bf16-on-TPU default this records the f32
+    # comparison (and under BENCH_ALS_EXCHANGE=bfloat16... the reverse),
+    # so every chip artifact carries both sides of the default-flip
+    # evidence; the quality anchor records the matching RMSE deltas
+    if (not small and platform != "cpu"
             and os.environ.get("BENCH_ALS_BF16_AB", "1") != "0"):
         try:
             import dataclasses as _dc
 
-            cfg_bf = _dc.replace(cfg, exchange_dtype="bfloat16")
-            spi_bf = time_fit(mesh, problem, cfg_bf, max(2, iters - 2))
-            out["als_bf16_sec_per_iter"] = round(spi_bf, 6)
-            _log(f"[bench] bf16 exchange: {spi_bf:.3f} s/iter "
-                 f"(f32: {sec_per_iter:.3f})")
+            resolved = resolve_exchange(cfg.exchange_dtype, platform)
+            alt = None if resolved else "bfloat16"
+            alt_name = "f32" if alt is None else "bf16"
+            cfg_alt = _dc.replace(cfg, exchange_dtype=alt)
+            spi_alt = time_fit(mesh, problem, cfg_alt, max(2, iters - 2))
+            out[f"als_{alt_name}_sec_per_iter"] = round(spi_alt, 6)
+            _log(f"[bench] {alt_name} exchange variant: {spi_alt:.3f} "
+                 f"s/iter (timed default: {sec_per_iter:.3f})")
         except Exception:
             _log(traceback.format_exc())
-            out["als_bf16_error"] = traceback.format_exc(limit=3)
+            out["als_exchange_ab_error"] = traceback.format_exc(limit=3)
 
     # quality anchor: the timed config's convergence, full scale + parity
     # delta vs the f64 reference (skippable: BENCH_SKIP_QUALITY=1)

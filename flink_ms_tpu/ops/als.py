@@ -76,8 +76,15 @@ class ALSConfig:
     # over ICI and the random-row gather's HBM traffic (a different lever
     # than assembly_precision — that one changes MXU passes, this one
     # changes the bytes moved).  Normal equations still accumulate in the
-    # solve dtype via preferred_element_type.  None = full precision.
-    exchange_dtype: Optional[str] = None
+    # solve dtype via preferred_element_type.  None = full precision;
+    # "auto" (the default) resolves per backend in resolve_exchange():
+    # bfloat16 on TPU — chip-measured +20% (50.2 vs 62.7 ms/iter at the
+    # 5M-nnz probe under the pallas solver) at a +1.4e-5 relative train-
+    # RMSE delta vs an f64 reference at the bench anchor scale — and full
+    # precision elsewhere.  Every accelerator bench artifact re-witnesses
+    # the quality side (als_rmse_at_iters / als_rmse_ref_delta inherit
+    # the resolved config).
+    exchange_dtype: Optional[str] = "auto"
 
 
 _MIN_BUCKET_W = 8  # smallest rating-list pad width (sublane-friendly)
@@ -660,6 +667,19 @@ def resolve_solver(platform: Optional[str]) -> str:
     return choice
 
 
+def resolve_exchange(exchange_dtype: Optional[str],
+                     platform: Optional[str]) -> Optional[str]:
+    """The factor-exchange dtype an "auto" config resolves to on
+    `platform` (explicit values and None pass through).  bfloat16 on TPU:
+    chip-measured +20% iteration speed at a +1.4e-5 relative RMSE delta
+    vs an f64 reference (ALSConfig.exchange_dtype docstring); full
+    precision everywhere else — the CPU baseline/reference paths must
+    not silently change numerics."""
+    if exchange_dtype == "auto":
+        return "bfloat16" if platform == "tpu" else None
+    return exchange_dtype
+
+
 def _chol_solve(A, b, platform: Optional[str] = None, in_scan=False):
     k = A.shape[-1]
     choice = resolve_solver(platform)
@@ -724,8 +744,9 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     n_i_buckets = len(problem.i.widths)
     platform = mesh.devices.flat[0].platform
 
+    resolved_exchange = resolve_exchange(config.exchange_dtype, platform)
     exchange_dtype = (
-        jnp.dtype(config.exchange_dtype) if config.exchange_dtype else None
+        jnp.dtype(resolved_exchange) if resolved_exchange else None
     )
 
     def half_sweep(y_shard, flat):

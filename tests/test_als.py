@@ -147,6 +147,18 @@ def test_auto_solver_resolution(monkeypatch):
     assert A.resolve_solver("tpu") == "panel"
 
 
+def test_auto_exchange_resolution():
+    """exchange_dtype="auto" resolves per backend — bfloat16 on TPU
+    (chip-measured +20% at +1.4e-5 relative RMSE delta), full precision
+    elsewhere; explicit values and None pass through untouched."""
+    assert A.resolve_exchange("auto", "tpu") == "bfloat16"
+    assert A.resolve_exchange("auto", "cpu") is None
+    assert A.resolve_exchange("auto", None) is None
+    assert A.resolve_exchange(None, "tpu") is None
+    assert A.resolve_exchange("bfloat16", "cpu") == "bfloat16"
+    assert A.ALSConfig().exchange_dtype == "auto"
+
+
 def test_fit_with_panel_solver_matches_default(rng, monkeypatch):
     u, i, r = _synthetic(rng, n_users=30, n_items=20)
     k = 5
