@@ -882,9 +882,12 @@ _STAGE_RE = re.compile(r"^iter_(\d+)\.npz$")
 
 
 def _staging_meta(problem: "BlockedProblem", config: "ALSConfig",
-                  init) -> dict:
+                  init, platform: "Optional[str]" = None) -> dict:
     """Identity of a training run; a snapshot from a different dataset,
-    problem, config, dtype, or starting point must not be resumed."""
+    problem, config, dtype, or starting point must not be resumed.
+    ``platform`` resolves the "auto" exchange dtype: the meta must record
+    the NUMERICS the run actually used, so a bf16-on-TPU snapshot cannot
+    silently resume as an f32-on-CPU continuation (or vice versa)."""
     if init is None:
         init_id = "seed"
     else:
@@ -908,7 +911,7 @@ def _staging_meta(problem: "BlockedProblem", config: "ALSConfig",
         "alpha": config.alpha,
         "weighted_reg": config.weighted_reg,
         "assembly_precision": config.assembly_precision,
-        "exchange_dtype": config.exchange_dtype,
+        "exchange_dtype": resolve_exchange(config.exchange_dtype, platform),
         "seed": config.seed,
         "dtype": str(np.dtype(config.dtype)),
         "init": init_id,
@@ -1132,7 +1135,8 @@ def als_fit(
     else:
         from ..parallel.distributed import is_primary
 
-        meta = _staging_meta(problem, config, init)
+        meta = _staging_meta(problem, config, init,
+                             mesh.devices.flat[0].platform)
         multi = jax.process_count() > 1
         # multi-process: exactly one writer, and process 0's snapshot is
         # authoritative for the resume point — local scans could disagree
