@@ -679,23 +679,30 @@ def als_quality_anchor(mesh, problem, users, items, ratings, cfg_base,
     _log(f"[bench] f64 reference RMSE {rmse_ref:.6f} "
          f"({time.time() - t0:.1f}s) -> delta {out['als_rmse_ref_delta']}")
 
-    # bf16-exchange quality side of the A/B (see run_als_section): the
-    # same parity fit with bfloat16 exchange against the SAME f64
+    # exchange-dtype quality side of the A/B (mirrors run_als_section's
+    # speed A/B): the same parity fit with the OPPOSITE exchange dtype of
+    # whatever the timed config resolved to, against the SAME f64
     # reference — the delta pair is the evidence a default flip needs
-    if (mesh.devices.flat[0].platform != "cpu"
-            and not cfg_base.exchange_dtype
+    platform_q = mesh.devices.flat[0].platform
+    if (platform_q != "cpu"
             and os.environ.get("BENCH_ALS_BF16_AB", "1") != "0"):
         try:
-            cfg_bf = dataclasses.replace(cfg_p, exchange_dtype="bfloat16")
-            m_bf = als_fit(ru, ri, rr, cfg_bf, mesh, problem=p_bench,
-                           init=init)
-            delta_bf = (rmse(m_bf, ru, ri, rr) - rmse_ref) / rmse_ref
-            out["als_bf16_rmse_ref_delta"] = round(delta_bf, 6)
-            _log(f"[bench] bf16-exchange parity fit -> delta "
-                 f"{out['als_bf16_rmse_ref_delta']}")
+            from flink_ms_tpu.ops.als import resolve_exchange
+
+            resolved = resolve_exchange(cfg_base.exchange_dtype, platform_q)
+            alt = None if resolved else "bfloat16"
+            alt_name = "f32" if alt is None else "bf16"
+            cfg_alt = dataclasses.replace(cfg_p, exchange_dtype=alt)
+            m_alt = als_fit(ru, ri, rr, cfg_alt, mesh, problem=p_bench,
+                            init=init)
+            delta_alt = (rmse(m_alt, ru, ri, rr) - rmse_ref) / rmse_ref
+            out[f"als_{alt_name}_rmse_ref_delta"] = round(delta_alt, 6)
+            _log(f"[bench] {alt_name}-exchange parity fit -> delta "
+                 f"{out[f'als_{alt_name}_rmse_ref_delta']}")
         except Exception:
             _log(traceback.format_exc())
-            out["als_bf16_quality_error"] = traceback.format_exc(limit=3)
+            out["als_exchange_ab_quality_error"] = traceback.format_exc(
+                limit=3)
     return out
 
 

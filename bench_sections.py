@@ -152,8 +152,8 @@ def run_svm_section(devices, platform, small: bool) -> dict:
     from flink_ms_tpu.ops.svm import _dw_choice, _resolve_inner, _step_choice
 
     out[f"{prefix}_inner"] = _resolve_inner(problem, cfg, mesh)
-    out[f"{prefix}_dw"] = _dw_choice(platform)
-    out[f"{prefix}_step"] = _step_choice(platform)
+    out[f"{prefix}_dw"] = _dw_choice()
+    out[f"{prefix}_step"] = _step_choice()
     # quality anchor (VERDICT r3 #3): wall-clock to reach within 1% of a
     # converged reference objective — the "identical hinge" half of the
     # north star.  The reference is this solver at BENCH_SVM_REF_ROUNDS

@@ -187,7 +187,7 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _dw_choice(platform: Optional[str] = None) -> str:
+def _dw_choice() -> str:
     """FLINK_MS_SVM_DW: how the Gram engine applies the round-end
     Δw = Xᵀ Δα update.  "direct": one unsorted scatter-add over all
     (C·H·L) entries.  "sorted": gather the row-major contribution array
@@ -209,7 +209,7 @@ def _dw_choice(platform: Optional[str] = None) -> str:
     return choice
 
 
-def _step_choice(platform: str) -> str:
+def _step_choice() -> str:
     """FLINK_MS_SVM_STEP: how the Gram engine's SDCA step touches chain
     state.  "dynamic": per-chain dynamic gather of the Gram row + scatter-
     add into alpha — O(1) memory touched per step, but batched per-chain
@@ -269,10 +269,9 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
 
     H_rows = problem.rows_per_block
     d = problem.n_features
-    platform = mesh.devices.flat[0].platform
     inner = _resolve_inner(problem, config, mesh)
-    step_mode = _step_choice(platform)
-    dw_mode = _dw_choice(platform) if inner == "gram" else "direct"
+    step_mode = _step_choice()
+    dw_mode = _dw_choice() if inner == "gram" else "direct"
 
     def chain_sdca(w, idx_c, val_c, label_c, sqn_c, alpha_c, key_c):
         """H serial SDCA steps of ONE chain; vmapped over the C chains of a
@@ -532,8 +531,8 @@ def _cached_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         config.sigma_prime,
         str(config.dtype),
         _resolve_inner(problem, config, mesh),
-        _dw_choice(mesh.devices.flat[0].platform),
-        _step_choice(mesh.devices.flat[0].platform),
+        _dw_choice(),
+        _step_choice(),
     )
     fn = _FIT_CACHE.pop(key, None)
     if fn is None:

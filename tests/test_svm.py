@@ -276,10 +276,12 @@ def test_gram_inner_matches_scatter(rng):
 
 
 def test_gram_onehot_step_bit_identical_to_dynamic(rng, monkeypatch):
-    """FLINK_MS_SVM_STEP=onehot (the TPU default: dense mask/one-hot
-    contractions, RNG hoisted out of the loop) runs the identical index
-    sequence and multiplies only by exact 0s/1s, so the trained weights
-    must be BIT-identical to the dynamic gather/scatter step."""
+    """FLINK_MS_SVM_STEP=onehot (a selectable lowering: dense mask/
+    one-hot contractions, RNG hoisted out of the loop — chip-neutral
+    single-chip, kept for meshes where per-step latency resurfaces) runs
+    the identical index sequence and multiplies only by exact 0s/1s, so
+    the trained weights must be BIT-identical to the dynamic
+    gather/scatter step that "auto" resolves to."""
     data = _sparse_blob(rng, n=500, d=250, nnz_row=10)
     mesh = make_mesh(4)
     p = prepare_svm_blocked(data, 16, seed=0)
@@ -335,9 +337,10 @@ def test_gram_sorted_dw_matches_direct(rng, monkeypatch):
     monkeypatch.setenv("FLINK_MS_SVM_DW", "sorted")
     w_sorted = svm_fit(data, cfg, mesh, problem=p).weights
     np.testing.assert_allclose(w_sorted, w_direct, rtol=2e-4, atol=1e-6)
-    # presorted (the TPU default): values stored feature-sorted at prepare
-    # time, runtime gathers only the (C·H) Δα table — same reduction
-    # order as "sorted", so allclose to direct and EQUAL to sorted
+    # presorted (selectable; "auto" stays direct everywhere per the chip
+    # A/B): values stored feature-sorted at prepare time, runtime gathers
+    # only the (C·H) Δα table — same reduction order as "sorted", so
+    # allclose to direct and EQUAL to sorted
     monkeypatch.setenv("FLINK_MS_SVM_DW", "presorted")
     w_pre = svm_fit(data, cfg, mesh, problem=p).weights
     np.testing.assert_allclose(w_pre, w_direct, rtol=2e-4, atol=1e-6)
