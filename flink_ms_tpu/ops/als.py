@@ -406,15 +406,19 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
     vanishes through y itself (explicit A needs no weighting at all —
     one fewer (r, w, k) transient and multiply on the hot path).
 
-    ``post`` (fused mode): a per-chunk (A, b, extra_chunk) -> out stage
-    applied INSIDE each lax.map chunk — the fused assembly+solve path
-    hands the solve in here so the bucket's (rows, k, k) normal equations
-    never exist beyond one chunk's transient.  ``extra`` is an optional
-    (rows, ...) operand sliced alongside idx/val (the per-slot counts).
+    ``post`` (fused mode): a per-chunk (A, b, extra_chunk, in_scan=bool)
+    -> out stage applied INSIDE each lax.map chunk — the fused
+    assembly+solve path hands the solve in here so the bucket's
+    (rows, k, k) normal equations never exist beyond one chunk's
+    transient.  ``in_scan`` tells the stage whether it is being traced
+    inside the lax.map body (where the Pallas solver must use its
+    batch-major layout) or straight-line (lane-major compiles and is ~9%
+    faster).  ``extra`` is an optional (rows, ...) operand sliced
+    alongside idx/val (the per-slot counts).
     Chunking is over the batch row axis only (the contraction axis w is
     untouched), so chunked and unchunked results are arithmetically
     identical per row."""
-    def compute(idx_c, val_c, extra_c):
+    def compute(idx_c, val_c, extra_c, in_scan=False):
         y = jnp.take(y_all, idx_c, axis=0)                   # (r, w, k)
         # HIGHEST keeps f32 products (bf16 single-pass shifts the normal
         # equations enough to slow convergence at small lambda)
@@ -432,7 +436,7 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
                        preferred_element_type=dtype)
         if post is None:
             return A, b
-        return post(A, b, extra_c)
+        return post(A, b, extra_c, in_scan=in_scan)
 
     r, w = idx.shape
     k = y_all.shape[1]
@@ -477,8 +481,8 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
 
     def one_chunk(args):
         if extra is None:
-            return compute(args[0], args[1], None)
-        return compute(args[0], args[1], args[2])
+            return compute(args[0], args[1], None, in_scan=True)
+        return compute(args[0], args[1], args[2], in_scan=True)
 
     operands = (idx_c, val_c) if extra is None else (idx_c, val_c, extra_c)
     out = jax.lax.map(one_chunk, operands)
@@ -776,11 +780,11 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
             # exists.  The block's guaranteed dummy last slot gets its
             # zero row appended explicitly (the unfused path routes it
             # through a zero system + count mask).
-            def solve_chunk(A, bb, cnt):
+            def solve_chunk(A, bb, cnt, in_scan=False):
                 if yty is not None:
                     A = A + yty[None, :, :]
                 return _solve_factors(A, bb, cnt, lam, weighted, dtype,
-                                      platform, in_scan=True)
+                                      platform, in_scan=in_scan)
 
             xs = []
             off = 0
