@@ -171,6 +171,30 @@ def test_recovery_hang_backoff_skips_probe(monkeypatch):
 
 
 @pytest.mark.slow
+def test_tiny_als_section_records_resolved_knobs(monkeypatch):
+    """The ALS section artifact records RESOLVED kernel knobs (solver,
+    exchange dtype) — not raw 'auto' markers — and the exchange A/B
+    sections stay off on CPU runs."""
+    for k, v in {
+        "BENCH_USERS": "300", "BENCH_ITEMS": "200", "BENCH_NNZ": "5000",
+        "BENCH_RANK": "4", "BENCH_ITERS": "2", "BENCH_SKIP_CPU": "1",
+        "BENCH_SKIP_QUALITY": "1",
+    }.items():
+        monkeypatch.setenv(k, v)
+    import jax
+
+    from bench import run_als_section
+
+    out = run_als_section(jax.devices("cpu")[:1], "cpu", True)
+    assert out["als_solver"] == "lax"
+    assert out["als_exchange_dtype"] == "f32"
+    assert out["value"] > 0
+    for key in ("als_bf16_sec_per_iter", "als_f32_sec_per_iter",
+                "als_exchange_ab_error"):
+        assert key not in out, key
+
+
+@pytest.mark.slow
 def test_tiny_serving_section_clean(monkeypatch):
     """Serving section at a tiny config: all metric families present, no
     *_error keys."""
