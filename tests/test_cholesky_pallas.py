@@ -22,6 +22,27 @@ def test_matches_numpy(rng, k, n):
     np.testing.assert_allclose(x, x_ref, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.parametrize("k", [8, 50, 64])
+@pytest.mark.parametrize("n", [1, 100, 257])
+def test_batch_major_matches_lane_major(rng, k, n):
+    """The batch-major variant (per-tile VMEM transpose; forced inside
+    fused scan bodies, auto tile-halving at k=64) must agree with the
+    lane-major kernel — same elimination arithmetic, different operand
+    routing."""
+    G = rng.standard_normal((n, k, k)).astype(np.float32)
+    A = G @ G.transpose(0, 2, 1) + 5.0 * np.eye(k, dtype=np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    lane = np.asarray(cholesky_solve_batched(
+        jnp.asarray(A), jnp.asarray(b), layout="lane_major"))
+    batch = np.asarray(cholesky_solve_batched(
+        jnp.asarray(A), jnp.asarray(b), layout="batch_major"))
+    np.testing.assert_allclose(batch, lane, rtol=1e-5, atol=1e-6)
+    x_ref = np.linalg.solve(
+        A.astype(np.float64), b.astype(np.float64)[..., None]
+    )[..., 0]
+    np.testing.assert_allclose(batch, x_ref, rtol=2e-3, atol=2e-4)
+
+
 def test_als_fit_with_pallas_solver_matches_default(rng, monkeypatch):
     from flink_ms_tpu.ops import als as A
     from flink_ms_tpu.parallel.mesh import make_mesh
