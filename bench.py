@@ -438,10 +438,16 @@ def run_als_section(devices, platform, small: bool) -> dict:
     # inside the kernel): the on-chip sweep flips these per run, and the
     # quality anchor inherits them so a flipped default is convergence-
     # checked in the same artifact that times it
+    exch_env = os.environ.get("BENCH_ALS_EXCHANGE") or "auto"
+    if exch_env.lower() in ("f32", "float32", "none", "full"):
+        exch_env = None  # explicit full precision (jnp.dtype("f32") would
+        # otherwise fail at trace time deep inside the sweep)
+    elif exch_env.lower() == "bf16":
+        exch_env = "bfloat16"
     cfg = ALSConfig(
         num_factors=rank, iterations=1, lambda_=0.1, seed=42,
         assembly_precision=os.environ.get("BENCH_ALS_PRECISION", "highest"),
-        exchange_dtype=os.environ.get("BENCH_ALS_EXCHANGE") or "auto",
+        exchange_dtype=exch_env,
     )
     mesh = make_mesh(devices=devices)
     _log(f"[bench] ALS devices: {devices}, nnz={nnz}, rank={rank}")
