@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Run the moment the tunnel recovers (scripts/chip_probe.sh exits 0):
+# everything round 3 still wants from the chip, in priority order, each
+# step independent and timeout-bounded.  Artifacts under $OUT.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/on_recovery_r03}"
+mkdir -p "$OUT"
+
+step() {
+  local name="$1"; shift
+  echo "=== $name: $*" | tee -a "$OUT/run.log"
+  timeout "${STEP_TIMEOUT:-2700}" "$@" > "$OUT/$name.log" 2>&1
+  echo "    rc=$? ($(tail -c 160 "$OUT/$name.log" | tr '\n' ' '))" \
+    | tee -a "$OUT/run.log"
+}
+
+# 1. driver-entry compile check (the driver will run this single-chip)
+step entry python -c "
+from flink_ms_tpu.parallel.mesh import honor_platform_env
+honor_platform_env()
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.block_until_ready(jax.jit(fn)(*args))
+print('entry OK on', jax.devices()[0].platform)
+"
+
+# 2. full bench with the round-3 defaults (pallas solver + bf16 exchange
+#    + the in-artifact exchange A/B) -> candidate BENCH_local_r03 refresh
+BENCH_DETAIL_PATH="$OUT/bench_full.detail.json" \
+  timeout "${STEP_TIMEOUT:-2700}" python bench.py \
+  > "$OUT/bench_full.json" 2> "$OUT/bench_full.log"
+echo "bench_full rc=$?" | tee -a "$OUT/run.log"
+
+# 3. the segmented-anchor validation the K-sweep crashes motivated:
+#    K=1024 scatter config whose 40-round reference fit previously killed
+#    the worker in one >60 s dispatch — must now survive via segments
+step svm_k1024_anchor env BENCH_SECTIONS=svm BENCH_SVM_BLOCKS=1024 \
+  BENCH_SKIP_CPU=1 BENCH_DETAIL_PATH="$OUT/svm_k1024.detail.json" \
+  python bench.py
+
+echo "recovery run complete; artifacts in $OUT" | tee -a "$OUT/run.log"
