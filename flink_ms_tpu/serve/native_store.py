@@ -148,6 +148,19 @@ class NativeStore:
                 )
             raise OSError(f"tpums_open failed for {directory}")
         self.directory = directory
+        # guards every native call against close(): a thread that captured
+        # self._h just before close() frees the Store would otherwise
+        # dereference freed memory (TOCTOU caught by the round-3 long soak
+        # as a tpums_get I/O failure on a live key).  The native layer
+        # already serializes under its own mutex, so this adds no new
+        # contention — it only makes close() an exclusion point.
+        self._call_lock = threading.RLock()
+
+    def _live_handle(self):
+        h = self._h
+        if not h:
+            raise OSError(f"store {self.directory} is closed")
+        return h
 
     @staticmethod
     def _is_locked(directory: str) -> bool:
@@ -170,8 +183,10 @@ class NativeStore:
     def put(self, key: str, value: str) -> None:
         k = key.encode("utf-8")
         v = value.encode("utf-8")
-        if self._lib.tpums_put(self._h, k, len(k), v, len(v)) != 0:
-            raise OSError("tpums_put failed")
+        with self._call_lock:
+            if self._lib.tpums_put(self._live_handle(), k, len(k), v,
+                                   len(v)) != 0:
+                raise OSError("tpums_put failed")
 
     def ingest_buf(self, data: bytes, mode: int) -> Tuple[int, int]:
         """Bulk-ingest a chunk of complete journal lines natively.
@@ -180,10 +195,11 @@ class NativeStore:
         token).  -> (rows ingested, parse errors)."""
         rows = ctypes.c_uint64(0)
         errs = ctypes.c_uint64(0)
-        rc = self._lib.tpums_ingest_buf(
-            self._h, data, len(data), mode,
-            ctypes.byref(rows), ctypes.byref(errs),
-        )
+        with self._call_lock:
+            rc = self._lib.tpums_ingest_buf(
+                self._live_handle(), data, len(data), mode,
+                ctypes.byref(rows), ctypes.byref(errs),
+            )
         if rc != 0:
             raise OSError("tpums_ingest_buf failed")
         return int(rows.value), int(errs.value)
@@ -192,9 +208,11 @@ class NativeStore:
         k = key.encode("utf-8")
         vlen = ctypes.c_uint32()
         err = ctypes.c_int()
-        p = self._lib.tpums_get(
-            self._h, k, len(k), ctypes.byref(vlen), ctypes.byref(err)
-        )
+        with self._call_lock:
+            p = self._lib.tpums_get(
+                self._live_handle(), k, len(k), ctypes.byref(vlen),
+                ctypes.byref(err),
+            )
         if not p:
             if err.value:
                 # the key exists but its value could not be read — an I/O
@@ -208,14 +226,17 @@ class NativeStore:
 
     def delete(self, key: str) -> None:
         k = key.encode("utf-8")
-        self._lib.tpums_delete(self._h, k, len(k))
+        with self._call_lock:
+            self._lib.tpums_delete(self._live_handle(), k, len(k))
 
     def __len__(self) -> int:
-        return int(self._lib.tpums_count(self._h))
+        with self._call_lock:
+            return int(self._lib.tpums_count(self._live_handle()))
 
     def flush(self) -> None:
-        if self._lib.tpums_flush(self._h) != 0:
-            raise OSError("tpums_flush failed")
+        with self._call_lock:
+            if self._lib.tpums_flush(self._live_handle()) != 0:
+                raise OSError("tpums_flush failed")
 
     def keys(self) -> List[str]:
         """All live keys (keys are small; values stay on disk)."""
@@ -225,8 +246,9 @@ class NativeStore:
             out.append(ctypes.string_at(kp, klen).decode("utf-8"))
 
         cb_ref = _KEY_CB(cb)
-        if self._lib.tpums_keys(self._h, cb_ref, None) != 0:
-            raise OSError("tpums_keys failed")
+        with self._call_lock:
+            if self._lib.tpums_keys(self._live_handle(), cb_ref, None) != 0:
+                raise OSError("tpums_keys failed")
         return out
 
     def items(self) -> Iterator[Tuple[str, str]]:
@@ -240,15 +262,18 @@ class NativeStore:
 
     @property
     def log_bytes(self) -> int:
-        return int(self._lib.tpums_log_bytes(self._h))
+        with self._call_lock:
+            return int(self._lib.tpums_log_bytes(self._live_handle()))
 
     @property
     def live_bytes(self) -> int:
-        return int(self._lib.tpums_live_bytes(self._h))
+        with self._call_lock:
+            return int(self._lib.tpums_live_bytes(self._live_handle()))
 
     def compact(self) -> None:
-        if self._lib.tpums_compact(self._h) != 0:
-            raise OSError("tpums_compact failed")
+        with self._call_lock:
+            if self._lib.tpums_compact(self._live_handle()) != 0:
+                raise OSError("tpums_compact failed")
 
     def maybe_compact(self, min_bytes: int = 16 << 20) -> bool:
         if self.log_bytes > min_bytes and self.live_bytes * 2 < self.log_bytes:
@@ -257,9 +282,10 @@ class NativeStore:
         return False
 
     def close(self) -> None:
-        if self._h:
-            self._lib.tpums_close(self._h)
-            self._h = None
+        with self._call_lock:
+            if self._h:
+                self._lib.tpums_close(self._h)
+                self._h = None
 
     def __enter__(self):
         return self

@@ -60,22 +60,40 @@ class LookupServer:
         self.job_id = job_id
         self.topk_handlers = topk_handlers or {}
         self.requests = 0  # observability; also lets tests assert round trips
+        # live persistent connections + their handler threads: clients hold
+        # sockets open across many requests, so TCPServer.shutdown() alone
+        # leaves handlers serving AFTER stop() returns — the round-3 long
+        # soak caught a handler reading the native store after the owning
+        # job closed it (tpums I/O failure; a use-after-close)
+        self._conns: set = set()
+        self._conn_threads: set = set()
+        self._conn_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                while True:
-                    try:
-                        line = self.rfile.readline()
-                    except (ConnectionResetError, OSError):
-                        break
-                    if not line:
-                        break
-                    reply = outer._dispatch(line.decode("utf-8").rstrip("\n"))
-                    try:
-                        self.wfile.write(reply.encode("utf-8") + b"\n")
-                    except (BrokenPipeError, OSError):
-                        break
+                with outer._conn_lock:
+                    outer._conns.add(self.connection)
+                    outer._conn_threads.add(threading.current_thread())
+                try:
+                    while True:
+                        try:
+                            line = self.rfile.readline()
+                        except (ConnectionResetError, OSError):
+                            break
+                        if not line:
+                            break
+                        reply = outer._dispatch(
+                            line.decode("utf-8").rstrip("\n"))
+                        try:
+                            self.wfile.write(reply.encode("utf-8") + b"\n")
+                        except (BrokenPipeError, OSError):
+                            break
+                finally:
+                    with outer._conn_lock:
+                        outer._conns.discard(self.connection)
+                        outer._conn_threads.discard(
+                            threading.current_thread())
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -151,3 +169,19 @@ class LookupServer:
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        # quiesce persistent connections: shutting the sockets unblocks the
+        # handlers' readline, then join them so no request is in flight
+        # when the caller tears down the backing state (ServingJob.stop()
+        # closes the native store right after this returns)
+        import socket as _socket
+
+        with self._conn_lock:
+            conns = list(self._conns)
+            threads = list(self._conn_threads)
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=5)

@@ -35,6 +35,53 @@ def _wait_until(pred, timeout=20.0, interval=0.02):
     return False
 
 
+def test_stop_quiesces_persistent_connections(tmp_path, rng):
+    """ServingJob.stop() must not close the backing store while a handler
+    on a PERSISTENT client connection is still serving: TCPServer.shutdown
+    only stops the accept loop, and the round-3 long soak caught a top-k
+    read hitting the freed native store (tpums I/O failure).  Readers may
+    see connection errors at stop — never store-level E-replies."""
+    k, n = 4, 30
+    bus = str(tmp_path / "bus")
+    j = Journal(bus, "m")
+    j.append([F.format_als_row(i, t, rng.normal(size=k))
+              for t in ("U", "I") for i in range(n)], flush=True)
+    job = ServingJob(
+        Journal(bus, "m"), ALS_STATE, parse_als_record,
+        make_backend("rocksdb", str(tmp_path / "chk")),
+        host="127.0.0.1", port=0, poll_interval_s=0.01,
+    ).start()
+    assert _wait_until(lambda: len(job.table) >= 2 * n)
+
+    bad: list = []
+    running = threading.Event()
+
+    def hammer():
+        try:
+            with QueryClient("127.0.0.1", job.port, timeout_s=10) as c:
+                while True:
+                    running.set()
+                    r = c.topk(ALS_STATE, str(int(rng.integers(0, n))), 5)
+                    assert r is None or len(r) <= 5
+        except RuntimeError as e:
+            # an E-reply surfaced as RuntimeError = the server answered
+            # from a torn-down backend — exactly the bug
+            bad.append(repr(e))
+        except OSError:
+            pass  # connection shut by stop(): expected
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    assert running.wait(timeout=20)
+    time.sleep(0.2)  # handlers mid-request
+    job.stop()
+    for t in threads:
+        t.join(timeout=10)
+    assert not bad, bad
+
+
 @pytest.mark.slow
 def test_serving_soak_with_restart(tmp_path):
     rng = np.random.default_rng(0)
