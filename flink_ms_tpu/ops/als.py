@@ -866,6 +866,10 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         _solver_choice(),          # env overrides are baked in at trace
         _assembly_chunk_bytes(),   # time, so they key the executable
         _fused_solve(),
+        # the Pallas solver reads its layout knob at trace time too (when
+        # layout=None inside cholesky_solve_batched) — omitting it here
+        # would silently reuse an executable compiled under the old layout
+        os.environ.get("FLINK_MS_PALLAS_LAYOUT", "lane_major"),
     )
     fn = _SWEEP_CACHE.pop(key, None)
     if fn is None:

@@ -54,6 +54,51 @@ def _remote_plugins() -> tuple:
     )
 
 
+_CACHE_DIR_ENV = "FLINK_MS_COMPILE_CACHE_DIR"
+_cache_configured = False
+
+
+def enable_compile_cache() -> None:
+    """Point jax's persistent compilation cache at a stable host-local dir.
+
+    The big executables (ML-20M sweep, full-scale CoCoA fit) cost tens of
+    seconds each to compile through the tunneled remote-compile service,
+    and heavy compile traffic is the one observed trigger for tunnel
+    wedges.  A persistent cache means a benchmark re-run (in particular
+    the DRIVER'S end-of-round bench.py, which runs the exact shapes this
+    session already compiled) reuses executables instead of re-paying the
+    compile — fewer/shorter tunnel round-trips, lower wedge exposure.
+
+    Explicit user config wins: a pre-set JAX_COMPILATION_CACHE_DIR (or
+    FLINK_MS_COMPILE_CACHE_DIR=off) leaves everything untouched."""
+    global _cache_configured
+    if _cache_configured:
+        return
+    _cache_configured = True
+    want = os.environ.get(_CACHE_DIR_ENV, "")
+    if want.lower() in ("off", "0", "none"):
+        return
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # operator already chose a cache location
+    path = want or os.path.expanduser("~/.cache/flink_ms_tpu/jax_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return
+    for knob, val in (
+        ("jax_compilation_cache_dir", path),
+        # cache anything that took >=2s to compile regardless of size —
+        # the point is skipping tunnel compile round-trips, not disk thrift
+        ("jax_persistent_cache_min_compile_time_secs", 2.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass  # knob renamed/absent on this jax version: cache may be
+            # partially configured, which is still strictly better than none
+
+
 def honor_platform_env() -> None:
     """Apply an explicitly-set ``JAX_PLATFORMS`` before backend init.
 
@@ -72,6 +117,7 @@ def honor_platform_env() -> None:
     would unregister the CPU fallback that ``jax.devices("cpu")`` callers
     (benchmark baselines, host-side eval) rely on.
     """
+    enable_compile_cache()
     val = os.environ.get("JAX_PLATFORMS", "")
     if val and not any(p in val.split(",") for p in _ambient_accel_platforms()):
         try:

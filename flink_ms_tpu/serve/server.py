@@ -185,3 +185,15 @@ class LookupServer:
                 pass
         for t in threads:
             t.join(timeout=5)
+        # the quiesce guarantee must be ENFORCED, not assumed: a handler
+        # wedged in _dispatch (e.g. a long device-side TOPK) surviving the
+        # join would race the caller's store teardown — make it loud
+        wedged = [t.name for t in threads if t.is_alive()]
+        if wedged:
+            import logging
+
+            logging.getLogger(__name__).error(
+                "server stop(): %d handler thread(s) still alive after "
+                "quiesce join: %s — backing state teardown may race a live "
+                "request", len(wedged), wedged,
+            )

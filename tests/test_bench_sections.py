@@ -224,6 +224,15 @@ def test_tiny_serving_section_clean(monkeypatch):
 
     assert math.isfinite(out["mse_live_value"])
     assert 0.0 <= out["mse_live_value"] < 30.0, out["mse_live_value"]
+    # the real gate (VERDICT r3 weak #7): the live served value must match
+    # the offline ground truth computed from the same model files — live
+    # and offline read identical text rows, so they agree to float
+    # summation order; a serving-plane corruption (wrong rows, truncated
+    # payloads, missed keys silently skipped) moves the live value off the
+    # truth long before it hits any absolute band
+    assert out["mse_live_value"] == pytest.approx(
+        out["mse_offline_value"], rel=1e-6, abs=1e-9
+    ), (out["mse_live_value"], out["mse_offline_value"])
 
 
 def test_recovery_merge_flips_degraded_and_keeps_initial_error(monkeypatch):

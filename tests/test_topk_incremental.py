@@ -130,23 +130,23 @@ def test_p99_flat_under_streaming_writer(rng):
 
     t = threading.Thread(target=writer)
     t.start()
-    p50_bound = min(max(0.05, 25 * p50_quiet), 0.6)
-    p99_bound = min(max(0.15, 25 * p99_quiet), 0.6)
+    p50_bound = min(max(0.05, 10 * p50_quiet), 0.3)
+    p99_bound = min(max(0.15, 10 * p99_quiet), 0.3)
     try:
-        p50_busy, p99_busy = measure()
-        for _ in range(2):
-            # retry on any would-fail window: a rebuild-on-path design
-            # breaches deterministically on EVERY window (~1 s/query), while
-            # an external stall (this box has ONE core — a concurrent
-            # process import can freeze a whole 60-query window; round 3
-            # measured a 0.34 s p99 purely from a parallel bench run)
-            # passes a re-measurement
-            if p50_busy < p50_bound and p99_busy < p99_bound:
-                break
-            p50_busy, p99_busy = measure()
+        # three full windows, gate on the MEDIAN of each statistic
+        # (VERDICT r3 weak #6: the old retry-until-pass accepted if ANY
+        # window passed, so one clean window could absorb a real
+        # regression).  The median still rejects one externally-stalled
+        # window — this box has ONE core, and a concurrent process import
+        # can freeze a whole 60-query window (round 3 measured a 0.34 s
+        # p99 purely from a parallel bench run) — but a PERSISTENT
+        # regression inflates at least two of three windows and fails.
+        windows = [measure() for _ in range(3)]
     finally:
         stop.set()
         t.join()
+    p50_busy = sorted(w[0] for w in windows)[1]
+    p99_busy = sorted(w[1] for w in windows)[1]
     # full rebuilds are allowed under an unthrottled writer (the overload
     # path absorbs the backlog in a BACKGROUND thread) — what must hold is
     # that no query ever pays the O(catalog) rebuild: per-query work is
@@ -154,11 +154,11 @@ def test_p99_flat_under_streaming_writer(rng):
     # the ~1 s/query a rebuild-on-path design costs at this scale.  The
     # bound is relative to the quiet baseline (with an absolute floor) so
     # a loaded CI machine — where the GIL-hot writer amplifies any
-    # scheduling delay — doesn't flake the assertion.
-    # the 0.6 s cap keeps the relative slack below the ~1 s rebuild cost,
-    # so the assertion never disarms entirely on a slow machine
-    assert p50_busy < p50_bound, (p50_quiet, p50_busy)
-    assert p99_busy < p99_bound, (p99_quiet, p99_busy)
+    # scheduling delay — doesn't flake the assertion; the 0.3 s cap keeps
+    # the relative slack well below the ~1 s rebuild cost, so the
+    # assertion never disarms entirely on a slow machine
+    assert p50_busy < p50_bound, (p50_quiet, windows)
+    assert p99_busy < p99_bound, (p99_quiet, windows)
 
 def test_snapshot_drops_malformed_rows_keeps_catalog(rng):
     """One truncated payload, one over-long payload, one non-numeric
