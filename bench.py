@@ -314,6 +314,9 @@ def final_recovery_loop(result: dict, orig_env: dict, deadline: float,
         return
     if not result.get("degraded") or result.get("recovered"):
         return
+    if not any(sec in requested_sections for sec in ACCEL_SECTIONS):
+        return  # nothing accelerator-bound was asked for: recovery can
+        # never fire, so don't idle out the deadline
     interval = float(os.environ.get("BENCH_RECOVER_PROBE_INTERVAL_S", 120))
     attempts = 0
     while (time.time() < deadline and result.get("degraded")

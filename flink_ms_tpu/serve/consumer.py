@@ -209,6 +209,11 @@ class ServingJob:
             self.server = NativeLookupServer(
                 backend.store, state_name, job_id=self.job_id,
                 host=host, port=port,
+                # ALS planes serve the full verb set natively: TOPK/TOPKV
+                # score the "-I" catalog straight from the store (the
+                # Python plane's DeviceFactorIndex analog, C++-side)
+                topk_suffixes=("-I", "-U") if state_name == ALS_STATE
+                else None,
             )
         else:
             topk_handlers = {}

@@ -122,6 +122,11 @@ def _declare_abi(lib):
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p, ctypes.c_int,
     ]
+    lib.tpums_server_start2.restype = ctypes.c_void_p
+    lib.tpums_server_start2.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+    ]
     lib.tpums_server_port.restype = ctypes.c_int
     lib.tpums_server_port.argtypes = [ctypes.c_void_p]
     lib.tpums_server_requests.restype = ctypes.c_uint64
@@ -354,20 +359,26 @@ class NativeLookupServer:
     """C++ epoll lookup server (native/lookup_server.cpp) serving point GETs
     straight from an open NativeStore — the Netty-KvState-parity data plane
     with no Python on the hot path.  Same line protocol as
-    ``serve.server.LookupServer``; TOPK answers with an error (device-scored
-    top-k stays on the Python server).
+    ``serve.server.LookupServer``.  ``topk_suffixes=(item, user)`` (e.g.
+    ``("-I", "-U")`` for ALS planes) enables catalog-scored TOPK/TOPKV in
+    the C++ server; left None, those verbs answer E like a Python server
+    with no registered handler.
     """
 
     def __init__(self, store: NativeStore, state_name: str,
-                 job_id: str = "local", host: str = "0.0.0.0", port: int = 0):
+                 job_id: str = "local", host: str = "0.0.0.0", port: int = 0,
+                 topk_suffixes: Optional[Tuple[str, str]] = None):
         self._lib = store._lib
         self._store = store  # keep the store alive while the server reads it
-        self._h = self._lib.tpums_server_start(
+        item_suf, user_suf = topk_suffixes or (None, None)
+        self._h = self._lib.tpums_server_start2(
             store._h,
             state_name.encode("utf-8"),
             job_id.encode("utf-8"),
             host.encode("utf-8"),
             port,
+            item_suf.encode("utf-8") if item_suf else None,
+            user_suf.encode("utf-8") if user_suf else None,
         )
         if not self._h:
             raise OSError(

@@ -33,9 +33,10 @@ of those into a single round trip.
 A C++ epoll implementation of the same protocol
 (``native/lookup_server.cpp``, wrapped by
 ``native_store.NativeLookupServer``, enabled with ``--nativeServer true`` on
-the rocksdb backend) serves point GETs straight from the persistent store;
-this Python server is the default and the semantics contract, and the only
-one that answers TOPK.
+the rocksdb backend) serves the full verb set straight from the persistent
+store, including catalog-scored TOPK/TOPKV (round 4); this Python server is
+the default and the semantics contract — the native plane's replies are
+byte-parity-tested against it.
 """
 
 from __future__ import annotations

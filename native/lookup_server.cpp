@@ -12,9 +12,26 @@
 //                               for a whole batch of point lookups)
 //   COUNT\t<state>\n        ->  C\t<n>\n  (live key count via tpums_count)
 //   PING\n                  ->  PONG\t<job_id>\t<state>\n
-//   TOPK\t...\n             ->  E\tno topk index for state: <state>\n
-//                               (device-scored top-k stays on the Python
-//                               server — this is the point-lookup hot path)
+//   TOPK\t<state>\t<id>\t<k>\n    ->  V\titem:score;...\n | N\n | E\t...\n
+//   TOPKV\t<state>\t<k>\t<f;..>\n ->  V\titem:score;...\n | E\t...\n
+//                               (enabled via tpums_server_start2 suffixes;
+//                               unconfigured servers answer E for parity
+//                               with a Python LookupServer that has no
+//                               registered handler)
+//
+// Top-k scoring (serve/topk.py semantics, ALSPredict.java:74-83's dot
+// product run catalog-wide): the catalog is every store key with the item
+// suffix (modal payload width wins, malformed rows dropped — the same
+// policy as DeviceFactorIndex._snapshot_rows), scored against the query
+// vector.  Ranking uses lax.top_k's total order (NaN above +inf) with
+// ties to the lower catalog row; the catalog is id-sorted so ties are
+// deterministic across rebuilds (the Python plane's tie order is its
+// table's insertion order, so tie parity across planes is not promised).
+// The index is cached and rebuilt only when the store's (count,
+// log_bytes) pair moves — every put/delete/ingest changes log_bytes, so
+// a static model pays one scan then serves from the cache.  TOPK/TOPKV
+// run on a dedicated worker thread with in-order deferred replies, so
+// they never head-of-line-block point lookups on the epoll thread.
 //
 // One epoll thread, level-triggered, nonblocking sockets; per-connection
 // in/out buffers; EPOLLOUT armed only while a response is partially written.
@@ -32,10 +49,19 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
+#include <memory>
+#include <mutex>
+#include <charconv>
+#include <condition_variable>
+#include <deque>
+#include <cstdlib>
+#include <numeric>
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <vector>
 
 #include "tpums.h"
 
@@ -50,18 +76,74 @@ constexpr size_t kMaxOutBuffer = 16u << 20;
 // returns; level-triggered epoll re-delivers EPOLLIN for the remainder.
 constexpr int kMaxChunksPerEvent = 16;
 
+// A reply slot filled asynchronously by the top-k worker thread.  The
+// worker writes `text` then publishes with ready.store(release); the epoll
+// thread consumes slots strictly in FIFO order per connection, so
+// pipelined requests keep their reply order even when a slow TOPK sits
+// between two instant GETs.
+struct PendingReply {
+  std::atomic<bool> ready{false};
+  std::string text;
+  size_t req_bytes = 0;  // queued request payload accounted to the conn
+};
+
+// Cap on unconsumed reply slots per connection — a client flooding TOPKs
+// without reading responses is a slow reader by another name.
+constexpr size_t kMaxPendingReplies = 4096;
+
 struct Conn {
   int fd = -1;
   std::string in;   // bytes read, not yet parsed into complete lines
   std::string out;  // response bytes not yet written
+  std::deque<std::shared_ptr<PendingReply>> pending;  // in-order reply slots
+  size_t pending_req_bytes = 0;  // queued TOPK request payload bytes
   bool writable_armed = false;
   bool eof = false;  // client half-closed: answer what's buffered, then close
+};
+
+// Cached catalog index for TOPK/TOPKV: an immutable row-major (n, width)
+// snapshot swapped in whole.  All top-k work (including the first build)
+// runs on the dedicated worker thread, so the point-lookup hot path on
+// the epoll thread never waits on an O(catalog) scan; once a snapshot
+// exists, a moved store version kicks ONE further-background rebuild
+// while queries keep answering from the current (briefly stale) snapshot —
+// the same serve-stale design as serve/topk.py.
+struct TopkIndex {
+  std::vector<std::string> ids;
+  std::vector<float> matrix;
+  int width = 0;
+  uint64_t ver_count = ~0ull;
+  uint64_t ver_bytes = ~0ull;
+};
+
+// One queued unit of top-k work: the raw request operands plus the reply
+// slot already enqueued on the owning connection.  The shared_ptr keeps
+// the slot alive even if the connection closes before the work finishes.
+struct TopkTask {
+  std::shared_ptr<PendingReply> reply;
+  std::string verb, state, query_arg, k_s;
 };
 
 struct ServerState {
   void* store = nullptr;
   std::string state_name;
   std::string job_id;
+  std::string topk_item_suffix;  // non-empty = TOPK/TOPKV enabled
+  std::string topk_user_suffix;
+  std::mutex topk_mu;            // guards topk_cur swaps
+  std::shared_ptr<const TopkIndex> topk_cur;
+  std::atomic<bool> topk_building{false};
+  std::thread topk_builder;      // spawned/reaped on the topk worker thread
+                                 // only; final join in tpums_server_stop
+  // TOPK/TOPKV execute on a dedicated worker thread so an O(catalog)
+  // index build or score can never head-of-line-block the point-lookup
+  // hot path on the epoll thread (the Python plane gets the same
+  // isolation from its thread-per-connection model)
+  std::mutex task_mu;
+  std::condition_variable task_cv;
+  std::deque<TopkTask> tasks;
+  std::thread topk_worker;
+  std::atomic<bool> worker_stop{false};
   int listen_fd = -1;
   int epoll_fd = -1;
   int wake_fd = -1;  // eventfd: poked by tpums_server_stop
@@ -93,13 +175,285 @@ int split_tabs(const std::string& line, std::string* parts, int max_parts) {
   return n;
 }
 
-std::string handle_line(ServerState* s, const std::string& line) {
+bool ends_with(const std::string& str, const std::string& suf) {
+  return str.size() >= suf.size() &&
+         str.compare(str.size() - suf.size(), suf.size(), suf) == 0;
+}
+
+// Shortest round-trip float formatting matching Python's repr(float(f32)):
+// the f32 score widens to double exactly, std::to_chars emits the unique
+// shortest decimal, and an integral result gains Python's trailing ".0".
+// Python picks scientific notation only when |x| >= 1e16 or 0 < |x| < 1e-4;
+// bare to_chars picks whichever is SHORTER (100000.0 -> "1e+05"), so the
+// notation is forced explicitly to keep replies byte-identical.
+std::string format_score(float f) {
+  if (f != f) return "nan";  // Python repr never signs NaN; to_chars
+  // would emit "-nan" for the sign-bit-set QNaN that 0*inf produces
+  double d = static_cast<double>(f);
+  char buf[48];
+  double a = d < 0 ? -d : d;
+  bool scientific = d != 0.0 && (a >= 1e16 || a < 1e-4);
+  auto res = std::to_chars(buf, buf + sizeof(buf), d,
+                           scientific ? std::chars_format::scientific
+                                      : std::chars_format::fixed);
+  std::string out(buf, res.ptr);
+  if (out.find_first_of(".enai") == std::string::npos) out += ".0";
+  return out;
+}
+
+// Parse one float token with Python float() semantics: outer ASCII
+// whitespace stripped, one optional sign, then a locale-INDEPENDENT
+// general/inf/nan parse via std::from_chars (strtod would read a non-C
+// LC_NUMERIC set by the embedding process and silently reject '.'
+// decimals; from_chars also rejects hex floats, matching Python).
+bool parse_float_token(const char* b, const char* e, double* out) {
+  while (b < e && (*b == ' ' || *b == '\t' || *b == '\r' || *b == '\n'))
+    ++b;
+  while (e > b && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r' ||
+                   e[-1] == '\n'))
+    --e;
+  if (b >= e) return false;
+  bool neg = false;
+  if (*b == '+' || *b == '-') {  // from_chars accepts '-' but not '+'
+    neg = (*b == '-');
+    ++b;
+    if (b < e && (*b == '+' || *b == '-')) return false;  // "+-1"
+  }
+  double v = 0.0;
+  auto res = std::from_chars(b, e, v);
+  if (res.ec != std::errc() || res.ptr != e) return false;
+  *out = neg ? -v : v;
+  return true;
+}
+
+// Parse a ";"-separated payload into doubles, skipping empty tokens (the
+// Python parser's `float(t) for t if t`).  Returns false on any token that
+// does not fully parse; *bad_tok receives the offender for the error
+// message.
+bool parse_vector(const std::string& payload, std::vector<double>* out,
+                  std::string* bad_tok) {
+  out->clear();
+  size_t start = 0;
+  while (start <= payload.size()) {
+    size_t semi = payload.find(';', start);
+    size_t end = (semi == std::string::npos) ? payload.size() : semi;
+    if (end > start) {
+      double v = 0.0;
+      if (!parse_float_token(payload.data() + start, payload.data() + end,
+                             &v)) {
+        if (bad_tok) *bad_tok = payload.substr(start, end - start);
+        return false;
+      }
+      out->push_back(v);
+    }
+    if (semi == std::string::npos) break;
+    start = semi + 1;
+  }
+  return true;
+}
+
+// Build a fresh catalog snapshot from the store.  Width policy mirrors
+// DeviceFactorIndex._snapshot_rows: modal separator count wins (ties to
+// the smaller width, matching np.bincount().argmax()), rows off the modal
+// width or with non-numeric tokens are dropped.  The version pair is read
+// BEFORE the scan, so writes landing mid-scan leave the snapshot stale and
+// the next query kicks another rebuild (convergent, never lossy).
+std::shared_ptr<const TopkIndex> build_topk_index(ServerState* s) {
+  auto ix = std::make_shared<TopkIndex>();
+  ix->ver_count = tpums_count(s->store);
+  ix->ver_bytes = tpums_log_bytes(s->store);
+
+  // chunked enumeration: tpums_keys holds the store mutex for the WHOLE
+  // scan, which would stall concurrent point gets for the full catalog
+  // walk; the chunked variant bounds each lock hold.  A rehash between
+  // chunks can repeat keys — the id-sorted dedup below absorbs that.
+  std::vector<std::string> keys;
+  uint64_t cursor = 0;
+  while (tpums_keys_chunk(
+             s->store, &cursor, 8192,
+             [](const char* key, uint32_t klen, void* ctx) {
+               static_cast<std::vector<std::string>*>(ctx)->emplace_back(
+                   key, klen);
+             },
+             &keys) > 0) {
+  }
+  std::vector<std::string> ids, payloads;
+  std::vector<int> widths;
+  std::unordered_map<int, int> hist;
+  for (const std::string& key : keys) {
+    if (!ends_with(key, s->topk_item_suffix)) continue;
+    if (key.rfind("MEAN", 0) == 0) continue;     // cold-start rows
+    if (!key.empty() && key[0] == '\x01') continue;  // store-internal keys
+    uint32_t vlen = 0;
+    int err = 0;
+    char* buf = tpums_get(s->store, key.data(),
+                          static_cast<uint32_t>(key.size()), &vlen, &err);
+    if (!buf) continue;
+    std::string payload(buf, vlen);
+    tpums_free_buf(buf);
+    while (!payload.empty() && payload.back() == ';') payload.pop_back();
+    int w = 1 + static_cast<int>(
+        std::count(payload.begin(), payload.end(), ';'));
+    ids.push_back(key.substr(0, key.size() - s->topk_item_suffix.size()));
+    payloads.push_back(std::move(payload));
+    widths.push_back(w);
+    hist[w] += 1;
+  }
+  int modal = 0, best = 0;
+  for (const auto& kv : hist) {
+    if (kv.second > best || (kv.second == best && kv.first < modal)) {
+      modal = kv.first;
+      best = kv.second;
+    }
+  }
+  ix->width = modal;
+  // deterministic catalog order: tpums_keys enumerates the store's hash
+  // buckets, which would make exact-score ties nondeterministic across
+  // rebuilds — sort by id so tie-breaking is stable (lexicographically
+  // smaller id wins; the Python plane's own tie order is its table's
+  // insertion-dependent iteration order, so cross-plane tie parity is
+  // unattainable either way and only determinism is promised)
+  std::vector<uint32_t> order(ids.size());
+  std::iota(order.begin(), order.end(), 0u);
+  std::sort(order.begin(), order.end(), [&ids](uint32_t a, uint32_t b) {
+    return ids[a] < ids[b];
+  });
+  std::vector<double> row;
+  for (uint32_t i : order) {
+    if (widths[i] != modal) continue;
+    if (!ix->ids.empty() && ix->ids.back() == ids[i]) continue;  // chunk
+    // rehash duplicate: id-sorted, so repeats are adjacent — keep first
+    if (!parse_vector(payloads[i], &row, nullptr)) continue;
+    if (static_cast<int>(row.size()) != modal) continue;
+    ix->ids.push_back(std::move(ids[i]));
+    for (double v : row) ix->matrix.push_back(static_cast<float>(v));
+  }
+  return ix;
+}
+
+// Current snapshot for a query (runs on the top-k worker thread): build
+// inline the first time — only queued top-k work waits, never the epoll
+// loop — then serve-stale with at most one background rebuild in flight.
+std::shared_ptr<const TopkIndex> get_topk_index(ServerState* s) {
+  uint64_t count = tpums_count(s->store);
+  uint64_t bytes = tpums_log_bytes(s->store);
+  std::shared_ptr<const TopkIndex> cur;
+  {
+    std::lock_guard<std::mutex> g(s->topk_mu);
+    cur = s->topk_cur;
+  }
+  if (cur && cur->ver_count == count && cur->ver_bytes == bytes) return cur;
+  if (!cur) {
+    cur = build_topk_index(s);
+    std::lock_guard<std::mutex> g(s->topk_mu);
+    s->topk_cur = cur;
+    return cur;
+  }
+  bool expected = false;
+  if (s->topk_building.compare_exchange_strong(expected, true)) {
+    if (s->topk_builder.joinable()) s->topk_builder.join();  // reap done run
+    s->topk_builder = std::thread([s]() {
+      auto fresh = build_topk_index(s);
+      {
+        std::lock_guard<std::mutex> g(s->topk_mu);
+        s->topk_cur = std::move(fresh);
+      }
+      s->topk_building.store(false, std::memory_order_release);
+    });
+  }
+  return cur;  // briefly stale while the rebuild runs
+}
+
+// Score the catalog against `query` and format the top-k payload
+// ("item:score;..."), or an E line on a shape/parse failure.  Error
+// message text matches the Python server's byte-for-byte so clients see
+// one protocol regardless of plane.
+std::string topk_payload(ServerState* s, const std::string& query_payload,
+                         long k) {
+  std::shared_ptr<const TopkIndex> ix = get_topk_index(s);
+  std::vector<double> q;
+  std::string bad;
+  if (!parse_vector(query_payload, &q, &bad)) {
+    return "E\ttopk failed: could not convert string to float: '" + bad +
+           "'\n";
+  }
+  size_t n = ix->ids.size();
+  if (n == 0) return "V\t\n";  // empty index answers an empty payload
+  if (static_cast<int>(q.size()) != ix->width) {
+    return "E\ttopk failed: query has " + std::to_string(q.size()) +
+           " factors, index has " + std::to_string(ix->width) + "\n";
+  }
+  std::vector<float> scores(n);
+  const float* m = ix->matrix.data();
+  int w = ix->width;
+  for (size_t i = 0; i < n; ++i) {
+    double acc = 0.0;
+    const float* row = m + i * w;
+    for (int j = 0; j < w; ++j) acc += static_cast<double>(row[j]) * q[j];
+    scores[i] = static_cast<float>(acc);
+  }
+  size_t k_eff = std::min<size_t>(static_cast<size_t>(k), n);
+  std::vector<uint32_t> order(n);
+  std::iota(order.begin(), order.end(), 0u);
+  // total order matching lax.top_k (measured: NaN sorts ABOVE +inf, ties
+  // to the lower row index).  A plain `a > b` comparator is not a strict
+  // weak ordering once NaN appears (NaN != x is true while NaN > x is
+  // false) — UB for partial_sort; this ranking is total for any input.
+  auto score_gt = [](float a, float b) {
+    bool na = a != a, nb = b != b;
+    if (na || nb) return na && !nb;
+    return a > b;
+  };
+  std::partial_sort(order.begin(), order.begin() + k_eff, order.end(),
+                    [&scores, &score_gt](uint32_t a, uint32_t b) {
+                      if (score_gt(scores[a], scores[b])) return true;
+                      if (score_gt(scores[b], scores[a])) return false;
+                      return a < b;  // lax.top_k tie order
+                    });
+  std::string reply = "V\t";
+  for (size_t i = 0; i < k_eff; ++i) {
+    if (i) reply.push_back(';');
+    reply += ix->ids[order[i]];
+    reply.push_back(':');
+    reply += format_score(scores[order[i]]);
+  }
+  reply.push_back('\n');
+  return reply;
+}
+
+std::string handle_topk(ServerState* s, const std::string& verb,
+                        const std::string& state,
+                        const std::string& query_arg,
+                        const std::string& k_s) {
+  if (s->topk_item_suffix.empty() || state != s->state_name) {
+    return "E\tno topk index for state: " + state + "\n";
+  }
+  errno = 0;
+  char* endp = nullptr;
+  long k = strtol(k_s.c_str(), &endp, 10);
+  if (k_s.empty() || endp != k_s.c_str() + k_s.size()) {
+    return "E\ttopk failed: invalid literal for int() with base 10: '" +
+           k_s + "'\n";
+  }
+  if (k < 1) return "E\tk must be >= 1\n";
+  if (verb == "TOPKV") return topk_payload(s, query_arg, k);
+  // TOPK: resolve the query entity's factors from the store (key
+  // "<id><user_suffix>"), then score like TOPKV
+  std::string user_key = query_arg + s->topk_user_suffix;
+  uint32_t vlen = 0;
+  int err = 0;
+  char* buf = tpums_get(s->store, user_key.data(),
+                        static_cast<uint32_t>(user_key.size()), &vlen, &err);
+  if (!buf) return "N\n";
+  std::string payload(buf, vlen);
+  tpums_free_buf(buf);
+  return topk_payload(s, payload, k);
+}
+
+// Answer a non-TOPK request from its pre-split parts (submit_line owns the
+// single split_tabs pass — the point-lookup hot path is parsed once).
+std::string handle_line(ServerState* s, const std::string* parts, int n) {
   s->requests.fetch_add(1, std::memory_order_relaxed);
-  // 5 slots: one more than the widest verb, so an over-long request is
-  // distinguishable from an exact TOPK (Python splits unbounded; parity
-  // demands "TOPK\ta\tb\tc\td" be a bad request, not a TOPK)
-  std::string parts[5];
-  int n = split_tabs(line, parts, 5);
   if (parts[0] == "PING") {  // Python matches on parts[0] alone
     return "PONG\t" + s->job_id + "\t" + s->state_name + "\n";
   }
@@ -155,11 +509,105 @@ std::string handle_line(ServerState* s, const std::string& line) {
     reply.push_back('\n');
     return reply;
   }
-  if ((parts[0] == "TOPK" || parts[0] == "TOPKV") && n == 4) {
-    // parity with a Python LookupServer that has no registered handler
-    return "E\tno topk index for state: " + parts[1] + "\n";
-  }
+  // TOPK/TOPKV never reach here — submit_line routes them to the worker
+  // thread before handle_line is called
   return "E\tbad request\n";
+}
+
+// Dedicated top-k worker: pops tasks, computes the (possibly O(catalog))
+// reply off the epoll thread, publishes it into the connection's reply
+// slot, and pokes the event loop via the eventfd to flush.
+void topk_worker_loop(ServerState* s) {
+  uint64_t one = 1;
+  while (true) {
+    TopkTask task;
+    {
+      std::unique_lock<std::mutex> lk(s->task_mu);
+      s->task_cv.wait(lk, [s] {
+        return s->worker_stop.load(std::memory_order_acquire) ||
+               !s->tasks.empty();
+      });
+      if (s->worker_stop.load(std::memory_order_acquire)) return;
+      task = std::move(s->tasks.front());
+      s->tasks.pop_front();
+    }
+    if (task.reply.use_count() > 1) {  // conn still holds its slot — a
+      // closed connection's orphaned tasks skip the O(catalog) work
+      task.reply->text =
+          handle_topk(s, task.verb, task.state, task.query_arg, task.k_s);
+    }
+    task.reply->ready.store(true, std::memory_order_release);
+    ssize_t wr = write(s->wake_fd, &one, 8);
+    (void)wr;
+  }
+}
+
+// Move every completed reply at the FRONT of the pending queue into the
+// connection's out buffer (strict FIFO: an unfinished TOPK blocks only
+// replies behind it on ITS connection).
+void drain_ready_replies(Conn* c) {
+  while (!c->pending.empty() &&
+         c->pending.front()->ready.load(std::memory_order_acquire)) {
+    c->out += c->pending.front()->text;
+    c->pending_req_bytes -= c->pending.front()->req_bytes;
+    c->pending.pop_front();
+  }
+}
+
+// Answer one request line: TOPK verbs are enqueued for the worker thread
+// (reply slot keeps pipelined order); everything else answers inline.
+// Returns false when the connection must close (pending-flood protection).
+bool submit_line(ServerState* s, Conn* c, const std::string& line) {
+  // 5 slots: one more than the widest verb, so an over-long request is
+  // distinguishable from an exact TOPK (Python splits unbounded; parity
+  // demands "TOPK\ta\tb\tc\td" be a bad request, not a TOPK)
+  std::string parts[5];
+  int n = split_tabs(line, parts, 5);
+  if ((parts[0] == "TOPK" || parts[0] == "TOPKV") && n == 4) {
+    s->requests.fetch_add(1, std::memory_order_relaxed);
+    // slot-count AND byte cap: queued tasks copy the request payload, so
+    // a flood of max-size TOPKV lines must trip the same slow-reader
+    // policy as buffered responses, not grow the heap unboundedly
+    if (c->pending.size() >= kMaxPendingReplies ||
+        c->pending_req_bytes + line.size() > kMaxOutBuffer) {
+      return false;
+    }
+    auto reply = std::make_shared<PendingReply>();
+    reply->req_bytes = line.size();
+    c->pending_req_bytes += line.size();
+    c->pending.push_back(reply);
+    // TOPK operands: state, id, k; TOPKV operands: state, k, payload
+    TopkTask task{std::move(reply), parts[0], parts[1],
+                  parts[0] == "TOPK" ? parts[2] : parts[3],
+                  parts[0] == "TOPK" ? parts[3] : parts[2]};
+    {
+      std::lock_guard<std::mutex> lk(s->task_mu);
+      s->tasks.push_back(std::move(task));
+    }
+    s->task_cv.notify_one();
+    return true;
+  }
+  std::string text = handle_line(s, parts, n);
+  if (c->pending.empty()) {
+    c->out += text;
+  } else {
+    // an async reply is still in flight ahead of us: preserve reply order.
+    // Parked reply text counts against the same byte cap as queued TOPK
+    // payloads — the slow-reader check only sees c->out, and a client
+    // pipelining GETs behind a slow TOPK without reading must not grow
+    // the pending queue unboundedly.
+    if (c->pending.size() >= kMaxPendingReplies ||
+        c->pending_req_bytes + text.size() > kMaxOutBuffer) {
+      return false;
+    }
+    auto slot = std::make_shared<PendingReply>();
+    slot->req_bytes = text.size();
+    c->pending_req_bytes += text.size();
+    slot->text = std::move(text);
+    slot->ready.store(true, std::memory_order_release);
+    c->pending.push_back(std::move(slot));
+  }
+  return true;
 }
 
 void arm_writable(ServerState* s, Conn* c, bool want) {
@@ -197,15 +645,18 @@ bool flush_out(ServerState* s, Conn* c) {
 }
 
 // Answer every complete line buffered in c->in, leaving the partial tail.
-void drain_lines(ServerState* s, Conn* c) {
+// false = close the conn (pending-flood protection tripped).
+bool drain_lines(ServerState* s, Conn* c) {
   size_t start = 0;
-  while (true) {
+  bool ok = true;
+  while (ok) {
     size_t nl = c->in.find('\n', start);
     if (nl == std::string::npos) break;
-    c->out += handle_line(s, c->in.substr(start, nl - start));
+    ok = submit_line(s, c, c->in.substr(start, nl - start));
     start = nl + 1;
   }
   c->in.erase(0, start);
+  return ok;
 }
 
 // Read available bytes, answer every complete line; false = close the conn.
@@ -217,7 +668,7 @@ bool on_readable(ServerState* s, Conn* c) {
       c->in.append(chunk, static_cast<size_t>(r));
       // parse as we go so the cap bounds ONE request line, not a burst of
       // pipelined small requests
-      drain_lines(s, c);
+      if (!drain_lines(s, c)) return false;
       if (c->in.size() > kMaxLine) return false;   // oversized request line
       if (c->out.size() > kMaxOutBuffer) return false;  // slow reader
       continue;
@@ -229,23 +680,35 @@ bool on_readable(ServerState* s, Conn* c) {
     if (errno == EAGAIN || errno == EWOULDBLOCK) break;
     return false;
   }
-  drain_lines(s, c);
+  if (!drain_lines(s, c)) return false;
   if (c->eof && !c->in.empty()) {
     // final line without '\n': readline()-at-EOF answers it, so we do too
-    c->out += handle_line(s, c->in);
+    bool ok = submit_line(s, c, c->in);
     c->in.clear();
+    if (!ok) return false;
   }
+  drain_ready_replies(c);
   return flush_out(s, c);
 }
 
 void event_loop(ServerState* s) {
   epoll_event events[64];
+  // Closes are DEFERRED to the end of each epoll batch: closing an fd
+  // mid-batch lets accept() reuse the number within the same batch, and a
+  // stale event later in the batch would then hit the brand-new
+  // connection.  Keeping doomed fds open (just marked) until the batch
+  // ends makes fd reuse impossible while any of its events are pending.
+  std::vector<int> doomed;
+  auto is_doomed = [&doomed](int fd) {
+    return std::find(doomed.begin(), doomed.end(), fd) != doomed.end();
+  };
   while (!s->stop.load(std::memory_order_acquire)) {
     int n = epoll_wait(s->epoll_fd, events, 64, -1);
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
     }
+    doomed.clear();
     for (int i = 0; i < n; ++i) {
       int fd = events[i].data.fd;
       uint32_t ev = events[i].events;
@@ -253,6 +716,18 @@ void event_loop(ServerState* s) {
         uint64_t tok;
         ssize_t rd = read(s->wake_fd, &tok, 8);
         (void)rd;
+        // the worker finished one or more top-k replies: flush every
+        // connection whose pending front is now ready
+        for (auto& kv : s->conns) {
+          if (is_doomed(kv.first)) continue;
+          Conn* cc = &kv.second;
+          drain_ready_replies(cc);
+          bool cok = flush_out(s, cc);
+          if (cok && cc->out.size() > kMaxOutBuffer) cok = false;
+          if (cok && cc->eof && cc->out.empty() && cc->pending.empty())
+            cok = false;  // half-closed and fully answered
+          if (!cok) doomed.push_back(kv.first);
+        }
         continue;  // stop flag is checked at the top of the loop
       }
       if (fd == s->listen_fd) {
@@ -276,6 +751,7 @@ void event_loop(ServerState* s) {
         }
         continue;
       }
+      if (is_doomed(fd)) continue;
       auto it = s->conns.find(fd);
       if (it == s->conns.end()) continue;
       Conn* c = &it->second;
@@ -284,10 +760,12 @@ void event_loop(ServerState* s) {
       if (ok && (ev & EPOLLIN)) ok = on_readable(s, c);
       if (ok && (ev & EPOLLOUT)) ok = flush_out(s, c);
       // half-closed and fully answered (EPOLLHUP arrives with EPOLLIN on a
-      // shutdown(WR) peer — the buffered requests must still be served)
-      if (ok && c->eof && c->out.empty()) ok = false;
-      if (!ok) close_conn(s, fd);
+      // shutdown(WR) peer — the buffered requests must still be served,
+      // including in-flight top-k replies)
+      if (ok && c->eof && c->out.empty() && c->pending.empty()) ok = false;
+      if (!ok) doomed.push_back(fd);
     }
+    for (int fd : doomed) close_conn(s, fd);
   }
   for (auto& kv : s->conns) close(kv.first);
   s->conns.clear();
@@ -304,13 +782,17 @@ void destroy(ServerState* s) {
 
 extern "C" {
 
-void* tpums_server_start(void* store, const char* state_name,
-                         const char* job_id, const char* host, int port) {
+void* tpums_server_start2(void* store, const char* state_name,
+                          const char* job_id, const char* host, int port,
+                          const char* topk_item_suffix,
+                          const char* topk_user_suffix) {
   if (!store || !state_name) return nullptr;
   auto* s = new ServerState();
   s->store = store;
   s->state_name = state_name;
   s->job_id = job_id ? job_id : "local";
+  s->topk_item_suffix = topk_item_suffix ? topk_item_suffix : "";
+  s->topk_user_suffix = topk_user_suffix ? topk_user_suffix : "";
 
   s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -361,7 +843,14 @@ void* tpums_server_start(void* store, const char* state_name,
     return nullptr;
   }
   s->loop = std::thread(event_loop, s);
+  s->topk_worker = std::thread(topk_worker_loop, s);
   return s;
+}
+
+void* tpums_server_start(void* store, const char* state_name,
+                         const char* job_id, const char* host, int port) {
+  return tpums_server_start2(store, state_name, job_id, host, port, nullptr,
+                             nullptr);
 }
 
 int tpums_server_port(void* srv) {
@@ -380,6 +869,12 @@ void tpums_server_stop(void* srv) {
   ssize_t wr = write(s->wake_fd, &one, 8);
   (void)wr;
   if (s->loop.joinable()) s->loop.join();
+  // epoll thread gone -> no new tasks; stop the worker, then reap the
+  // last background index build before freeing state they read
+  s->worker_stop.store(true, std::memory_order_release);
+  s->task_cv.notify_all();
+  if (s->topk_worker.joinable()) s->topk_worker.join();
+  if (s->topk_builder.joinable()) s->topk_builder.join();
   destroy(s);
 }
 

@@ -378,6 +378,32 @@ int tpums_keys(void* h, tpums_key_cb cb, void* ctx) {
   return 0;
 }
 
+// Bounded-lock key enumeration: emits the keys of whole hash buckets
+// [*cursor, ...) until at least max_keys have been emitted or the table is
+// exhausted, advancing *cursor past the buckets consumed.  Returns the
+// number emitted (0 = done).  The lock is held only per chunk, so a large
+// catalog scan (e.g. the lookup server's top-k index build) cannot stall
+// concurrent gets for the whole enumeration.  A rehash between chunks may
+// skip or repeat keys — callers needing an exact snapshot use tpums_keys;
+// convergent consumers (version-checked index rebuilds) dedup/retry.
+uint64_t tpums_keys_chunk(void* h, uint64_t* cursor, uint64_t max_keys,
+                          tpums_key_cb cb, void* ctx) {
+  if (!h || !cursor) return 0;
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  uint64_t nbuckets = s->index.bucket_count();
+  uint64_t emitted = 0;
+  uint64_t b = *cursor;
+  for (; b < nbuckets && emitted < max_keys; ++b) {
+    for (auto it = s->index.begin(b); it != s->index.end(b); ++it) {
+      cb(it->first.data(), static_cast<uint32_t>(it->first.size()), ctx);
+      ++emitted;
+    }
+  }
+  *cursor = b;
+  return emitted;
+}
+
 uint64_t tpums_log_bytes(void* h) {
   if (!h) return 0;
   Store* s = static_cast<Store*>(h);

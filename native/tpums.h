@@ -34,6 +34,11 @@ uint64_t tpums_count(void* h);
 int tpums_flush(void* h);
 typedef void (*tpums_key_cb)(const char* key, uint32_t klen, void* ctx);
 int tpums_keys(void* h, tpums_key_cb cb, void* ctx);
+// Bounded-lock variant: emits whole hash buckets from *cursor until
+// >= max_keys keys, advancing the cursor; returns the count (0 = done).
+// A rehash between chunks may skip/repeat keys — convergent consumers only.
+uint64_t tpums_keys_chunk(void* h, uint64_t* cursor, uint64_t max_keys,
+                          tpums_key_cb cb, void* ctx);
 uint64_t tpums_log_bytes(void* h);
 uint64_t tpums_live_bytes(void* h);
 int tpums_compact(void* h);
@@ -41,11 +46,19 @@ void tpums_close(void* h);
 
 // -- lookup server (lookup_server.cpp) --------------------------------------
 // Starts an epoll event loop on its own thread, serving the line protocol of
-// flink_ms_tpu/serve/server.py (GET/PING; TOPK answers E — device-scored
-// top-k stays on the Python server) from the given open store handle.
-// `port` 0 picks an ephemeral port. Returns a server handle or nullptr.
+// flink_ms_tpu/serve/server.py (GET/MGET/COUNT/PING/TOPK/TOPKV) from the
+// given open store handle.  `port` 0 picks an ephemeral port.  Returns a
+// server handle or nullptr.  tpums_server_start leaves TOPK/TOPKV
+// unconfigured (they answer E, parity with a Python server that has no
+// registered handler); tpums_server_start2 additionally takes the catalog
+// item-key suffix (e.g. "-I") and the TOPK query-entity suffix (e.g. "-U"),
+// enabling catalog-scored top-k straight from the store.
 void* tpums_server_start(void* store, const char* state_name,
                          const char* job_id, const char* host, int port);
+void* tpums_server_start2(void* store, const char* state_name,
+                          const char* job_id, const char* host, int port,
+                          const char* topk_item_suffix,
+                          const char* topk_user_suffix);
 int tpums_server_port(void* srv);
 uint64_t tpums_server_requests(void* srv);
 // Stops the loop, closes all connections, joins the thread, frees the handle.

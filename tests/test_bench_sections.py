@@ -225,13 +225,15 @@ def test_tiny_serving_section_clean(monkeypatch):
     assert math.isfinite(out["mse_live_value"])
     assert 0.0 <= out["mse_live_value"] < 30.0, out["mse_live_value"]
     # the real gate (VERDICT r3 weak #7): the live served value must match
-    # the offline ground truth computed from the same model files — live
-    # and offline read identical text rows, so they agree to float
-    # summation order; a serving-plane corruption (wrong rows, truncated
-    # payloads, missed keys silently skipped) moves the live value off the
-    # truth long before it hits any absolute band
+    # the offline ground truth computed from the same model files.  The two
+    # paths read identical text rows but compute at different precisions
+    # (offline scores through f32 jax _predict_dense, live through f64
+    # numpy dots), so the tolerance allows per-prediction f32 rounding —
+    # abs ~1e-5 bounds it at any MSE magnitude — while a serving-plane
+    # corruption (wrong rows, truncated payloads, silently missed keys)
+    # moves the live value by far more
     assert out["mse_live_value"] == pytest.approx(
-        out["mse_offline_value"], rel=1e-6, abs=1e-9
+        out["mse_offline_value"], rel=1e-4, abs=1e-5
     ), (out["mse_live_value"], out["mse_offline_value"])
 
 

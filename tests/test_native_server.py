@@ -4,6 +4,7 @@ integration (end-to-end journal -> native store -> C++ data plane)."""
 
 import socket
 import threading
+import time
 
 import pytest
 
@@ -197,9 +198,11 @@ def test_serving_job_native_server_end_to_end(tmp_path):
                 deadline -= 1
             assert c.query_state(ALS_STATE, "1-U") == "0.5;1.5"
             assert c.query_state(ALS_STATE, "7-I") == "3.0;4.0"
-            # TOPK is a Python-server feature; the native plane must say so
-            with pytest.raises(Exception):
-                c.topk(ALS_STATE, "1", 3)
+            # the native ALS plane serves the full verb set: TOPK scores
+            # the "-I" catalog straight from the store (round 4; it used
+            # to answer E)
+            got = c.topk(ALS_STATE, "1", 3)
+            assert got == [("7", pytest.approx(0.5 * 3.0 + 1.5 * 4.0))]
     finally:
         job.stop()
 
@@ -219,3 +222,234 @@ def test_mget_batches_native(server):
         assert vals == ["2.0;-1.0", None, "0.5;1.5"]
         assert server.requests == before + 1
         assert c.query_states(ALS_STATE, []) == []
+
+
+# -- native TOPK/TOPKV (VERDICT r3 missing #2: the C++ plane now serves the
+# -- full verb set; serve/topk.py + server.py are the semantics contract)
+
+def _als_store(tmp_path, rows):
+    s = NativeStore(str(tmp_path / "topk_store"))
+    for k, v in rows:
+        s.put(k, v)
+    return s
+
+
+def _als_pyserver(rows):
+    from flink_ms_tpu.serve.topk import make_als_topk_handler
+
+    table = ModelTable(2)
+    for k, v in rows:
+        table.put(k, v)
+    return LookupServer(
+        {ALS_STATE: table}, host="127.0.0.1", port=0, job_id="jid",
+        topk_handlers={ALS_STATE: make_als_topk_handler(table)},
+    ).start()
+
+
+# factor values on a 0.25 grid: every product and 4-term sum is exactly
+# representable in f32, so the XLA-scored Python plane and the C++ plane
+# compute bit-identical scores and byte-identical formatted payloads
+_EXACT_ROWS = [
+    ("10-I", "1.0;0.5;-2.0;0.25"),
+    ("11-I", "0.5;0.5;0.5;0.5"),
+    ("12-I", "-1.0;2.0;1.5;-0.5"),
+    ("13-I", "2.0;-0.25;0.75;1.0"),
+    ("7-U", "1.0;2.0;0.5;-1.0"),
+    ("MEAN-I", "9.0;9.0;9.0;9.0"),      # cold-start row: excluded
+    ("bad-I", "1.0;2.0"),               # off the modal width: dropped
+]
+
+
+def test_native_topkv_byte_parity(tmp_path):
+    # formatting edges ride along: a 4e5-scale score (Python repr stays
+    # fixed-notation where bare to_chars would flip to "4e+05") and a
+    # ~1e-5 score (scientific on both sides)
+    rows = _EXACT_ROWS + [
+        ("20-I", "400000.0;0.0;0.0;0.0"),
+        ("21-I", "0.00001;0.0;0.0;0.0"),
+    ]
+    pysrv = _als_pyserver(rows)
+    store = _als_store(tmp_path, rows)
+    requests = (
+        b"TOPKV\tALS_MODEL\t3\t1.0;2.0;0.5;-1.0\n"
+        b"TOPKV\tALS_MODEL\t99\t1.0;2.0;0.5;-1.0\n"   # k > catalog
+        b"TOPK\tALS_MODEL\t7\t2\n"                     # resolves 7-U
+        b"TOPK\tALS_MODEL\tmissing\t2\n"               # unknown user -> N
+        b"TOPKV\tALS_MODEL\t0\t1.0\n"                  # k < 1
+        b"TOPKV\tALS_MODEL\tx\t1.0\n"                  # non-integer k
+        b"TOPKV\tALS_MODEL\t2\t1.0;2.0\n"              # width mismatch
+        b"TOPKV\tALS_MODEL\t2\t1.0;oops;3.0;4.0\n"     # non-numeric token
+        b"TOPKV\tOTHER\t2\t1.0\n"                      # unknown state
+    )
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            native = _raw(nsrv.port, requests)
+            python = _raw(pysrv.port, requests)
+            assert native == python, (native, python)
+    finally:
+        pysrv.stop()
+        store.close()
+
+
+def test_native_topkv_semantic_parity_random(tmp_path):
+    """Random float factors: ranking identical, scores equal to f32
+    round-off (the planes may differ in accumulation order)."""
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    rows = [(f"{i}-I", ";".join(repr(float(x)) for x in rng.normal(size=6)))
+            for i in range(40)]
+    rows += [(f"{u}-U", ";".join(repr(float(x)) for x in rng.normal(size=6)))
+             for u in range(3)]
+    pysrv = _als_pyserver(rows)
+    store = _als_store(tmp_path, rows)
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            with QueryClient("127.0.0.1", nsrv.port) as nc, \
+                    QueryClient("127.0.0.1", pysrv.port) as pc:
+                payload = ";".join(repr(float(x))
+                                   for x in rng.normal(size=6))
+                nat = nc.topk_by_vector(ALS_STATE, payload, 7)
+                pyr = pc.topk_by_vector(ALS_STATE, payload, 7)
+                assert [i for i, _ in nat] == [i for i, _ in pyr]
+                for (_, a), (_, b) in zip(nat, pyr):
+                    assert a == pytest.approx(b, rel=1e-5, abs=1e-5)
+                nat_u = nc.topk(ALS_STATE, "1", 5)
+                pyr_u = pc.topk(ALS_STATE, "1", 5)
+                assert [i for i, _ in nat_u] == [i for i, _ in pyr_u]
+    finally:
+        pysrv.stop()
+        store.close()
+
+
+def test_native_topkv_index_refreshes_on_store_change(tmp_path):
+    store = _als_store(tmp_path, _EXACT_ROWS)
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            with QueryClient("127.0.0.1", nsrv.port) as c:
+                def poll_until(expect_ids, k):
+                    deadline = time.time() + 20
+                    while time.time() < deadline:
+                        got = c.topk_by_vector(
+                            ALS_STATE, "1.0;0.0;0.0;0.0", k)
+                        if [i for i, _ in got] == expect_ids:
+                            return got
+                        time.sleep(0.02)
+                    return got
+
+                got = c.topk_by_vector(ALS_STATE, "1.0;0.0;0.0;0.0", 1)
+                assert got[0][0] == "13"      # 2.0 leads dim 0
+                # overwrite an existing row to the new best: the version
+                # proxy (count unchanged, log_bytes grew) must invalidate.
+                # Serve-stale semantics: the change lands via a BACKGROUND
+                # rebuild, so poll rather than assert the first answer.
+                store.put("11-I", "50.0;0.0;0.0;0.0")
+                got = poll_until(["11"], 1)
+                assert got[0] == ("11", 50.0)
+                # and a brand-new item (count changes) lands too
+                store.put("99-I", "100.0;0.0;0.0;0.0")
+                got = poll_until(["99", "11"], 2)
+                assert [i for i, _ in got] == ["99", "11"]
+    finally:
+        store.close()
+
+
+def test_native_topkv_serve_stale_under_writes(tmp_path):
+    """A streaming writer must not head-of-line-block the plane: once a
+    snapshot exists, queries under continuous writes answer from the
+    current (possibly stale) index while the rebuild runs in the
+    background, and the new best eventually lands."""
+    store = _als_store(tmp_path, _EXACT_ROWS)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            store.put(f"{100 + (i % 50)}-I", "0.125;0.125;0.125;0.125")
+            i += 1
+
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            with QueryClient("127.0.0.1", nsrv.port, timeout_s=30) as c:
+                c.topk_by_vector(ALS_STATE, "1.0;0.0;0.0;0.0", 1)  # build
+                t = threading.Thread(target=writer)
+                t.start()
+                try:
+                    # under the writer every query window sees a moved
+                    # version; answers must keep coming (stale is fine)
+                    for _ in range(50):
+                        got = c.topk_by_vector(
+                            ALS_STATE, "1.0;0.0;0.0;0.0", 1)
+                        assert got, "no answer under streaming writes"
+                    # a decisive new best lands once a rebuild completes
+                    store.put("999-I", "1000.0;0.0;0.0;0.0")
+                    deadline = time.time() + 20
+                    while time.time() < deadline:
+                        got = c.topk_by_vector(
+                            ALS_STATE, "1.0;0.0;0.0;0.0", 1)
+                        if got and got[0][0] == "999":
+                            break
+                        time.sleep(0.05)
+                    assert got[0][0] == "999"
+                finally:
+                    stop.set()
+                    t.join()
+    finally:
+        store.close()
+
+
+def test_native_topkv_empty_catalog(tmp_path):
+    store = NativeStore(str(tmp_path / "empty_store"))
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            out = _raw(nsrv.port, b"TOPKV\tALS_MODEL\t3\t1.0;2.0\n")
+            assert out == b"V\t\n"
+    finally:
+        store.close()
+
+
+def test_native_topkv_pipelined_reply_order(tmp_path):
+    """A GET pipelined behind a TOPKV on one connection must come back
+    AFTER the TOPKV reply even though the top-k runs on the worker thread
+    (per-connection FIFO via deferred reply slots)."""
+    store = _als_store(tmp_path, _EXACT_ROWS)
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            out = _raw(nsrv.port,
+                       b"TOPKV\tALS_MODEL\t1\t1.0;0.0;0.0;0.0\n"
+                       b"GET\tALS_MODEL\t7-U\n"
+                       b"TOPK\tALS_MODEL\t7\t1\n"
+                       b"PING\n")
+            lines = out.split(b"\n")
+            assert lines[0].startswith(b"V\t13:")   # TOPKV first
+            assert lines[1] == b"V\t1.0;2.0;0.5;-1.0"
+            assert lines[2].startswith(b"V\t")      # TOPK third
+            assert lines[3].startswith(b"PONG")
+    finally:
+        store.close()
+
+
+def test_native_topkv_nan_scores_deterministic(tmp_path):
+    """NaN tokens parse (like Python float('nan')) and rank above +inf in
+    lax.top_k's total order, with deterministic id-sorted tie-breaking —
+    no undefined comparator behavior."""
+    rows = [("1-I", "1.0;2.0"), ("2-I", "3.0;1.0"), ("3-I", "0.5;0.5")]
+    store = _als_store(tmp_path, rows)
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            out = _raw(nsrv.port, b"TOPKV\tALS_MODEL\t3\tnan;0.0\n")
+            # every score is NaN -> all tie -> id-sorted catalog order
+            assert out == b"V\t1:nan;2:nan;3:nan\n"
+            out = _raw(nsrv.port, b"TOPKV\tALS_MODEL\t2\tinf;0.0\n")
+            # finite*inf = inf for rows 1,2; 0.5*inf = inf too -> ties in
+            # id order
+            assert out == b"V\t1:inf;2:inf\n"
+    finally:
+        store.close()
