@@ -24,6 +24,7 @@ import numpy as np
 from ..core import formats as F
 from ..core.params import Params
 from ..serve.client import QueryClient
+from ..serve.registry import resolve_endpoint
 from ..serve.consumer import ALS_STATE
 from .common import parse_factors
 
@@ -31,8 +32,7 @@ INT_MAX = 2**31 - 1
 
 
 def run(params: Params) -> int:
-    host = params.get("jobManagerHost", "localhost")
-    port = params.get_int("jobManagerPort", 6123)
+    host, port = resolve_endpoint(params)  # jobId routes via the registry
     timeout = params.get_int("queryTimeout", 5)
     num_queries = params.get_int("numQueries", 1000)
     lower_item = params.get_int("lowerItemId", 0)

@@ -17,13 +17,13 @@ import numpy as np
 from ..core import formats as F
 from ..core.params import Params
 from ..serve.client import QueryClient
+from ..serve.registry import resolve_endpoint
 from ..serve.consumer import ALS_STATE
 from .common import read_lines
 
 
 def run(params: Params) -> None:
-    host = params.get("jobManagerHost", "localhost")
-    port = params.get_int("jobManagerPort", 6123)
+    host, port = resolve_endpoint(params)  # jobId routes via the registry
     timeout = params.get_int("queryTimeout", 5)
     k = params.get_int("k", 10)
     job_id = params.get("jobId", "local")

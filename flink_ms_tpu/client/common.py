@@ -14,8 +14,17 @@ def repl_client_from_argv(argv: Sequence[str], usage: str) -> QueryClient:
             "Missing required job ID argument. Usage: " + usage
         )
     job_id = argv[0]
-    host = argv[1] if len(argv) > 1 else "localhost"
-    port = int(argv[2]) if len(argv) > 2 else 6123
+    explicit_host = argv[1] if len(argv) > 1 else None
+    if len(argv) > 2:
+        host, port = explicit_host, int(argv[2])
+    else:
+        # no explicit port: resolve the jobId through the location
+        # registry, like queryState resolves any job via the JobManager
+        # (QueryClientHelper.java:82-92,121); shared precedence helper so
+        # positional and flag-based clients can never diverge
+        from ..serve.registry import merge_endpoint, resolve
+
+        host, port = merge_endpoint(resolve(job_id), explicit_host)
     print(f"Using JobManager {host}:{port}")
     return QueryClient(host=host, port=port, timeout_s=5.0, job_id=job_id)
 

@@ -19,6 +19,7 @@ import numpy as np
 from ..core import formats as F
 from ..core.params import Params
 from ..serve.client import QueryClient
+from ..serve.registry import resolve_endpoint
 from ..serve.consumer import SVM_STATE
 from .svm_predict import decide
 
@@ -33,8 +34,7 @@ def random_sparse_vector(rng, max_features: int, min_pct: int) -> Dict[int, floa
 
 
 def run(params: Params) -> int:
-    host = params.get("jobManagerHost", "localhost")
-    port = params.get_int("jobManagerPort", 6123)
+    host, port = resolve_endpoint(params)  # jobId routes via the registry
     timeout = params.get_int("queryTimeout", 5)
     num_queries = params.get_int("numQueries", 1000)
     output_decision = params.get_bool("outputDecisionFunction", False)

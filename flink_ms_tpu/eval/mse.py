@@ -152,9 +152,12 @@ def run(params: Params, lookup=None) -> Optional[float]:
         if lookup is None:
             from ..serve.client import QueryClient
 
+            from ..serve.registry import resolve_endpoint
+
+            mse_host, mse_port = resolve_endpoint(params)
             client = QueryClient(
-                host=params.get("jobManagerHost", "localhost"),
-                port=params.get_int("jobManagerPort", 6123),
+                host=mse_host,
+                port=mse_port,
                 timeout_s=params.get_int("queryTimeout", 5),
             )
 

@@ -42,6 +42,7 @@ import numpy as np
 from ..core import formats as F
 from ..core.params import Params, field_delimiter_from
 from ..serve.client import QueryClient
+from ..serve.registry import resolve_endpoint
 from ..serve.consumer import ALS_STATE
 from ..serve.journal import Journal
 
@@ -313,9 +314,10 @@ def run(params: Params, stop: Optional[Callable[[], bool]] = None) -> int:
     output_mode = params.get_required("outputMode")
     delimiter = field_delimiter_from(params, default="tab")
 
+    sgd_host, sgd_port = resolve_endpoint(params)  # jobId -> registry
     client = QueryClient(
-        host=params.get("jobManagerHost", "localhost"),
-        port=params.get_int("jobManagerPort", 6123),
+        host=sgd_host,
+        port=sgd_port,
         timeout_s=params.get_int("queryTimeout", 5),
         job_id=params.get_required("jobId"),
     )

@@ -162,6 +162,7 @@ class ServingJob:
             raise ValueError("start_from must be earliest|latest")
         self.journal = journal
         self.state_name = state_name
+        self.host = host
         self.parse_fn = parse_fn
         self.backend = backend
         # the native (rocksdb-parity) backend provides its own durable table;
@@ -189,6 +190,7 @@ class ServingJob:
         # and replay the whole retained backlog it was configured to skip
         self._seed_offset = self.offset
         self.parse_errors = 0
+        self._stopped = False
         self._stop = threading.Event()
         self._consumer_thread: Optional[threading.Thread] = None
         if native_server:
@@ -243,6 +245,12 @@ class ServingJob:
                 file=sys.stderr,
             )
         self.server.start()
+        # announce jobId -> endpoint so clients resolve this job without
+        # explicit port wiring (the reference's JobManager lookup,
+        # QueryClientHelper.java:82-92; best-effort by design)
+        from . import registry
+
+        registry.register(self.job_id, self.host, self.port, self.state_name)
         self._consumer_thread = threading.Thread(
             target=self._supervised_consume, name="journal-consumer", daemon=True
         )
@@ -250,6 +258,15 @@ class ServingJob:
         return self
 
     def stop(self) -> None:
+        # idempotent: wait() calls stop() on every exit path (SIGTERM
+        # handler, KeyboardInterrupt, supervisor give-up), and callers may
+        # also stop() explicitly
+        if self._stopped:
+            return
+        self._stopped = True
+        from . import registry
+
+        registry.unregister(self.job_id)
         self._stop.set()
         if self._consumer_thread:
             self._consumer_thread.join(timeout=10)
@@ -268,8 +285,26 @@ class ServingJob:
                 )
 
     def wait(self) -> None:
-        while not self._stop.is_set():
-            time.sleep(0.5)
+        # CLI foreground mode: translate SIGTERM into an orderly stop()
+        # so the registry entry and backing store are released (a killed
+        # job would otherwise leave a stale jobId -> port entry; clients
+        # then see a refused connect instead of a clean miss)
+        import signal
+
+        try:
+            signal.signal(signal.SIGTERM, lambda *_: self.stop())
+        except ValueError:
+            pass  # not the main thread: caller owns signal handling
+        try:
+            while not self._stop.is_set():
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        # every exit path releases the registry entry and backing store
+        # (idempotent — a SIGTERM-handler stop() already ran is a no-op);
+        # this also covers the supervisor's give-up path, which sets
+        # _stop without the full teardown
+        self.stop()
 
     # -- consume loop with fixed-delay restart -----------------------------
 
@@ -287,6 +322,12 @@ class ServingJob:
                         f"{self.restart_attempts} restarts: {e}",
                         file=sys.stderr,
                     )
+                    # a dead job must not stay resolvable: drop the
+                    # registry entry here too — embedded (non-CLI) jobs
+                    # have no wait() to run the full stop() for them
+                    from . import registry
+
+                    registry.unregister(self.job_id)
                     self._stop.set()
                     return
                 print(

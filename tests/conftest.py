@@ -50,3 +50,12 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_job_registry(tmp_path, monkeypatch):
+    """Every test gets a private jobId->endpoint registry: ServingJobs
+    register themselves on start (serve/registry.py), and the shared
+    /tmp default would let concurrent suite runs (or a dev's live job)
+    cross-talk through fixed test jobIds."""
+    monkeypatch.setenv("TPUMS_REGISTRY_DIR", str(tmp_path / "job_registry"))

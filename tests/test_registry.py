@@ -1,0 +1,152 @@
+"""Job location registry (serve/registry.py): jobId -> endpoint resolution,
+the counterpart of the reference's JobManager-side queryable-state lookup
+(QueryClientHelper.java:82-92,121 — clients name a jobId, never a server
+port), plus the producer's checkpoint-cadence flush parity
+(ALSKafkaProducer.java:35-37)."""
+
+import json
+
+import pytest
+
+from flink_ms_tpu.core.params import Params
+from flink_ms_tpu.serve import registry
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    ServingJob,
+    make_backend,
+    parse_als_record,
+)
+from flink_ms_tpu.serve.journal import Journal
+
+
+# registry isolation comes from conftest.py's autouse fixture (every test
+# gets a private TPUMS_REGISTRY_DIR)
+
+
+def test_register_resolve_unregister_roundtrip():
+    registry.register("job-a", "127.0.0.1", 7001, ALS_STATE)
+    entry = registry.resolve("job-a")
+    assert entry["port"] == 7001 and entry["host"] == "127.0.0.1"
+    assert entry["state"] == ALS_STATE
+    registry.unregister("job-a")
+    assert registry.resolve("job-a") is None
+
+
+def test_resolve_endpoint_precedence():
+    registry.register("job-b", "10.0.0.9", 7002, ALS_STATE)
+    # explicit --jobManagerPort wins over the registry
+    host, port = registry.resolve_endpoint(Params.from_dict({
+        "jobId": "job-b", "jobManagerHost": "h", "jobManagerPort": 9999,
+    }))
+    assert (host, port) == ("h", 9999)
+    # jobId alone routes through the registry (host too, none given)
+    host, port = registry.resolve_endpoint(Params.from_dict({
+        "jobId": "job-b",
+    }))
+    assert (host, port) == ("10.0.0.9", 7002)
+    # an explicit host is kept even when the registry resolves the port
+    host, port = registry.resolve_endpoint(Params.from_dict({
+        "jobId": "job-b", "jobManagerHost": "override",
+    }))
+    assert (host, port) == ("override", 7002)
+    # unknown jobId: the reference defaults (localhost:6123)
+    host, port = registry.resolve_endpoint(Params.from_dict({
+        "jobId": "nope",
+    }))
+    assert (host, port) == ("localhost", 6123)
+
+
+def test_wildcard_bind_resolves_via_client_host():
+    registry.register("job-c", "0.0.0.0", 7003, ALS_STATE)
+    host, port = registry.resolve_endpoint(Params.from_dict({
+        "jobId": "job-c",
+    }))
+    assert (host, port) == ("localhost", 7003)
+
+
+def test_serving_job_registers_and_unregisters(tmp_path):
+    journal = Journal(str(tmp_path / "bus"), "t")
+    journal.append(["1,U,0.5;1.5"])
+    job = ServingJob(
+        journal, ALS_STATE, parse_als_record, make_backend("memory", None),
+        host="127.0.0.1", port=0, poll_interval_s=0.01, job_id="reg-e2e",
+    ).start()
+    try:
+        entry = registry.resolve("reg-e2e")
+        assert entry is not None and entry["port"] == job.port
+        # a client holding only the jobId reaches the plane
+        from flink_ms_tpu.serve.client import QueryClient
+
+        host, port = registry.resolve_endpoint(
+            Params.from_dict({"jobId": "reg-e2e"}))
+        with QueryClient(host, port, timeout_s=10) as c:
+            deadline = 100
+            while c.query_state(ALS_STATE, "1-U") is None and deadline:
+                deadline -= 1
+        assert deadline
+    finally:
+        job.stop()
+    assert registry.resolve("reg-e2e") is None
+
+
+def test_repl_client_resolves_port_from_registry(tmp_path):
+    from flink_ms_tpu.client.common import repl_client_from_argv
+
+    registry.register("repl-job", "127.0.0.1", 7044, ALS_STATE)
+    c = repl_client_from_argv(["repl-job"], usage="u")
+    assert (c.host, c.port) == ("127.0.0.1", 7044)
+    # positional host+port still win
+    c = repl_client_from_argv(["repl-job", "h2", "7055"], usage="u")
+    assert (c.host, c.port) == ("h2", 7055)
+
+
+def test_registry_entry_is_json_file():
+    import pathlib
+
+    registry.register("weird/../id", "127.0.0.1", 7005, ALS_STATE)
+    files = list(pathlib.Path(registry.registry_dir()).iterdir())
+    assert len(files) == 1 and files[0].suffix == ".json"
+    assert json.loads(files[0].read_text())["port"] == 7005
+    # sanitization must stay injective: a jobId that sanitizes to the
+    # same name must not overwrite or unregister the first job's entry
+    registry.register("weird_.._id", "127.0.0.1", 7006, ALS_STATE)
+    assert registry.resolve("weird/../id")["port"] == 7005
+    registry.unregister("weird_.._id")
+    assert registry.resolve("weird/../id") is not None
+
+
+def test_producer_flush_interval(tmp_path, monkeypatch):
+    """--flushInterval fsyncs mid-load on the checkpoint cadence
+    (ALSKafkaProducer.java:35-37 flushes every checkpoint); 0 disables."""
+    from flink_ms_tpu.serve import producer
+
+    model = tmp_path / "model"
+    model.write_text("".join(f"{i},U,0.1;0.2\n" for i in range(25_000)))
+
+    flushes = []
+    real_append = Journal.append
+
+    def spy_append(self, lines, flush=True):
+        flushes.append(bool(flush))
+        return real_append(self, lines, flush=flush)
+
+    monkeypatch.setattr(Journal, "append", spy_append)
+    clock = [0.0]
+    monkeypatch.setattr(producer.time, "monotonic",
+                        lambda: clock.__setitem__(0, clock[0] + 40.0)
+                        or clock[0])  # +40s per call: every batch is due
+    n = producer.run(Params.from_dict({
+        "journalDir": str(tmp_path / "bus"), "topic": "t",
+        "input": str(model), "flushInterval": 60_000,
+    }))
+    assert n == 25_000
+    # two full batches flushed on cadence + the final checkpoint flush
+    assert flushes.count(True) >= 2 and flushes[-1] is True
+
+    flushes.clear()
+    producer.run(Params.from_dict({
+        "journalDir": str(tmp_path / "bus2"), "topic": "t",
+        "input": str(model), "flushInterval": 0,
+    }))
+    # interval disabled: only the end-of-stream fsync
+    assert flushes.count(True) == 1 and flushes[-1] is True
