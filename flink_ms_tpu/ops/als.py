@@ -124,7 +124,8 @@ class BlockedProblem:
     The analog of FlinkML's user-block x item-block routing tables [dep]:
     instead of routing messages, each block holds the degree-bucketed pad
     layout of the ratings it owns in both orientations, and factor exchange
-    is an all_gather.
+    is an all_gather — or, when the need-lists are sparse enough, a routed
+    all_to_all over them (``_exchange_plan``).
     """
 
     n_blocks: int
@@ -133,6 +134,9 @@ class BlockedProblem:
     nnz: int
     u: SideLayout             # user-major (solves user factors)
     i: SideLayout             # item-major (solves item factors)
+    # lazily built routed-exchange plans, keyed by (D, mode choice) —
+    # see _exchange_plan
+    routing: dict = dataclasses.field(default_factory=dict, repr=False)
 
     @property
     def n_users(self) -> int:
@@ -382,6 +386,171 @@ def prepare_blocked(
 
 
 # ---------------------------------------------------------------------------
+# routed factor exchange (SURVEY §2.3: the reference's block routing tables,
+# ALSImpl.scala:39-41 [dep] — blocks exchange only the factor rows their
+# ratings reference, not the whole opposite table)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RoutedSide:
+    """Routed-exchange plan for one half-sweep.
+
+    Replaces the full-table ``all_gather`` (every device receives the
+    entire opposite factor table, (D-1)·opp_pb rows, regardless of need)
+    with need-list routing: block d receives only the opposite rows its
+    ratings reference, via one ``all_to_all`` of (D, r_max, k) send
+    buffers.  Receive volume is D·r_max rows per device and SHRINKS as the
+    mesh grows (per-block nnz drops, so need-lists thin out), where the
+    all_gather's volume stays ~constant — exactly the scaling SURVEY §2.3
+    prescribes for the 10M-user envelope.
+    """
+
+    send_idx: np.ndarray   # (D, D, r_max) int32: LOCAL factor rows source
+    #                        block s sends to destination d; the diagonal
+    #                        (s == d) and pad entries point at s's
+    #                        guaranteed-zero dummy slot — self-owned rows
+    #                        never ride the collective
+    idx: list              # per bucket: (D, rows_j, w_j) int32 into the
+    #                        received table: off-block slots at
+    #                        s*r_max + pos, self-owned slots at
+    #                        D*r_max + local (the appended own shard)
+    r_max: int             # max OFF-DIAGONAL route length (self excluded:
+    #                        padding every route to a diagonal-dominated
+    #                        r_max would ship the skew as zeros)
+    recv_rows: int         # D*r_max + opp_pb (routed table incl. own shard)
+    net_rows: int          # (D-1)*r_max — rows actually crossing ICI
+
+
+def build_routing(side: SideLayout, opp: SideLayout,
+                  n_blocks: int) -> RoutedSide:
+    """Host-side routing tables: per destination block, the sorted unique
+    opposite slots its ratings reference, split by owning source block.
+    Self-owned rows are read straight from the local shard (appended after
+    the exchanged stack), so the collective carries off-block needs only.
+    Pure layout transform — gathered VALUES are identical to the gather
+    path (same rows, same per-rating order), so routed and gathered sweeps
+    agree bitwise."""
+    D = n_blocks
+    opp_pb = opp.per_block
+    pad_local = opp_pb - 1  # every block's last slot is a guaranteed dummy
+    routes = [[None] * D for _ in range(D)]  # [src][dst] -> local rows
+    r_max = 1
+    for d in range(D):
+        parts = [b[d].ravel() for b in side.idx]
+        need = np.unique(np.concatenate(parts)) if parts else np.empty(
+            0, np.int64)
+        src = need // opp_pb
+        loc = need % opp_pb
+        for s in range(D):
+            if s == d:
+                continue  # self-owned rows come from the local shard
+            routes[s][d] = loc[src == s]  # sorted (need is sorted)
+            r_max = max(r_max, len(routes[s][d]))
+    send_idx = np.full((D, D, r_max), pad_local, np.int32)
+    for s in range(D):
+        for d in range(D):
+            if s == d:
+                continue
+            r = routes[s][d]
+            send_idx[s, d, : len(r)] = r
+    self_base = D * r_max  # own shard appended after the exchanged stack
+    remapped = []
+    for b in side.idx:
+        out = np.empty_like(b)
+        for d in range(D):
+            g = b[d].astype(np.int64)
+            s = g // opp_pb
+            loc = g % opp_pb
+            pos = np.empty_like(loc)
+            for sb in range(D):
+                m = s == sb
+                if not m.any():
+                    continue
+                if sb == d:
+                    pos[m] = self_base + loc[m] - sb * r_max  # net of the
+                    # s*r_max term added below
+                else:
+                    pos[m] = np.searchsorted(routes[sb][d], loc[m])
+            out[d] = (s * r_max + pos).astype(np.int32)
+        remapped.append(out)
+    return RoutedSide(send_idx=send_idx, idx=remapped, r_max=r_max,
+                      recv_rows=D * r_max + opp_pb,
+                      net_rows=(D - 1) * r_max)
+
+
+_EXCHANGE_MODE_ENV = "FLINK_MS_ALS_EXCHANGE_MODE"
+
+
+def _exchange_mode_choice() -> str:
+    mode = os.environ.get(_EXCHANGE_MODE_ENV, "auto")
+    if mode not in ("auto", "gather", "routed"):
+        raise ValueError(
+            f"{_EXCHANGE_MODE_ENV}={mode!r} must be auto|gather|routed"
+        )
+    return mode
+
+
+def _exchange_plan(problem: BlockedProblem, D: int) -> dict:
+    """-> {"u": RoutedSide|None, "i": RoutedSide|None} for a D-device mesh
+    (None = full-table all_gather for that half-sweep).
+
+    "auto" routes a half-sweep only when its need-lists actually receive
+    fewer rows than the all_gather would; the dense/saturated regime
+    (ML-20M: every block references nearly the whole 27k-item catalog)
+    skips the routing build entirely on an nnz-density estimate.  Each
+    half-sweep decides independently — a 10M-user catalog routes the
+    user-factor exchange while the small item side keeps the gather.
+    Plans are cached on the problem; the decision is logged with the
+    per-device exchange-row accounting either way."""
+    choice = _exchange_mode_choice()
+    key = (D, choice)
+    if key in problem.routing:
+        return problem.routing[key]
+    plan = {}
+    for name, side, opp in (
+        ("u", problem.u, problem.i),
+        ("i", problem.i, problem.u),
+    ):
+        gather_rows = (D - 1) * opp.per_block
+        if D == 1 or choice == "gather":
+            plan[name] = None
+            continue
+        if choice == "auto" and problem.nnz / D >= 2.0 * opp.per_block * D:
+            # each block's ratings reference ~the whole opposite catalog
+            # (need saturates at 1-e^-x); routing can't beat the gather,
+            # don't pay the host-side build
+            print(
+                f"[als] {name}-sweep exchange: gather ({gather_rows} "
+                f"rows/device; need-lists saturated at nnz/D="
+                f"{problem.nnz // D} vs {opp.per_block * D} opposite slots)"
+            )
+            plan[name] = None
+            continue
+        routed = build_routing(side, opp, D)
+        # ICI win condition: the all_to_all crosses (D-1)*r_max rows per
+        # device vs the gather's (D-1)*opp_pb — route when the need-lists
+        # are meaningfully thinner (margin for the extra take + concat)
+        if choice == "routed" or routed.r_max < 0.8 * opp.per_block:
+            print(
+                f"[als] {name}-sweep exchange: routed all_to_all — "
+                f"{routed.net_rows} rows/device over ICI vs {gather_rows} "
+                f"all_gather (r_max={routed.r_max}, table "
+                f"{routed.recv_rows} rows)"
+            )
+            plan[name] = routed
+        else:
+            print(
+                f"[als] {name}-sweep exchange: gather ({gather_rows} "
+                f"rows/device over ICI; routed would cross "
+                f"{routed.net_rows})"
+            )
+            plan[name] = None
+    problem.routing[key] = plan
+    return plan
+
+
+# ---------------------------------------------------------------------------
 # device-side kernel
 # ---------------------------------------------------------------------------
 
@@ -397,7 +566,7 @@ def _assembly_chunk_bytes() -> int:
 
 
 def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
-                       precision, post=None, extra=None):
+                       precision, post=None, extra=None, platform=None):
     """One bucket's (A, b): gather the opposite factors for each row's
     rating list and contract over the rating axis on the MXU.
 
@@ -418,6 +587,19 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
     Chunking is over the batch row axis only (the contraction axis w is
     untouched), so chunked and unchunked results are arithmetically
     identical per row."""
+    # fused gather+contract kernel (FLINK_MS_ALS_ASSEMBLY=pallas): the
+    # whole opposite table rides VMEM and the (r, w, k) gather transient
+    # never touches HBM — see ops/gather_assembly.py.  Explicit unfused
+    # mode only; anything else falls through to the XLA path below.
+    if post is None and not implicit:
+        from .gather_assembly import fused_bucket_assembly, use_fused_gather
+
+        if use_fused_gather(y_all.shape, y_all.dtype, implicit):
+            return fused_bucket_assembly(
+                y_all, idx, val, dtype, platform or "cpu",
+                precision=precision,
+            )
+
     def compute(idx_c, val_c, extra_c, in_scan=False):
         y = jnp.take(y_all, idx_c, axis=0)                   # (r, w, k)
         # HIGHEST keeps f32 products (bf16 single-pass shifts the normal
@@ -492,7 +674,7 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
 
 
 def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
-                         precision="highest"):
+                         precision="highest", platform=None):
     """A_u = Σ w·y yᵀ and b_u = Σ t·y per slot, as batched MXU matmuls.
 
     y_all:   (n_slots_global, k) gathered opposite-side factor table
@@ -510,7 +692,8 @@ def _assemble_normal_eqs(y_all, buckets, implicit, alpha, dtype,
     As, bs = [], []
     for idx, val in buckets:
         A, b = _bucket_normal_eqs(
-            y_all, idx, val, implicit, alpha, dtype, precision
+            y_all, idx, val, implicit, alpha, dtype, precision,
+            platform=platform,
         )
         As.append(A)
         bs.append(b)
@@ -721,23 +904,27 @@ def _solve_factors(A, b, counts, lam, weighted_reg, dtype,
     return jnp.where((counts > 0)[:, None], x, 0.0)
 
 
-def _flat_side_args(side: SideLayout, dtype):
+def _flat_side_args(side: SideLayout, dtype, routed=None):
     """Device-arg flattening of one side: bucket (idx, val) pairs then the
-    count."""
+    count; a routed half-sweep appends its send plan and swaps the idx
+    arrays for their received-table remapping."""
     out = []
     for j in range(len(side.widths)):
         out += [
-            side.idx[j],
+            routed.idx[j] if routed is not None else side.idx[j],
             side.val[j].astype(dtype),
         ]
     out.append(side.count.astype(dtype))
+    if routed is not None:
+        out.append(routed.send_idx)
     return out
 
 
 def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     """Build the jitted full-fit function: fori_loop over iterations, each
     iteration = user half-sweep then item half-sweep, all inside one
-    shard_map so factor exchange is an ICI all_gather."""
+    shard_map so factor exchange rides ICI — a full-table ``all_gather``,
+    or a need-list-routed ``all_to_all`` per the problem's exchange plan."""
     k = config.num_factors
     lam = config.lambda_
     implicit = config.implicit
@@ -747,22 +934,38 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     n_u_buckets = len(problem.u.widths)
     n_i_buckets = len(problem.i.widths)
     platform = mesh.devices.flat[0].platform
+    plan = _exchange_plan(problem, num_blocks(mesh))
 
     resolved_exchange = resolve_exchange(config.exchange_dtype, platform)
     exchange_dtype = (
         jnp.dtype(resolved_exchange) if resolved_exchange else None
     )
 
-    def half_sweep(y_shard, flat):
+    def half_sweep(y_shard, flat, routed: bool):
         # y_shard: (1, opp_pb, k) this device's shard of the opposite factors
-        *bucket_args, counts = flat
+        if routed:
+            *bucket_args, counts, send_idx = flat
+        else:
+            *bucket_args, counts = flat
         y_send = y_shard[0]
         if exchange_dtype is not None:
-            # cast BEFORE the collective: the all_gather moves half the
+            # cast BEFORE the collective: the exchange moves half the
             # bytes over ICI and every downstream gather reads half the
             # bytes from HBM; accumulation stays in the solve dtype
             y_send = y_send.astype(exchange_dtype)
-        y_all = jax.lax.all_gather(y_send, BLOCK_AXIS, axis=0, tiled=True)
+        if routed:
+            # need-list exchange: send each destination only the off-block
+            # rows its ratings reference (pad/diagonal rows are the dummy
+            # slot -> zeros); the received (D, r_max, k) stack plus the
+            # device's OWN shard is the gather table, with idx arrays
+            # pre-remapped (off-block: s*r_max + pos; self: D*r_max + local)
+            picked = jnp.take(y_send, send_idx[0], axis=0)  # (D, r_max, k)
+            recv = jax.lax.all_to_all(
+                picked, BLOCK_AXIS, split_axis=0, concat_axis=0
+            ).reshape(-1, k)
+            y_all = jnp.concatenate([recv, y_send], axis=0)
+        else:
+            y_all = jax.lax.all_gather(y_send, BLOCK_AXIS, axis=0, tiled=True)
         buckets = [
             (bucket_args[2 * j][0], bucket_args[2 * j + 1][0])
             for j in range(len(bucket_args) // 2)
@@ -800,22 +1003,22 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
             return jnp.concatenate(xs, axis=0)[None]
         A, b = _assemble_normal_eqs(
             y_all, buckets, implicit, alpha, dtype,
-            precision=config.assembly_precision,
+            precision=config.assembly_precision, platform=platform,
         )
         if implicit:
             A = A + yty[None, :, :]
         x = _solve_factors(A, b, counts[0], lam, weighted, dtype, platform)
         return x[None]  # (1, per_block, k)
 
-    n_u_args = 2 * n_u_buckets + 1
+    n_u_args = 2 * n_u_buckets + 1 + (1 if plan["u"] is not None else 0)
 
     def fit_body(iterations, uf, itf, *flat):
         u_flat, i_flat = flat[:n_u_args], flat[n_u_args:]
 
         def one_iter(_, carry):
             uf, itf = carry
-            uf = half_sweep(itf, u_flat)
-            itf = half_sweep(uf, i_flat)
+            uf = half_sweep(itf, u_flat, routed=plan["u"] is not None)
+            itf = half_sweep(uf, i_flat, routed=plan["i"] is not None)
             return uf, itf
 
         # dynamic trip count (lowers to while_loop): one compiled program
@@ -826,7 +1029,9 @@ def _make_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
     spec2 = P(BLOCK_AXIS, None)
     flat_specs = (
         (spec3,) * (2 * n_u_buckets) + (spec2,)
+        + ((spec3,) if plan["u"] is not None else ())  # send_idx
         + (spec3,) * (2 * n_i_buckets) + (spec2,)
+        + ((spec3,) if plan["i"] is not None else ())
     )
     sharded_fit = shard_map(
         fit_body,
@@ -863,9 +1068,20 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         str(config.dtype),
         config.assembly_precision,
         config.exchange_dtype,
+        # the exchange plan changes arg shapes and the collective: key by
+        # each half-sweep's mode + received-table size
+        tuple(
+            (name, None if r is None else r.r_max)
+            for name, r in sorted(
+                _exchange_plan(problem, num_blocks(mesh)).items()
+            )
+        ),
         _solver_choice(),          # env overrides are baked in at trace
         _assembly_chunk_bytes(),   # time, so they key the executable
         _fused_solve(),
+        os.environ.get("FLINK_MS_ALS_ASSEMBLY", "auto"),
+        os.environ.get("FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES", ""),
+        os.environ.get("FLINK_MS_ALS_ASSEMBLY_ROW_TILE", ""),
         # the Pallas solver reads its layout knob at trace time too (when
         # layout=None inside cholesky_solve_batched) — omitting it here
         # would silently reuse an executable compiled under the old layout
@@ -1087,8 +1303,9 @@ def compile_fit(
         return jax.device_put(a, sharding)
 
     dev_args = [put(uf0, shard3), put(itf0, shard3)]
-    for side in (problem.u, problem.i):
-        for a in _flat_side_args(side, dtype):
+    plan = _exchange_plan(problem, D)
+    for name, side in (("u", problem.u), ("i", problem.i)):
+        for a in _flat_side_args(side, dtype, routed=plan[name]):
             dev_args.append(put(a, shard2 if a.ndim == 2 else shard3))
     return _cached_sweep(problem, config, mesh), dev_args
 

@@ -1,0 +1,139 @@
+"""Fused gather+contract assembly kernel (Pallas TPU).
+
+The ALS roofline's dominant post-solver term (BASELINE.md) is the
+(r, w, k) factor-gather transient: XLA cannot fuse a gather producer into
+a dot operand, so every bucket's gathered rows are written to HBM and read
+straight back — ~2x8 GB per ML-20M iteration — and the random 200 B row
+gather itself runs at worst-case HBM efficiency.  The gather SOURCE is
+small (item table 5.3 MB f32; user table 13.9 MB bf16), so this kernel
+keeps the whole opposite-factor table resident in VMEM, gathers each
+row-tile's rating lists inside the kernel, and contracts them on the MXU
+— the (tile, w, k) gather exists only in VMEM and the HBM transient
+disappears entirely.
+
+Activation: ``FLINK_MS_ALS_ASSEMBLY=pallas`` (opt-in until
+chip-validated; ``auto`` currently resolves to the XLA path).  The kernel
+gates itself on the table fitting the VMEM budget
+(``FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES``, default 12 MiB) and falls back to
+the XLA path otherwise — at ML-20M the user half-sweep (5.3 MB item
+table) always qualifies; the item half-sweep qualifies under the bf16
+exchange default.  Non-TPU backends run the same kernel in interpret mode
+for tests.
+
+Cited reference behavior: the normal-equation assembly semantics match
+``_bucket_normal_eqs`` exactly (explicit mode A = Σ y yᵀ, b = Σ r·y with
+pad rows zero through the dummy slot — ALSImpl.scala:35-52 [dep] blocked
+ALS), arithmetic reassociated only by tile boundaries on the contraction
+batch axis, never within a row.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ASSEMBLY_ENV = "FLINK_MS_ALS_ASSEMBLY"
+_VMEM_BUDGET_ENV = "FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES"
+_ROW_TILE_ENV = "FLINK_MS_ALS_ASSEMBLY_ROW_TILE"
+
+
+def assembly_choice() -> str:
+    mode = os.environ.get(_ASSEMBLY_ENV, "auto")
+    if mode not in ("auto", "xla", "pallas"):
+        raise ValueError(f"{_ASSEMBLY_ENV}={mode!r} must be auto|xla|pallas")
+    return mode
+
+
+def _vmem_budget() -> int:
+    return int(os.environ.get(_VMEM_BUDGET_ENV, 12 << 20))
+
+
+def _row_tile() -> int:
+    return int(os.environ.get(_ROW_TILE_ENV, 8))
+
+
+def use_fused_gather(y_all_shape, y_dtype, implicit: bool) -> bool:
+    """Trace-time gate: explicit mode only (the implicit path needs the
+    confidence-weighted yw operand — a follow-up), table within the VMEM
+    budget, and the knob set to pallas.  Backend selection happens inside
+    fused_bucket_assembly (non-TPU runs the kernel in interpret mode)."""
+    if assembly_choice() != "pallas":
+        return False
+    if implicit:
+        return False
+    s, k = y_all_shape
+    table_bytes = s * k * np.dtype(y_dtype).itemsize
+    return table_bytes <= _vmem_budget()
+
+
+def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
+                          precision="highest"):
+    """-> (A (r, k, k), b (r, k)) for one bucket, gather fused in VMEM.
+
+    ``y_all`` (S, k) opposite factor table (any float dtype — gathered
+    values are cast to ``out_dtype`` before the contraction, matching the
+    XLA path's exchange-dtype semantics); ``idx``/``val`` (r, w).  Rows
+    are padded to the row tile with dummy-slot gathers (zero rows), then
+    sliced back — per-row arithmetic is untouched.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r, w = idx.shape
+    s, k = y_all.shape
+    tile = _row_tile()
+    r_pad = -(-r // tile) * tile
+    if r_pad != r:
+        # dummy-slot pads: y_all[s-1] is the guaranteed-zero dummy row of
+        # the last block (every block's final slot is a dummy)
+        idx = jnp.pad(idx, ((0, r_pad - r), (0, 0)),
+                      constant_values=s - 1)
+        val = jnp.pad(val, ((0, r_pad - r), (0, 0)))
+
+    def kernel(tab_ref, idx_ref, val_ref, a_ref, b_ref):
+        tab = tab_ref[:]
+        ix = idx_ref[:]
+        y = jnp.take(tab, ix.reshape(-1), axis=0).reshape(tile, w, k)
+        yf = y.astype(out_dtype)
+        a_ref[:] = jax.lax.dot_general(
+            yf, yf, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=out_dtype, precision=precision,
+        )
+        b_ref[:] = jnp.einsum(
+            "twk,tw->tk", yf, val_ref[:].astype(out_dtype),
+            preferred_element_type=out_dtype, precision=precision,
+        )
+
+    a_out, b_out = pl.pallas_call(
+        kernel,
+        grid=(r_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((s, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),  # resident table
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, k, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((tile, k), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r_pad, k, k), out_dtype),
+            jax.ShapeDtypeStruct((r_pad, k), out_dtype),
+        ],
+        interpret=platform != "tpu",
+    )(y_all, idx, val)
+    if r_pad != r:
+        a_out, b_out = a_out[:r], b_out[:r]
+    return a_out, b_out
+
+
+__all__ = [
+    "assembly_choice",
+    "use_fused_gather",
+    "fused_bucket_assembly",
+]

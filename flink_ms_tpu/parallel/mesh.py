@@ -80,6 +80,12 @@ def enable_compile_cache() -> None:
         return
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return  # operator already chose a cache location
+    if not want and os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # host-pinned runs (tests, degraded benches) don't pay tunnel
+        # compiles, and XLA:CPU's AOT cache loader logs loud machine-
+        # feature-mismatch warnings for its prefer-no-scatter pseudo-
+        # features — opt in explicitly via FLINK_MS_COMPILE_CACHE_DIR
+        return
     path = want or os.path.expanduser("~/.cache/flink_ms_tpu/jax_cache")
     try:
         os.makedirs(path, exist_ok=True)

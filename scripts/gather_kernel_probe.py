@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Probe: can a Pallas TPU kernel gather factor rows from a VMEM-resident
+table fast enough to beat XLA's HBM gather + materialized transient?
+
+The ALS roofline (BASELINE.md) charges ~2x8 GB/iter to the (r, w, k)
+gather transient (TPU dots don't fuse gather producers) plus the random
+200 B row gather itself at worst-case effective bandwidth.  The opposite
+factor TABLE is small (items 5.3 MB f32, users 27.7 MB f32 / 13.9 MB
+bf16), so if Mosaic can gather from a VMEM-resident table inside the
+kernel and feed the contraction directly, both terms vanish.
+
+Variants:
+  xla        jnp.take from HBM + einsum (the production path, baseline)
+  pallas     fused kernel: whole table as a VMEM operand, per-row-tile
+             jnp.take inside the kernel + dot_general contraction, (r,w,k)
+             never exists outside VMEM
+  pallas_bf16  same with a bf16 table (halves VMEM + gather bytes)
+
+Usage: python scripts/gather_kernel_probe.py [--interpret] [--nnz N]
+  --interpret: CPU interpret-mode correctness check only (no timing).
+On chip, prints ms per assembly pass for each variant.
+"""
+
+import argparse
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def make_case(rng, n_rows, w, n_table, k, dtype=np.float32):
+    """One bucket-shaped assembly: (n_rows, w) idx into an (n_table, k)
+    table, values, -> A (n_rows, k, k), b (n_rows, k)."""
+    idx = rng.integers(0, n_table, (n_rows, w)).astype(np.int32)
+    val = rng.uniform(1, 5, (n_rows, w)).astype(dtype)
+    table = rng.standard_normal((n_table, k)).astype(dtype)
+    return idx, val, table
+
+
+def xla_assembly(table, idx, val):
+    import jax.numpy as jnp
+
+    y = jnp.take(table, idx, axis=0)                      # (r, w, k)
+    A = jnp.einsum("rwk,rwl->rkl", y, y, precision="highest",
+                   preferred_element_type=jnp.float32)
+    b = jnp.einsum("rwk,rw->rk", y, val.astype(y.dtype),
+                   precision="highest", preferred_element_type=jnp.float32)
+    return A, b
+
+
+def pallas_assembly(table, idx, val, row_tile=8, interpret=False):
+    """Fused gather+contract: the table lives whole in VMEM; each grid step
+    gathers row_tile rating lists and contracts them on the MXU without an
+    HBM transient."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r, w = idx.shape
+    k = table.shape[1]
+    assert r % row_tile == 0, (r, row_tile)
+
+    def kernel(tab_ref, idx_ref, val_ref, a_ref, b_ref):
+        tab = tab_ref[:]                                   # (S, k) VMEM
+        ix = idx_ref[:]                                    # (T, w)
+        y = jnp.take(tab, ix.reshape(-1), axis=0,
+                     unique_indices=False).reshape(row_tile, w, k)
+        yf = y.astype(jnp.float32)
+        a_ref[:] = jax.lax.dot_general(
+            yf, yf, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                                  # (T, k, k)
+        b_ref[:] = jnp.einsum(
+            "twk,tw->tk", yf, val_ref[:].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+
+    grid = (r // row_tile,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(table.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),          # whole table
+            pl.BlockSpec((row_tile, w), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, w), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((row_tile, k, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((row_tile, k), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r, k, k), jnp.float32),
+            jax.ShapeDtypeStruct((r, k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(table, idx, val)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--nnz", type=int, default=5_000_000)
+    ap.add_argument("--rows", type=int, default=0)
+    ap.add_argument("--w", type=int, default=128)
+    ap.add_argument("--table", type=int, default=12_000)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--row-tile", type=int, default=8)
+    args = ap.parse_args()
+
+    if args.interpret:
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from flink_ms_tpu.parallel.mesh import pin_host_backend
+
+        pin_host_backend()
+
+    import jax
+
+    rng = np.random.default_rng(0)
+    rows = args.rows or max(args.nnz // args.w, args.row_tile)
+    rows -= rows % args.row_tile
+    if args.interpret:
+        rows = min(rows, 64)
+    idx, val, table = make_case(rng, rows, args.w, args.table, args.k)
+    print(f"rows={rows} w={args.w} table={args.table} k={args.k} "
+          f"({rows * args.w / 1e6:.1f}M gathers)")
+
+    a_ref, b_ref = jax.jit(xla_assembly)(table, idx, val)
+    a_ref.block_until_ready()
+
+    if args.interpret:
+        a_p, b_p = pallas_assembly(table, idx, val, args.row_tile,
+                                   interpret=True)
+        np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(b_p), np.asarray(b_ref),
+                                   rtol=1e-5, atol=1e-5)
+        print("interpret-mode parity OK (xla vs pallas fused)")
+        return
+
+    from flink_ms_tpu.utils.profiling import hard_sync
+
+    def bench(fn, *a, n=5):
+        out = fn(*a)
+        hard_sync(out[0])
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            hard_sync(out[0])
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2] * 1e3
+
+    results = {}
+    results["xla"] = bench(jax.jit(xla_assembly), table, idx, val)
+    try:
+        fn = jax.jit(functools.partial(
+            pallas_assembly, row_tile=args.row_tile))
+        results["pallas"] = bench(fn, table, idx, val)
+    except Exception as e:  # noqa: BLE001
+        results["pallas"] = f"FAILED: {type(e).__name__}: {str(e)[:300]}"
+    try:
+        tb = table.astype(jax.numpy.bfloat16)
+        fn = jax.jit(functools.partial(
+            pallas_assembly, row_tile=args.row_tile))
+        results["pallas_bf16_table"] = bench(fn, tb, idx, val)
+    except Exception as e:  # noqa: BLE001
+        results["pallas_bf16_table"] = (
+            f"FAILED: {type(e).__name__}: {str(e)[:300]}"
+        )
+    for name, v in results.items():
+        print(f"{name:>20}: {v if isinstance(v, str) else f'{v:8.2f} ms'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Probe: attack the single-chip CoCoA round boundary (BASELINE.md: 452 ms
+margin gather + 350 ms scatter-add, both 49M-scalar irregular ops against
+a 189 KB weight vector that trivially fits VMEM).
+
+Variants (each vs its XLA production-path baseline):
+  gather:  wx0[i] = sum_j w[idx[i,j]] * val[i,j]
+    xla          jnp.take(w, idx) * val, row-sum (the r3 path)
+    pallas       w resident in VMEM, jnp.take inside the kernel, no HBM
+                 transient
+  scatter: dw = sum_i val[i,j] * dalpha[i] into bins idx[i,j]
+    xla          zeros(d).at[flat_idx].add(flat_contrib)
+    pallas       VMEM (d,) accumulator across sequential grid steps with
+                 in-kernel .at[].add per tile
+
+Usage: python scripts/svm_kernel_probe.py [--interpret] [--nnz N]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def xla_gather(w, idx, val):
+    import jax.numpy as jnp
+
+    return jnp.sum(jnp.take(w, idx, axis=0) * val, axis=1)
+
+
+def pallas_gather(w, idx, val, tile=512, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, m = idx.shape
+    assert n % tile == 0
+
+    def kernel(w_ref, idx_ref, val_ref, out_ref):
+        wv = w_ref[:]                       # (d,) VMEM-resident
+        ix = idx_ref[:]                     # (tile, m)
+        g = jnp.take(wv, ix.reshape(-1), axis=0).reshape(tile, m)
+        out_ref[:] = jnp.sum(g * val_ref[:], axis=1)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // tile,),
+        in_specs=[
+            pl.BlockSpec(w.shape, lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, m), lambda i: (i, 0)),
+            pl.BlockSpec((tile, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
+        interpret=interpret,
+    )(w, idx, val)
+
+
+def xla_scatter(d, idx, contrib):
+    import jax.numpy as jnp
+
+    return jnp.zeros((d,), jnp.float32).at[idx.reshape(-1)].add(
+        contrib.reshape(-1))
+
+
+def pallas_scatter(d, idx, contrib, tile=512, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, m = idx.shape
+    assert n % tile == 0
+    grid = (n // tile,)
+
+    def kernel(idx_ref, c_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        flat_i = idx_ref[:].reshape(-1)
+        flat_c = c_ref[:].reshape(-1)
+        out_ref[:] = out_ref[:].at[flat_i].add(flat_c)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, m), lambda i: (i, 0)),
+            pl.BlockSpec((tile, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((d,), lambda i: (0,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((d,), jnp.float32),
+        interpret=interpret,
+    )(idx, contrib)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--nnz", type=int, default=5_000_000)
+    ap.add_argument("--m", type=int, default=70)
+    ap.add_argument("--d", type=int, default=47_236)
+    ap.add_argument("--tile", type=int, default=512)
+    args = ap.parse_args()
+
+    if args.interpret:
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from flink_ms_tpu.parallel.mesh import pin_host_backend
+
+        pin_host_backend()
+
+    import jax
+
+    rng = np.random.default_rng(0)
+    n = max(args.nnz // args.m, args.tile)
+    n -= n % args.tile
+    if args.interpret:
+        n = min(n, 2 * args.tile)
+    idx = rng.integers(0, args.d, (n, args.m)).astype(np.int32)
+    val = rng.standard_normal((n, args.m)).astype(np.float32)
+    w = rng.standard_normal(args.d).astype(np.float32)
+    dal = rng.standard_normal((n, 1)).astype(np.float32)
+    contrib = val * dal
+    print(f"n={n} m={args.m} d={args.d} ({n * args.m / 1e6:.1f}M scalars)")
+
+    g_ref = jax.jit(xla_gather)(w, idx, val)
+    s_ref = jax.jit(lambda i, c: xla_scatter(args.d, i, c))(idx, contrib)
+    jax.block_until_ready((g_ref, s_ref))
+
+    if args.interpret:
+        g_p = pallas_gather(w, idx, val, args.tile, interpret=True)
+        np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-4)
+        s_p = pallas_scatter(args.d, idx, contrib, args.tile, interpret=True)
+        np.testing.assert_allclose(np.asarray(s_p), np.asarray(s_ref),
+                                   rtol=2e-3, atol=2e-3)
+        print("interpret-mode parity OK (gather + scatter)")
+        return
+
+    from flink_ms_tpu.utils.profiling import hard_sync
+
+    def bench(fn, *a, nrep=5):
+        out = fn(*a)
+        hard_sync(out)
+        ts = []
+        for _ in range(nrep):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            hard_sync(out)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2] * 1e3
+
+    import functools
+
+    results = {"gather_xla": bench(jax.jit(xla_gather), w, idx, val)}
+    try:
+        fn = jax.jit(functools.partial(pallas_gather, tile=args.tile))
+        results["gather_pallas"] = bench(fn, w, idx, val)
+    except Exception as e:  # noqa: BLE001
+        results["gather_pallas"] = f"FAILED: {type(e).__name__}: {str(e)[:240]}"
+    results["scatter_xla"] = bench(
+        jax.jit(lambda i, c: xla_scatter(args.d, i, c)), idx, contrib)
+    try:
+        fn = jax.jit(functools.partial(
+            pallas_scatter, args.d, tile=args.tile))
+        results["scatter_pallas"] = bench(fn, idx, contrib)
+    except Exception as e:  # noqa: BLE001
+        results["scatter_pallas"] = (
+            f"FAILED: {type(e).__name__}: {str(e)[:240]}"
+        )
+    for name, v in results.items():
+        print(f"{name:>16}: {v if isinstance(v, str) else f'{v:8.2f} ms'}")
+
+
+if __name__ == "__main__":
+    main()
