@@ -467,8 +467,10 @@ def time_fit(mesh, problem, cfg_base, iters, repeats=5):
 def run_als_section(devices, platform, small: bool) -> dict:
     import jax
 
-    from flink_ms_tpu.ops.als import (ALSConfig, prepare_blocked,
-                                      resolve_exchange, resolve_solver)
+    from flink_ms_tpu.ops.als import (ALSConfig,
+                                      _exchange_plan as _exchange_plan_fn,
+                                      prepare_blocked, resolve_exchange,
+                                      resolve_solver)
     from flink_ms_tpu.parallel.mesh import make_mesh
 
     n_users = int(os.environ.get("BENCH_USERS", 20_000 if small else 138_493))
@@ -548,6 +550,13 @@ def run_als_section(devices, platform, small: bool) -> dict:
         "als_bucket_ratio": os.environ.get("FLINK_MS_ALS_BUCKET_RATIO", "1.5"),
         "als_fused": os.environ.get("FLINK_MS_ALS_FUSED", "0"),
         "als_exchange_dtype": resolve_exchange(cfg.exchange_dtype, platform) or "f32",
+        # round 4: per-half-sweep exchange plan (routed all_to_all vs
+        # gather) and the fused-assembly knob
+        "als_exchange_mode": {
+            name: ("routed" if r is not None else "gather")
+            for name, r in _exchange_plan_fn(problem, len(devices)).items()
+        },
+        "als_assembly": os.environ.get("FLINK_MS_ALS_ASSEMBLY", "auto"),
     }
 
     # BASELINE.json config "als-ms implicit-feedback ALS (confidence-

@@ -589,15 +589,15 @@ def _bucket_normal_eqs(y_all, idx, val, implicit, alpha, dtype,
     identical per row."""
     # fused gather+contract kernel (FLINK_MS_ALS_ASSEMBLY=pallas): the
     # whole opposite table rides VMEM and the (r, w, k) gather transient
-    # never touches HBM — see ops/gather_assembly.py.  Explicit unfused
-    # mode only; anything else falls through to the XLA path below.
-    if post is None and not implicit:
+    # never touches HBM — see ops/gather_assembly.py.  Unfused-solve mode
+    # only (the fused-solve `post` stage keeps the XLA chunk path).
+    if post is None:
         from .gather_assembly import fused_bucket_assembly, use_fused_gather
 
-        if use_fused_gather(y_all.shape, y_all.dtype, implicit):
+        if use_fused_gather(y_all.shape, y_all.dtype):
             return fused_bucket_assembly(
                 y_all, idx, val, dtype, platform or "cpu",
-                precision=precision,
+                precision=precision, implicit=implicit, alpha=alpha,
             )
 
     def compute(idx_c, val_c, extra_c, in_scan=False):

@@ -56,14 +56,11 @@ def _row_tile() -> int:
     return int(os.environ.get(_ROW_TILE_ENV, 8))
 
 
-def use_fused_gather(y_all_shape, y_dtype, implicit: bool) -> bool:
-    """Trace-time gate: explicit mode only (the implicit path needs the
-    confidence-weighted yw operand — a follow-up), table within the VMEM
-    budget, and the knob set to pallas.  Backend selection happens inside
-    fused_bucket_assembly (non-TPU runs the kernel in interpret mode)."""
+def use_fused_gather(y_all_shape, y_dtype) -> bool:
+    """Trace-time gate: table within the VMEM budget and the knob set to
+    pallas.  Backend selection happens inside fused_bucket_assembly
+    (non-TPU runs the kernel in interpret mode)."""
     if assembly_choice() != "pallas":
-        return False
-    if implicit:
         return False
     s, k = y_all_shape
     table_bytes = s * k * np.dtype(y_dtype).itemsize
@@ -71,7 +68,7 @@ def use_fused_gather(y_all_shape, y_dtype, implicit: bool) -> bool:
 
 
 def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
-                          precision="highest"):
+                          precision="highest", implicit=False, alpha=40.0):
     """-> (A (r, k, k), b (r, k)) for one bucket, gather fused in VMEM.
 
     ``y_all`` (S, k) opposite factor table (any float dtype — gathered
@@ -79,6 +76,11 @@ def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
     XLA path's exchange-dtype semantics); ``idx``/``val`` (r, w).  Rows
     are padded to the row tile with dummy-slot gathers (zero rows), then
     sliced back — per-row arithmetic is untouched.
+
+    Explicit:  A = Σ y yᵀ,          b = Σ r·y
+    Implicit:  A = Σ alpha·r·y yᵀ,  b = Σ (1+alpha·r)·y  (HKV; pads have
+               val 0 AND zero y rows, so both weightings vanish on pads —
+               the same invariants as the XLA path)
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -99,12 +101,19 @@ def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
         ix = idx_ref[:]
         y = jnp.take(tab, ix.reshape(-1), axis=0).reshape(tile, w, k)
         yf = y.astype(out_dtype)
+        v = val_ref[:].astype(out_dtype)
+        if implicit:
+            lhs = yf * (alpha * v)[..., None]
+            t = 1.0 + alpha * v
+        else:
+            lhs = yf
+            t = v
         a_ref[:] = jax.lax.dot_general(
-            yf, yf, (((1,), (1,)), ((0,), (0,))),
+            lhs, yf, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=out_dtype, precision=precision,
         )
         b_ref[:] = jnp.einsum(
-            "twk,tw->tk", yf, val_ref[:].astype(out_dtype),
+            "twk,tw->tk", yf, t,
             preferred_element_type=out_dtype, precision=precision,
         )
 

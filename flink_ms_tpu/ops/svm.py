@@ -204,6 +204,13 @@ def _dw_choice() -> str:
     attribution: the boundary cost is two 49M-scalar irregular ops that
     shrink linearly with device count on a real mesh.)"""
     choice = os.environ.get("FLINK_MS_SVM_DW", "auto")
+    if choice not in ("auto", "direct", "sorted", "presorted", "pallas"):
+        # a typo'd knob must not silently fall through to the direct
+        # scatter — A/B verdicts depend on the requested path running
+        raise ValueError(
+            f"FLINK_MS_SVM_DW={choice!r} must be "
+            "auto|direct|sorted|presorted|pallas"
+        )
     if choice == "auto":
         return "direct"
     return choice
@@ -272,6 +279,10 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
     inner = _resolve_inner(problem, config, mesh)
     step_mode = _step_choice()
     dw_mode = _dw_choice() if inner == "gram" else "direct"
+    from .svm_kernels import wx0_choice
+
+    _wx0_mode = wx0_choice() if inner == "gram" else "einsum"
+    platform = mesh.devices.flat[0].platform
 
     def chain_sdca(w, idx_c, val_c, label_c, sqn_c, alpha_c, key_c):
         """H serial SDCA steps of ONE chain; vmapped over the C chains of a
@@ -436,10 +447,18 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
             # HIGHEST: the scatter path computes these margins as full-f32
             # elementwise work; a default-precision (bf16-pass) contraction
             # here would seed every SDCA step with ~1e-3 relative error and
-            # break the documented cross-engine equivalence on TPU
-            wx0 = jnp.einsum("chl,chl->ch", jnp.take(w, idx, axis=0), val,
-                             precision="highest",
-                             preferred_element_type=dtype)
+            # break the documented cross-engine equivalence on TPU.
+            # FLINK_MS_SVM_WX0=pallas keeps w VMEM-resident and fuses the
+            # 49M-scalar gather into the reduction (ops/svm_kernels.py;
+            # the single-chip round's 452 ms boundary term).
+            if _wx0_mode == "pallas":
+                from .svm_kernels import margin_gather
+
+                wx0 = margin_gather(w, idx, val, dtype, platform)
+            else:
+                wx0 = jnp.einsum("chl,chl->ch", jnp.take(w, idx, axis=0),
+                                 val, precision="highest",
+                                 preferred_element_type=dtype)
             dalpha = jax.vmap(sdca_gram)(
                 wx0, gram, label, sq_norm, alpha, keys
             )
@@ -458,6 +477,14 @@ def _make_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
                 dw = jax.ops.segment_sum(
                     contrib[dw_a[0]], dw_b[0], num_segments=d,
                     indices_are_sorted=True,
+                ) / lam_n
+            elif dw_mode == "pallas":
+                # VMEM-resident (d,) accumulator, scatter inside the
+                # kernel (the round's other 350 ms boundary term)
+                from .svm_kernels import scatter_add_dw
+
+                dw = scatter_add_dw(
+                    idx, val * dalpha[:, :, None], d, dtype, platform
                 ) / lam_n
             else:
                 contrib = (val * dalpha[:, :, None]).reshape(-1)
@@ -533,6 +560,8 @@ def _cached_fit(problem: BlockedSVMProblem, config: SVMConfig, mesh: Mesh):
         _resolve_inner(problem, config, mesh),
         _dw_choice(),
         _step_choice(),
+        os.environ.get("FLINK_MS_SVM_WX0", "auto"),
+        os.environ.get("FLINK_MS_SVM_KERNEL_TILE", ""),
     )
     fn = _FIT_CACHE.pop(key, None)
     if fn is None:

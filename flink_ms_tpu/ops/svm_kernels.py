@@ -1,0 +1,123 @@
+"""Pallas TPU kernels for the CoCoA round boundary.
+
+BASELINE.md's single-chip attribution: the round is dominated by two
+49M-scalar irregular ops against a 189 KB weight vector — the round-start
+margin gather ``take(w, idx)`` (452 ms) and the round-end unsorted
+scatter-add ``Δw = XᵀΔα`` (350 ms).  The weight vector trivially fits
+VMEM, so both ops can run inside a kernel that keeps it resident: the
+gather feeds the margin reduction without an HBM (C, H, L) transient, and
+the scatter accumulates into a VMEM (d,) buffer across sequential grid
+steps.
+
+Opt-in via ``FLINK_MS_SVM_WX0=pallas`` / ``FLINK_MS_SVM_DW=pallas`` until
+chip-validated (scripts/svm_kernel_probe.py is the measurement harness);
+non-TPU backends run interpret mode so the paths stay test-covered.
+
+Semantics parity: margin = Σ_l w[idx]*val per (chain, row) — identical
+per-row reduction order to the XLA einsum; the scatter accumulates the
+same contributions with tile-sequential bin order (float reassociation
+only, like any scatter lowering).  SVMImpl.scala:24-29 [dep] CoCoA.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_ROW_TILE_ENV = "FLINK_MS_SVM_KERNEL_TILE"
+
+
+def _tile() -> int:
+    return int(os.environ.get(_ROW_TILE_ENV, 512))
+
+
+def margin_gather(w, idx, val, out_dtype, platform: str):
+    """wx0 (C, H) = Σ_l w[idx[c,h,l]] * val[c,h,l], weight vector VMEM-
+    resident, gather fused into the reduction."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, H, L = idx.shape
+    n = C * H
+    tile = min(_tile(), n)
+    idx2 = idx.reshape(n, L)
+    val2 = val.reshape(n, L)
+    n_pad = -(-n // tile) * tile
+    if n_pad != n:
+        idx2 = jnp.pad(idx2, ((0, n_pad - n), (0, 0)))
+        val2 = jnp.pad(val2, ((0, n_pad - n), (0, 0)))  # val 0 -> term 0
+
+    def kernel(w_ref, idx_ref, val_ref, out_ref):
+        wv = w_ref[:]
+        g = jnp.take(wv, idx_ref[:].reshape(-1), axis=0).reshape(tile, L)
+        out_ref[:] = jnp.sum(
+            g.astype(out_dtype) * val_ref[:].astype(out_dtype), axis=1
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec(w.shape, lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, L), lambda i: (i, 0)),
+            pl.BlockSpec((tile, L), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((n_pad,), out_dtype),
+        interpret=platform != "tpu",
+    )(w, idx2, val2)
+    return out[:n].reshape(C, H)
+
+
+def scatter_add_dw(idx, contrib, d, out_dtype, platform: str):
+    """dw (d,) = Σ contrib[c,h,l] into bins idx[c,h,l] — the Δw = XᵀΔα
+    reduction, accumulated in a VMEM-resident (d,) buffer across
+    sequential grid steps."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = idx.size
+    m = idx.shape[-1]
+    rows = n // m
+    tile = min(_tile(), rows)
+    idx2 = idx.reshape(rows, m)
+    c2 = contrib.reshape(rows, m)
+    rows_pad = -(-rows // tile) * tile
+    if rows_pad != rows:
+        idx2 = jnp.pad(idx2, ((0, rows_pad - rows), (0, 0)))
+        c2 = jnp.pad(c2, ((0, rows_pad - rows), (0, 0)))  # contrib 0
+
+    def kernel(idx_ref, c_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        out_ref[:] = out_ref[:].at[idx_ref[:].reshape(-1)].add(
+            c_ref[:].reshape(-1).astype(out_dtype))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(rows_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, m), lambda i: (i, 0)),
+            pl.BlockSpec((tile, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((d,), lambda i: (0,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((d,), out_dtype),
+        interpret=platform != "tpu",
+    )(idx2, c2)
+
+
+def wx0_choice() -> str:
+    choice = os.environ.get("FLINK_MS_SVM_WX0", "auto")
+    if choice not in ("auto", "einsum", "pallas"):
+        raise ValueError(
+            f"FLINK_MS_SVM_WX0={choice!r} must be auto|einsum|pallas"
+        )
+    return "einsum" if choice == "auto" else choice

@@ -52,53 +52,21 @@ def xla_assembly(table, idx, val):
 
 
 def pallas_assembly(table, idx, val, row_tile=8, interpret=False):
-    """Fused gather+contract: the table lives whole in VMEM; each grid step
-    gathers row_tile rating lists and contracts them on the MXU without an
-    HBM transient."""
-    import jax
+    """PRODUCTION kernel (flink_ms_tpu.ops.gather_assembly
+    .fused_bucket_assembly) — the probe times exactly what
+    FLINK_MS_ALS_ASSEMBLY=pallas would run, so a kernel tweak can never
+    drift away from the measured numbers."""
+    import os
+
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    r, w = idx.shape
-    k = table.shape[1]
-    assert r % row_tile == 0, (r, row_tile)
+    from flink_ms_tpu.ops.gather_assembly import fused_bucket_assembly
 
-    def kernel(tab_ref, idx_ref, val_ref, a_ref, b_ref):
-        tab = tab_ref[:]                                   # (S, k) VMEM
-        ix = idx_ref[:]                                    # (T, w)
-        y = jnp.take(tab, ix.reshape(-1), axis=0,
-                     unique_indices=False).reshape(row_tile, w, k)
-        yf = y.astype(jnp.float32)
-        a_ref[:] = jax.lax.dot_general(
-            yf, yf, (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )                                                  # (T, k, k)
-        b_ref[:] = jnp.einsum(
-            "twk,tw->tk", yf, val_ref[:].astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-
-    grid = (r // row_tile,)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(table.shape, lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),          # whole table
-            pl.BlockSpec((row_tile, w), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, w), lambda i: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((row_tile, k, k), lambda i: (i, 0, 0)),
-            pl.BlockSpec((row_tile, k), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((r, k, k), jnp.float32),
-            jax.ShapeDtypeStruct((r, k), jnp.float32),
-        ],
-        interpret=interpret,
-    )(table, idx, val)
+    os.environ["FLINK_MS_ALS_ASSEMBLY_ROW_TILE"] = str(row_tile)
+    platform = "cpu" if interpret else "tpu"
+    return fused_bucket_assembly(
+        table, idx, val, jnp.float32, platform, precision="highest"
+    )
 
 
 def main():

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Round-4 on-chip measurement plan — run when the tunnel recovers.
+#
+# Order matters: cheap probes first (they decide the kernel defaults),
+# then the targeted A/Bs, then the full bench last (also warms the
+# persistent compile cache for the driver's end-of-round run).  Every
+# step runs in its own subprocess under `timeout` so a wedge costs one
+# step, not the session; steps are strictly sequential (concurrent
+# compiles through the tunnel are the one observed wedge trigger).
+#
+# Usage: bash scripts/onchip_r04.sh [outdir]   (default scripts/onchip_r04)
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-scripts/onchip_r04}"
+mkdir -p "$OUT"
+log() { echo "[onchip_r04 $(date +%H:%M:%S)] $*"; }
+
+run_step() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  log "step $name (timeout ${t}s): $*"
+  timeout "$t" "$@" >"$OUT/$name.log" 2>&1
+  local rc=$?
+  log "step $name rc=$rc"
+  tail -20 "$OUT/$name.log"
+  return $rc
+}
+
+# 0. sanity probe: is the chip actually answering?
+run_step probe 180 python -c "
+from flink_ms_tpu.parallel.mesh import honor_platform_env
+honor_platform_env()
+import jax; d = jax.devices()[0]
+assert d.platform != 'cpu', d
+print('chip:', d, d.device_kind)
+" || { log "chip not answering — abort"; exit 1; }
+
+# 1. fused gather+contract probe (decides FLINK_MS_ALS_ASSEMBLY):
+#    ML-20M user-half-sweep shape (item table 12k->27k rows, k=64)
+run_step gather_probe_small 600 python scripts/gather_kernel_probe.py \
+  --nnz 5000000 --w 128 --table 12000 --k 64
+probe_rc=$?
+run_step gather_probe_ml20m 600 python scripts/gather_kernel_probe.py \
+  --nnz 5000000 --w 128 --table 27000 --k 64
+# row-tile sweep on the winning shape (only if the probe step SUCCEEDED
+# and the kernel compiled — a timeout/crash leaves no FAILED marker but
+# must not trigger 20 more minutes of sweeps against a wedged chip)
+if [ "$probe_rc" -eq 0 ] && ! grep -q FAILED "$OUT/gather_probe_small.log"; then
+  run_step gather_tile16 600 python scripts/gather_kernel_probe.py \
+    --nnz 5000000 --w 128 --table 12000 --k 64 --row-tile 16
+  run_step gather_tile32 600 python scripts/gather_kernel_probe.py \
+    --nnz 5000000 --w 128 --table 12000 --k 64 --row-tile 32
+fi
+
+# 2. SVM boundary probe (decides FLINK_MS_SVM_WX0 / FLINK_MS_SVM_DW)
+#    + the per-device boundary-shrink table at nnz/D
+run_step svm_probe 600 python scripts/svm_kernel_probe.py --nnz 49000000
+
+# 3. ALS assembly A/B at the 5M-nnz probe config (the r3 solver-matrix
+#    config): xla vs pallas assembly under the pallas solver
+run_step als_ab_xla 900 env BENCH_SECTIONS=als BENCH_NNZ=5000000 \
+  BENCH_USERS=60000 BENCH_ITEMS=12000 BENCH_RANK=50 BENCH_SKIP_CPU=1 \
+  BENCH_SKIP_QUALITY=1 BENCH_ALS_BF16_AB=0 FLINK_MS_ALS_ASSEMBLY=xla \
+  python bench.py --sections-json als
+run_step als_ab_pallas 900 env BENCH_SECTIONS=als BENCH_NNZ=5000000 \
+  BENCH_USERS=60000 BENCH_ITEMS=12000 BENCH_RANK=50 BENCH_SKIP_CPU=1 \
+  BENCH_SKIP_QUALITY=1 BENCH_ALS_BF16_AB=0 FLINK_MS_ALS_ASSEMBLY=pallas \
+  python bench.py --sections-json als
+
+# 4. SVM round A/B at RCV1 scale: production path vs pallas boundary
+run_step svm_ab_base 1200 env BENCH_SECTIONS=svm BENCH_SKIP_CPU=1 \
+  python bench.py --sections-json svm
+run_step svm_ab_pallas 1200 env BENCH_SECTIONS=svm BENCH_SKIP_CPU=1 \
+  FLINK_MS_SVM_WX0=pallas FLINK_MS_SVM_DW=pallas \
+  python bench.py --sections-json svm
+
+# 5. full bench at the headline config with whatever won above (operator
+#    reads the A/B logs and exports the winning knobs before this, or
+#    re-runs manually) — ALSO warms the driver's compile cache
+run_step bench_full 3000 python bench.py
+cp -f BENCH_DETAIL.json "$OUT/bench_full.detail.json" 2>/dev/null || true
+
+log "done — artifacts in $OUT/"

@@ -32,32 +32,22 @@ def xla_gather(w, idx, val):
 
 
 def pallas_gather(w, idx, val, tile=512, interpret=False):
-    import jax
+    """PRODUCTION kernel (flink_ms_tpu.ops.svm_kernels.margin_gather) —
+    the probe times exactly what FLINK_MS_SVM_WX0=pallas would run, so a
+    kernel tweak can never drift away from the measured numbers."""
+    import os
+
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+
+    from flink_ms_tpu.ops.svm_kernels import margin_gather
 
     n, m = idx.shape
-    assert n % tile == 0
-
-    def kernel(w_ref, idx_ref, val_ref, out_ref):
-        wv = w_ref[:]                       # (d,) VMEM-resident
-        ix = idx_ref[:]                     # (tile, m)
-        g = jnp.take(wv, ix.reshape(-1), axis=0).reshape(tile, m)
-        out_ref[:] = jnp.sum(g * val_ref[:], axis=1)
-
-    return pl.pallas_call(
-        kernel,
-        grid=(n // tile,),
-        in_specs=[
-            pl.BlockSpec(w.shape, lambda i: (0,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, m), lambda i: (i, 0)),
-            pl.BlockSpec((tile, m), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((tile,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
-        interpret=interpret,
-    )(w, idx, val)
+    os.environ["FLINK_MS_SVM_KERNEL_TILE"] = str(tile)
+    platform = "cpu" if interpret else "tpu"
+    return margin_gather(
+        w, idx.reshape(n, 1, m), val.reshape(n, 1, m), jnp.float32,
+        platform,
+    ).reshape(n)
 
 
 def xla_scatter(d, idx, contrib):
@@ -68,38 +58,17 @@ def xla_scatter(d, idx, contrib):
 
 
 def pallas_scatter(d, idx, contrib, tile=512, interpret=False):
-    import jax
+    """PRODUCTION kernel (flink_ms_tpu.ops.svm_kernels.scatter_add_dw) —
+    the probe times exactly what FLINK_MS_SVM_DW=pallas would run."""
+    import os
+
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    n, m = idx.shape
-    assert n % tile == 0
-    grid = (n // tile,)
+    from flink_ms_tpu.ops.svm_kernels import scatter_add_dw
 
-    def kernel(idx_ref, c_ref, out_ref):
-        step = pl.program_id(0)
-
-        @pl.when(step == 0)
-        def _init():
-            out_ref[:] = jnp.zeros_like(out_ref)
-
-        flat_i = idx_ref[:].reshape(-1)
-        flat_c = c_ref[:].reshape(-1)
-        out_ref[:] = out_ref[:].at[flat_i].add(flat_c)
-
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((tile, m), lambda i: (i, 0)),
-            pl.BlockSpec((tile, m), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((d,), lambda i: (0,),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((d,), jnp.float32),
-        interpret=interpret,
-    )(idx, contrib)
+    os.environ["FLINK_MS_SVM_KERNEL_TILE"] = str(tile)
+    platform = "cpu" if interpret else "tpu"
+    return scatter_add_dw(idx, contrib, d, jnp.float32, platform)
 
 
 def main():
@@ -181,6 +150,20 @@ def main():
         )
     for name, v in results.items():
         print(f"{name:>16}: {v if isinstance(v, str) else f'{v:8.2f} ms'}")
+
+    # boundary-scaling demonstration (BASELINE.md: "both boundary terms
+    # are per-device and shrink linearly with mesh size"): time the SAME
+    # ops at per-device shares of the nnz for D=2,4,8 — the per-device
+    # cost at nnz/D is what each chip of a D-mesh would pay
+    print("\nper-device boundary at nnz/D (gather + scatter, xla):")
+    for D in (1, 2, 4, 8):
+        nd = max(n // D, args.tile)
+        nd -= nd % args.tile
+        g = bench(jax.jit(xla_gather), w, idx[:nd], val[:nd])
+        s = bench(jax.jit(lambda i, c: xla_scatter(args.d, i, c)),
+                  idx[:nd], contrib[:nd])
+        print(f"  D={D}: rows/device={nd} gather {g:7.2f} ms, "
+              f"scatter {s:7.2f} ms, boundary {g + s:7.2f} ms")
 
 
 if __name__ == "__main__":

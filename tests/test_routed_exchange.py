@@ -151,3 +151,25 @@ def test_fused_gather_assembly_matches_xla(monkeypatch, rng):
                                rtol=5e-4, atol=1e-6)
     np.testing.assert_allclose(m_pal.item_factors, m_xla.item_factors,
                                rtol=5e-4, atol=1e-6)
+
+
+def test_fused_gather_assembly_implicit_matches_xla(monkeypatch, rng):
+    """Implicit/HKV mode through the fused kernel (confidence-weighted
+    lhs + 1+alpha*r rhs) matches the XLA path."""
+    users, items, ratings = _ratings(n_users=100, n_items=70, nnz=1_200)
+    mesh = make_mesh(4)
+    problem = prepare_blocked(users, items, ratings, 4)
+    k = 5
+    cfg = ALSConfig(num_factors=k, iterations=2, lambda_=0.1,
+                    implicit=True, alpha=10.0, exchange_dtype=None)
+    init = _pinned_init(problem, k)
+    monkeypatch.setenv("FLINK_MS_ALS_ASSEMBLY", "xla")
+    m_xla = als_fit(users, items, ratings, cfg, mesh, problem=problem,
+                    init=init)
+    monkeypatch.setenv("FLINK_MS_ALS_ASSEMBLY", "pallas")
+    m_pal = als_fit(users, items, ratings, cfg, mesh, problem=problem,
+                    init=init)
+    np.testing.assert_allclose(m_pal.user_factors, m_xla.user_factors,
+                               rtol=5e-4, atol=1e-6)
+    np.testing.assert_allclose(m_pal.item_factors, m_xla.item_factors,
+                               rtol=5e-4, atol=1e-6)

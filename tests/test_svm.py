@@ -418,3 +418,27 @@ def test_add_mode_safe_matches_batch_optimum(rng):
                        regularization=lam)
     ref = objective(svm_fit(data, single, make_mesh(1)))
     assert converged <= ref * 1.10 + 1e-3
+
+
+def test_gram_pallas_boundary_matches_einsum(rng, monkeypatch):
+    """FLINK_MS_SVM_WX0=pallas / FLINK_MS_SVM_DW=pallas route the round
+    boundary (margin gather + Xᵀ Δα scatter) through the VMEM-resident
+    Pallas kernels (interpret mode off-TPU) — same numbers, multi-device
+    (ops/svm_kernels.py; the single-chip 452+350 ms boundary terms)."""
+    data = _sparse_blob(rng, n=500, d=250, nnz_row=10)
+    lam = 1e-3
+    mesh = make_mesh(8)
+    p = prepare_svm_blocked(data, 32, seed=0)
+    cfg = SVMConfig(iterations=6, local_iterations=p.rows_per_block,
+                    regularization=lam, mode="add", sigma_prime=4.0,
+                    inner="gram")
+    w_base = svm_fit(data, cfg, mesh, problem=p).weights
+    monkeypatch.setenv("FLINK_MS_SVM_WX0", "pallas")
+    w_wx0 = svm_fit(data, cfg, mesh, problem=p).weights
+    np.testing.assert_allclose(w_wx0, w_base, rtol=2e-4, atol=1e-6)
+    monkeypatch.setenv("FLINK_MS_SVM_DW", "pallas")
+    w_both = svm_fit(data, cfg, mesh, problem=p).weights
+    np.testing.assert_allclose(w_both, w_base, rtol=2e-4, atol=1e-6)
+    monkeypatch.delenv("FLINK_MS_SVM_WX0")
+    w_dw = svm_fit(data, cfg, mesh, problem=p).weights
+    np.testing.assert_allclose(w_dw, w_base, rtol=2e-4, atol=1e-6)
