@@ -6,19 +6,21 @@ a dot operand, so every bucket's gathered rows are written to HBM and read
 straight back — ~2x8 GB per ML-20M iteration — and the random 200 B row
 gather itself runs at worst-case HBM efficiency.  The gather SOURCE is
 small (item table 5.3 MB f32; user table 13.9 MB bf16), so this kernel
-keeps the whole opposite-factor table resident in VMEM, gathers each
-row-tile's rating lists inside the kernel, and contracts them on the MXU
-— the (tile, w, k) gather exists only in VMEM and the HBM transient
-disappears entirely.
+streams each row-tile's rating lists past a VMEM-resident view of the
+opposite-factor table and contracts them on the MXU — the (tile, w, k)
+gather exists only in VMEM and the HBM transient disappears entirely.
+A table over the VMEM budget is processed in up to ``_MAX_TABLE_SLICES``
+slices (minor grid axis): each pass gathers only the entries whose slot
+falls in the resident slice (masked to zero otherwise) and accumulates
+partial A, b into the same output block.
 
 Activation: ``FLINK_MS_ALS_ASSEMBLY=pallas`` (opt-in until
 chip-validated; ``auto`` currently resolves to the XLA path).  The kernel
-gates itself on the table fitting the VMEM budget
-(``FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES``, default 12 MiB) and falls back to
-the XLA path otherwise — at ML-20M the user half-sweep (5.3 MB item
-table) always qualifies; the item half-sweep qualifies under the bf16
-exchange default.  Non-TPU backends run the same kernel in interpret mode
-for tests.
+gates itself on the table fitting ``_MAX_TABLE_SLICES`` slices of the
+VMEM budget (``FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES``, default 12 MiB) and
+falls back to the XLA path beyond that — at ML-20M both half-sweeps
+qualify (item table single-slice; f32 user table in 2-3 slices, bf16 in
+2).  Non-TPU backends run the same kernel in interpret mode for tests.
 
 Cited reference behavior: the normal-equation assembly semantics match
 ``_bucket_normal_eqs`` exactly (explicit mode A = Σ y yᵀ, b = Σ r·y with
@@ -56,15 +58,27 @@ def _row_tile() -> int:
     return int(os.environ.get(_ROW_TILE_ENV, 8))
 
 
-def use_fused_gather(y_all_shape, y_dtype) -> bool:
-    """Trace-time gate: table within the VMEM budget and the knob set to
-    pallas.  Backend selection happens inside fused_bucket_assembly
-    (non-TPU runs the kernel in interpret mode)."""
-    if assembly_choice() != "pallas":
-        return False
+_MAX_TABLE_SLICES = 4
+
+
+def _n_slices(y_all_shape, y_dtype) -> int:
+    """Table slices needed to fit the VMEM budget (each slice is
+    double-buffered across the slice grid axis, so the budget halves)."""
     s, k = y_all_shape
     table_bytes = s * k * np.dtype(y_dtype).itemsize
-    return table_bytes <= _vmem_budget()
+    if table_bytes <= _vmem_budget():
+        return 1
+    return -(-table_bytes // (_vmem_budget() // 2))
+
+
+def use_fused_gather(y_all_shape, y_dtype) -> bool:
+    """Trace-time gate: the knob set to pallas and the table within
+    ``_MAX_TABLE_SLICES`` VMEM slices — beyond that the repeated masked
+    passes over the idx arrays erase the fusion win.  Backend selection
+    happens inside fused_bucket_assembly (non-TPU runs interpret mode)."""
+    if assembly_choice() != "pallas":
+        return False
+    return _n_slices(y_all_shape, y_dtype) <= _MAX_TABLE_SLICES
 
 
 def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
@@ -96,11 +110,28 @@ def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
                       constant_values=s - 1)
         val = jnp.pad(val, ((0, r_pad - r), (0, 0)))
 
+    n_slices = _n_slices((s, k), y_all.dtype)
+    slice_rows = -(-s // n_slices)
+    s_pad = n_slices * slice_rows
+    if s_pad != s:
+        # zero-row padding: padded slots are never gathered in-slice
+        y_all = jnp.pad(y_all, ((0, s_pad - s), (0, 0)))
+
     def kernel(tab_ref, idx_ref, val_ref, a_ref, b_ref):
-        tab = tab_ref[:]
-        ix = idx_ref[:]
-        y = jnp.take(tab, ix.reshape(-1), axis=0).reshape(tile, w, k)
-        yf = y.astype(out_dtype)
+        # grid = (row tiles, table slices); the slice axis is MINOR, so
+        # for one row tile the output block stays resident while every
+        # table slice streams past — each pass gathers only the entries
+        # whose slot falls inside the current slice (masked to zero
+        # otherwise) and accumulates its partial A, b
+        j = pl.program_id(1)
+        tab = tab_ref[:]                      # (slice_rows, k)
+        ix = idx_ref[:]                       # (tile, w) global slots
+        lo = j * slice_rows
+        local = ix - lo
+        in_slice = (local >= 0) & (local < slice_rows)
+        local = jnp.clip(local, 0, slice_rows - 1)
+        y = jnp.take(tab, local.reshape(-1), axis=0).reshape(tile, w, k)
+        yf = jnp.where(in_slice[..., None], y.astype(out_dtype), 0)
         v = val_ref[:].astype(out_dtype)
         if implicit:
             lhs = yf * (alpha * v)[..., None]
@@ -108,27 +139,42 @@ def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
         else:
             lhs = yf
             t = v
-        a_ref[:] = jax.lax.dot_general(
+        a_part = jax.lax.dot_general(
             lhs, yf, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=out_dtype, precision=precision,
         )
-        b_ref[:] = jnp.einsum(
+        # t is NOT masked: the rhs zeroing rides yf, so out-of-slice
+        # entries contribute 0 to b exactly like pad rows do
+        b_part = jnp.einsum(
             "twk,tw->tk", yf, t,
             preferred_element_type=out_dtype, precision=precision,
         )
+        if n_slices == 1:
+            a_ref[:] = a_part
+            b_ref[:] = b_part
+        else:
+            @pl.when(j == 0)
+            def _init():
+                a_ref[:] = a_part
+                b_ref[:] = b_part
+
+            @pl.when(j > 0)
+            def _acc():
+                a_ref[:] = a_ref[:] + a_part
+                b_ref[:] = b_ref[:] + b_part
 
     a_out, b_out = pl.pallas_call(
         kernel,
-        grid=(r_pad // tile,),
+        grid=(r_pad // tile, n_slices),
         in_specs=[
-            pl.BlockSpec((s, k), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),  # resident table
-            pl.BlockSpec((tile, w), lambda i: (i, 0)),
-            pl.BlockSpec((tile, w), lambda i: (i, 0)),
+            pl.BlockSpec((slice_rows, k), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, w), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, w), lambda i, j: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((tile, k, k), lambda i: (i, 0, 0)),
-            pl.BlockSpec((tile, k), lambda i: (i, 0)),
+            pl.BlockSpec((tile, k, k), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((tile, k), lambda i, j: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((r_pad, k, k), out_dtype),

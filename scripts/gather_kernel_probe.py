@@ -78,7 +78,14 @@ def main():
     ap.add_argument("--table", type=int, default=12_000)
     ap.add_argument("--k", type=int, default=64)
     ap.add_argument("--row-tile", type=int, default=8)
+    ap.add_argument("--vmem-budget", type=int, default=0,
+                    help="force FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES (0 = "
+                    "default; small values exercise the sliced multi-pass)")
     args = ap.parse_args()
+    if args.vmem_budget:
+        import os
+
+        os.environ["FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES"] = str(args.vmem_budget)
 
     if args.interpret:
         import os
@@ -105,10 +112,18 @@ def main():
     if args.interpret:
         a_p, b_p = pallas_assembly(table, idx, val, args.row_tile,
                                    interpret=True)
+        # multi-slice runs accumulate per-slice partials (reassociated
+        # sums), so their parity is to f32 round-off; single-slice runs
+        # keep the tight bound
+        from flink_ms_tpu.ops.gather_assembly import _n_slices
+
+        sliced = _n_slices(table.shape, table.dtype) > 1
+        rtol, atol = (2e-4, 1e-4) if sliced else (1e-5, 1e-5)
         np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_ref),
-                                   rtol=1e-5, atol=1e-5)
+                                   rtol=rtol, atol=atol)
         np.testing.assert_allclose(np.asarray(b_p), np.asarray(b_ref),
-                                   rtol=1e-5, atol=1e-5)
+                                   rtol=rtol, atol=atol)
+        print(f"sliced={sliced}", end=" ")
         print("interpret-mode parity OK (xla vs pallas fused)")
         return
 

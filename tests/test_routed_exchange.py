@@ -173,3 +173,40 @@ def test_fused_gather_assembly_implicit_matches_xla(monkeypatch, rng):
                                rtol=5e-4, atol=1e-6)
     np.testing.assert_allclose(m_pal.item_factors, m_xla.item_factors,
                                rtol=5e-4, atol=1e-6)
+
+def test_fused_gather_assembly_multislice(monkeypatch, rng):
+    """A VMEM budget too small for the whole table forces the sliced
+    multi-pass accumulation — results must match the single-slice path
+    (and the XLA path) over the full fit."""
+    users, items, ratings = _ratings(n_users=150, n_items=110, nnz=1_800)
+    mesh = make_mesh(4)
+    problem = prepare_blocked(users, items, ratings, 4)
+    k = 5
+    cfg = ALSConfig(num_factors=k, iterations=2, lambda_=0.1,
+                    exchange_dtype=None)
+    init = _pinned_init(problem, k)
+    monkeypatch.setenv("FLINK_MS_ALS_ASSEMBLY", "xla")
+    m_xla = als_fit(users, items, ratings, cfg, mesh, problem=problem,
+                    init=init)
+    # budget small enough that every table (both sides) needs >=2 slices
+    # but few enough to stay under the slice cap
+    from flink_ms_tpu.ops import gather_assembly as ga
+
+    # budget sized so BOTH tables need >=2 slices yet stay under the
+    # slice cap — otherwise one half-sweep silently falls back to XLA and
+    # the comparison is (partly) XLA vs XLA
+    u_shape = (problem.u.per_block * 4, k)
+    i_shape = (problem.i.per_block * 4, k)
+    budget = max(u_shape[0], i_shape[0]) * k * 4 * 2 // 3
+    monkeypatch.setenv("FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES", str(budget))
+    monkeypatch.setenv("FLINK_MS_ALS_ASSEMBLY", "pallas")
+    for shape in (u_shape, i_shape):
+        n = ga._n_slices(shape, np.float32)
+        assert 2 <= n <= ga._MAX_TABLE_SLICES, (shape, n)
+        assert ga.use_fused_gather(shape, np.float32), shape
+    m_sliced = als_fit(users, items, ratings, cfg, mesh, problem=problem,
+                       init=init)
+    np.testing.assert_allclose(m_sliced.user_factors, m_xla.user_factors,
+                               rtol=5e-4, atol=1e-6)
+    np.testing.assert_allclose(m_sliced.item_factors, m_xla.item_factors,
+                               rtol=5e-4, atol=1e-6)
