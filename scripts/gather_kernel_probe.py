@@ -87,10 +87,14 @@ def main():
 
         os.environ["FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES"] = str(args.vmem_budget)
 
-    if args.interpret:
-        import os
+    import os
 
+    if args.interpret:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # honor an explicit host pin BEFORE the first backend touch —
+        # plain jax.devices() initializes every registered plugin, and a
+        # wedged accelerator tunnel HANGS that init rather than erroring
         from flink_ms_tpu.parallel.mesh import pin_host_backend
 
         pin_host_backend()

@@ -80,10 +80,14 @@ def main():
     ap.add_argument("--tile", type=int, default=512)
     args = ap.parse_args()
 
-    if args.interpret:
-        import os
+    import os
 
+    if args.interpret:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # honor an explicit host pin BEFORE the first backend touch —
+        # plain jax.devices() initializes every registered plugin, and a
+        # wedged accelerator tunnel HANGS that init rather than erroring
         from flink_ms_tpu.parallel.mesh import pin_host_backend
 
         pin_host_backend()
@@ -132,22 +136,30 @@ def main():
 
     import functools
 
+    on_tpu = jax.devices()[0].platform == "tpu"
     results = {"gather_xla": bench(jax.jit(xla_gather), w, idx, val)}
-    try:
-        fn = jax.jit(functools.partial(pallas_gather, tile=args.tile))
-        results["gather_pallas"] = bench(fn, w, idx, val)
-    except Exception as e:  # noqa: BLE001
-        results["gather_pallas"] = f"FAILED: {type(e).__name__}: {str(e)[:240]}"
+    if on_tpu:
+        try:
+            fn = jax.jit(functools.partial(pallas_gather, tile=args.tile))
+            results["gather_pallas"] = bench(fn, w, idx, val)
+        except Exception as e:  # noqa: BLE001
+            results["gather_pallas"] = (
+                f"FAILED: {type(e).__name__}: {str(e)[:240]}"
+            )
     results["scatter_xla"] = bench(
         jax.jit(lambda i, c: xla_scatter(args.d, i, c)), idx, contrib)
-    try:
-        fn = jax.jit(functools.partial(
-            pallas_scatter, args.d, tile=args.tile))
-        results["scatter_pallas"] = bench(fn, idx, contrib)
-    except Exception as e:  # noqa: BLE001
-        results["scatter_pallas"] = (
-            f"FAILED: {type(e).__name__}: {str(e)[:240]}"
-        )
+    if on_tpu:
+        try:
+            fn = jax.jit(functools.partial(
+                pallas_scatter, args.d, tile=args.tile))
+            results["scatter_pallas"] = bench(fn, idx, contrib)
+        except Exception as e:  # noqa: BLE001
+            results["scatter_pallas"] = (
+                f"FAILED: {type(e).__name__}: {str(e)[:240]}"
+            )
+    else:
+        print("(pallas variants skipped off-TPU: a non-interpret "
+              "pallas_call on CPU crawls through the interpreter)")
     for name, v in results.items():
         print(f"{name:>16}: {v if isinstance(v, str) else f'{v:8.2f} ms'}")
 
