@@ -1082,6 +1082,7 @@ def _cached_sweep(problem: BlockedProblem, config: ALSConfig, mesh: Mesh):
         os.environ.get("FLINK_MS_ALS_ASSEMBLY", "auto"),
         os.environ.get("FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES", ""),
         os.environ.get("FLINK_MS_ALS_ASSEMBLY_ROW_TILE", ""),
+        os.environ.get("FLINK_MS_ALS_ASSEMBLY_W_CHUNK", ""),
         # the Pallas solver reads its layout knob at trace time too (when
         # layout=None inside cholesky_solve_batched) — omitting it here
         # would silently reuse an executable compiled under the old layout

@@ -25,8 +25,11 @@ qualify (item table single-slice; f32 user table in 2-3 slices, bf16 in
 Cited reference behavior: the normal-equation assembly semantics match
 ``_bucket_normal_eqs`` exactly (explicit mode A = Σ y yᵀ, b = Σ r·y with
 pad rows zero through the dummy slot — ALSImpl.scala:35-52 [dep] blocked
-ALS), arithmetic reassociated only by tile boundaries on the contraction
-batch axis, never within a row.
+ALS).  Arithmetic: single-slice, single-w-chunk runs reassociate only by
+tile boundaries on the batch axis; a bucket wider than the w-chunk (or a
+table needing multiple slices) accumulates per-chunk/per-slice PARTIAL
+sums within each row — f32 reassociation of the row reduction, which is
+why equivalence tests compare at round-off tolerance, not bitwise.
 """
 
 from __future__ import annotations
@@ -41,6 +44,7 @@ import numpy as np
 _ASSEMBLY_ENV = "FLINK_MS_ALS_ASSEMBLY"
 _VMEM_BUDGET_ENV = "FLINK_MS_ALS_ASSEMBLY_VMEM_BYTES"
 _ROW_TILE_ENV = "FLINK_MS_ALS_ASSEMBLY_ROW_TILE"
+_W_CHUNK_ENV = "FLINK_MS_ALS_ASSEMBLY_W_CHUNK"
 
 
 def assembly_choice() -> str:
@@ -56,6 +60,15 @@ def _vmem_budget() -> int:
 
 def _row_tile() -> int:
     return int(os.environ.get(_ROW_TILE_ENV, 8))
+
+
+def _w_chunk() -> int:
+    """Rating-list columns per grid step.  Wide degree buckets (a popular
+    ML-20M item carries tens of thousands of ratings) would otherwise
+    materialize a (tile, w, k) VMEM gather far beyond the budget; chunking
+    the contraction axis bounds the per-step tile at
+    tile * w_chunk * k floats."""
+    return int(os.environ.get(_W_CHUNK_ENV, 512))
 
 
 _MAX_TABLE_SLICES = 4
@@ -102,13 +115,17 @@ def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
     r, w = idx.shape
     s, k = y_all.shape
     tile = _row_tile()
+    wc = min(_w_chunk(), w)
     r_pad = -(-r // tile) * tile
-    if r_pad != r:
+    w_pad = -(-w // wc) * wc
+    if r_pad != r or w_pad != w:
         # dummy-slot pads: y_all[s-1] is the guaranteed-zero dummy row of
-        # the last block (every block's final slot is a dummy)
-        idx = jnp.pad(idx, ((0, r_pad - r), (0, 0)),
+        # the last block (every block's final slot is a dummy); val pads
+        # are 0, so both padded rows and padded columns contribute nothing
+        idx = jnp.pad(idx, ((0, r_pad - r), (0, w_pad - w)),
                       constant_values=s - 1)
-        val = jnp.pad(val, ((0, r_pad - r), (0, 0)))
+        val = jnp.pad(val, ((0, r_pad - r), (0, w_pad - w)))
+    n_wchunks = w_pad // wc
 
     n_slices = _n_slices((s, k), y_all.dtype)
     slice_rows = -(-s // n_slices)
@@ -116,21 +133,23 @@ def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
     if s_pad != s:
         # zero-row padding: padded slots are never gathered in-slice
         y_all = jnp.pad(y_all, ((0, s_pad - s), (0, 0)))
+    multi = n_slices > 1 or n_wchunks > 1
 
     def kernel(tab_ref, idx_ref, val_ref, a_ref, b_ref):
-        # grid = (row tiles, table slices); the slice axis is MINOR, so
-        # for one row tile the output block stays resident while every
-        # table slice streams past — each pass gathers only the entries
-        # whose slot falls inside the current slice (masked to zero
-        # otherwise) and accumulates its partial A, b
+        # grid = (row tiles, table slices, w chunks); the two minor axes
+        # revisit the same output block, so for one row tile the partial
+        # A, b accumulate in place while table slices and rating-list
+        # chunks stream past.  Each pass gathers only the entries whose
+        # slot falls inside the resident slice (masked to zero otherwise).
         j = pl.program_id(1)
+        c = pl.program_id(2)
         tab = tab_ref[:]                      # (slice_rows, k)
-        ix = idx_ref[:]                       # (tile, w) global slots
+        ix = idx_ref[:]                       # (tile, wc) global slots
         lo = j * slice_rows
         local = ix - lo
         in_slice = (local >= 0) & (local < slice_rows)
         local = jnp.clip(local, 0, slice_rows - 1)
-        y = jnp.take(tab, local.reshape(-1), axis=0).reshape(tile, w, k)
+        y = jnp.take(tab, local.reshape(-1), axis=0).reshape(tile, wc, k)
         yf = jnp.where(in_slice[..., None], y.astype(out_dtype), 0)
         v = val_ref[:].astype(out_dtype)
         if implicit:
@@ -149,32 +168,34 @@ def fused_bucket_assembly(y_all, idx, val, out_dtype, platform: str,
             "twk,tw->tk", yf, t,
             preferred_element_type=out_dtype, precision=precision,
         )
-        if n_slices == 1:
+        if not multi:
             a_ref[:] = a_part
             b_ref[:] = b_part
         else:
-            @pl.when(j == 0)
+            first = (j == 0) & (c == 0)
+
+            @pl.when(first)
             def _init():
                 a_ref[:] = a_part
                 b_ref[:] = b_part
 
-            @pl.when(j > 0)
+            @pl.when(jnp.logical_not(first))
             def _acc():
                 a_ref[:] = a_ref[:] + a_part
                 b_ref[:] = b_ref[:] + b_part
 
     a_out, b_out = pl.pallas_call(
         kernel,
-        grid=(r_pad // tile, n_slices),
+        grid=(r_pad // tile, n_slices, n_wchunks),
         in_specs=[
-            pl.BlockSpec((slice_rows, k), lambda i, j: (j, 0),
+            pl.BlockSpec((slice_rows, k), lambda i, j, c: (j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, w), lambda i, j: (i, 0)),
-            pl.BlockSpec((tile, w), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, wc), lambda i, j, c: (i, c)),
+            pl.BlockSpec((tile, wc), lambda i, j, c: (i, c)),
         ],
         out_specs=[
-            pl.BlockSpec((tile, k, k), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((tile, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, k, k), lambda i, j, c: (i, 0, 0)),
+            pl.BlockSpec((tile, k), lambda i, j, c: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((r_pad, k, k), out_dtype),

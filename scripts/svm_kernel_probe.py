@@ -83,7 +83,10 @@ def main():
     import os
 
     if args.interpret:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # FORCE the host pin: the launcher ambiently exports
+        # JAX_PLATFORMS=axon, so a setdefault would leave the tunnel
+        # plugin registered and a wedged tunnel hangs the first jit
+        os.environ["JAX_PLATFORMS"] = "cpu"
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         # honor an explicit host pin BEFORE the first backend touch —
         # plain jax.devices() initializes every registered plugin, and a
