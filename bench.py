@@ -179,6 +179,33 @@ _last_probe_hang = 0.0
 PROBE_HANG_BACKOFF_S = 900.0
 
 
+_CHILD_PROC = None  # the in-flight probe/recovery subprocess; the SIGTERM
+# emitter must kill it rather than orphan a child holding the chip/tunnel
+
+
+def _tracked_child(cmd, env, budget, cwd):
+    """Popen (not subprocess.run) so a driver-budget SIGTERM can kill an
+    in-flight child — a hung jax probe or a full-scale accelerator re-run —
+    instead of leaving it contending with whatever the driver does next
+    (e.g. queued on-chip measurements).  Raises subprocess.TimeoutExpired
+    after killing the child, like subprocess.run would."""
+    import subprocess
+
+    global _CHILD_PROC
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=cwd)
+    _CHILD_PROC = proc
+    try:
+        out, err = proc.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise
+    finally:
+        _CHILD_PROC = None
+    return subprocess.CompletedProcess(cmd, proc.returncode, out, err)
+
+
 def _accel_probe_ok(orig_env: dict, timeout_s: float) -> bool:
     """One subprocess jax probe under the ORIGINAL env (pre-degrade caps and
     pins must not leak in).  True iff a non-cpu backend initializes.  A
@@ -187,14 +214,14 @@ def _accel_probe_ok(orig_env: dict, timeout_s: float) -> bool:
 
     global _last_probe_hang
     try:
-        probe = subprocess.run(
+        probe = _tracked_child(
             [sys.executable, "-c",
              "from flink_ms_tpu.parallel.mesh import honor_platform_env;"
              "honor_platform_env();"
              "import jax; import sys;"
              "sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)"],
-            timeout=timeout_s, env=orig_env, capture_output=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+            orig_env, timeout_s,
+            os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
         _last_probe_hang = time.time()
@@ -240,17 +267,19 @@ def try_recover_accelerator(result: dict, orig_env: dict, deadline: float,
         _log("[bench] recovery probe failed; staying degraded")
         return
     budget = float(os.environ.get("BENCH_RECOVER_TIMEOUT_S", 2400))
-    budget = max(min(budget, deadline - time.time() + 600), 300)
+    # small grace past the deadline only: the artifact line is already out
+    # (or imminently will be), so a re-run overrunning the stated recovery
+    # budget by minutes would just burn driver wall-clock it can't honor
+    budget = max(min(budget, deadline - time.time() + 60), 120)
     _log(f"[bench] accelerator is back — re-running {'+'.join(sections)} "
          f"in a subprocess (budget {budget:.0f}s)")
     env = dict(orig_env)
     env["BENCH_INIT_ATTEMPTS"] = "2"
     try:
-        sub = subprocess.run(
+        sub = _tracked_child(
             [sys.executable, os.path.abspath(__file__), "--sections-json",
              ",".join(sections)],
-            timeout=budget, env=env, capture_output=True, text=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env, budget, os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
         result["recovery_error"] = f"recovery subprocess hit {budget:.0f}s cap"
@@ -317,6 +346,14 @@ def final_recovery_loop(result: dict, orig_env: dict, deadline: float,
     if not any(sec in requested_sections for sec in ACCEL_SECTIONS):
         return  # nothing accelerator-bound was asked for: recovery can
         # never fire, so don't idle out the deadline
+    # The artifact line is ALREADY emitted by the time this runs (VERDICT
+    # r4 #1: round 4 lost the whole artifact to a driver SIGKILL inside
+    # this loop), so the loop is pure upside — but still bound it by its
+    # own budget so a healthy-driver run doesn't idle out the session:
+    # the global recovery deadline (3000 s from start) outlived the
+    # round-4 driver budget by at least 1210 s.
+    budget = float(os.environ.get("BENCH_FINAL_RECOVERY_BUDGET_S", 900))
+    deadline = min(deadline, time.time() + budget)
     interval = float(os.environ.get("BENCH_RECOVER_PROBE_INTERVAL_S", 120))
     attempts = 0
     while (time.time() < deadline and result.get("degraded")
@@ -783,7 +820,7 @@ _COMPACT_KEYS = (
     "als_rmse_at_iters", "als_rmse_ref_delta",
     "svm_rcv1_sec_per_round", "svm_rcv1_vs_baseline", "svm_secs_to_target",
     "serving_mget_p50_ms", "serving_topk_p50_ms", "serving_shard_mget_p50_ms",
-    "mse_live_value", "degraded", "recovered",
+    "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
 )
 
 
@@ -801,8 +838,9 @@ def emit_artifact(result: dict) -> str:
     compact = {k: result[k] for k in _COMPACT_KEYS if k in result}
     err_keys = sorted(
         k for k in result
-        if k.endswith("_error") and k != "backend_error"  # surfaced on its
-        # own line below — not a section failure
+        if k.endswith("_error")
+        and k not in ("backend_error", "crash_error")  # each surfaced as
+        # its own compact key — neither is a section failure
     )
     if err_keys:
         compact["section_errors"] = err_keys
@@ -818,23 +856,113 @@ def emit_artifact(result: dict) -> str:
     return line
 
 
+_CURRENT_RESULT: dict = {}
+_RECOVERY_CTX = None  # (orig_env, deadline, sections) from _run_all -> main
+
+
+def _ensure_headline_keys(result: dict) -> None:
+    """Every emitted artifact — normal, crashed, or SIGTERM'd — must carry
+    the four headline keys the driver contract names."""
+    result.setdefault("metric", "als_ml20m_sec_per_iter")
+    result.setdefault("value", None)
+    result.setdefault("unit", "s/iter")
+    result.setdefault("vs_baseline", None)
+
+
+def _install_sigterm_emitter(real_stdout) -> None:
+    """timeout(1) delivers SIGTERM before escalating to SIGKILL: emit the
+    best artifact we have RIGHT NOW so a driver-budget kill can never
+    yield parsed=null again (round 4: BENCH_r04.json rc=124, no line)."""
+    import signal
+
+    def _emit_and_die(signum, frame):
+        proc = _CHILD_PROC
+        if proc is not None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        res = dict(_CURRENT_RESULT)
+        res["terminated"] = True
+        _ensure_headline_keys(res)
+        try:
+            line = emit_artifact(res)
+        except Exception:
+            line = json.dumps({
+                "metric": "als_ml20m_sec_per_iter", "value": None,
+                "unit": "s/iter", "vs_baseline": None, "terminated": True,
+            })
+        try:
+            print(line, file=real_stdout, flush=True)
+        except Exception:  # reentrant buffered-IO write mid-print: the
+            # raw fd write cannot collide with the buffered layer
+            try:
+                os.write(real_stdout.fileno(), (line + "\n").encode())
+            except Exception:
+                pass
+        os._exit(124)
+
+    try:
+        signal.signal(signal.SIGTERM, _emit_and_die)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic host: emission-before-loop still holds
+
+
 def main() -> None:
-    # stdout is the artifact: exactly ONE compact JSON line.  Section code
+    # stdout is the artifact: exactly ONE compact JSON line (re-printed at
+    # most once on late recovery — the LAST line wins).  Section code
     # calls CLI mains in-process (producer, SGD, MSE) whose job summaries
-    # print to stdout — reroute everything but the final line to stderr.
+    # print to stdout — reroute everything but the artifact lines to stderr.
     real_stdout = sys.stdout
+    _install_sigterm_emitter(real_stdout)
+    crashed = False
     with contextlib.redirect_stdout(sys.stderr):
-        result = _run_all()
+        try:
+            result = _run_all()
+        except Exception as e:  # even a harness crash must leave a line
+            _log(traceback.format_exc())
+            crashed = True
+            result = dict(_CURRENT_RESULT)
+            # clamp like backend_error: an XLA traceback str() can be
+            # several KB and would outgrow the driver's stdout-tail window
+            result["crash_error"] = f"{type(e).__name__}: {e}"[:100]
+            _ensure_headline_keys(result)
+        ctx = _RECOVERY_CTX
         line = emit_artifact(result)
+    # Un-losable artifact (VERDICT r4 #1): print BEFORE any end-of-run
+    # recovery probing, so a driver kill mid-loop still leaves a parseable
+    # line.  Recovery, if it fires, upgrades the numbers and re-prints.
     print(line, file=real_stdout, flush=True)
+    if crashed:
+        sys.exit(1)  # loud rc, but the line above still parses
+    if ctx is None:
+        return
+    orig_env, deadline, sections = ctx
+    already_recovered = bool(result.get("recovered"))
+    with contextlib.redirect_stdout(sys.stderr):
+        try:
+            final_recovery_loop(result, orig_env, deadline, sections)
+        except Exception:
+            _log(traceback.format_exc())
+        recovered_late = result.get("recovered") and not already_recovered
+        # refresh the sidecar either way so the loop's diagnostics
+        # (final_recovery_attempts, last recovery_error) survive an
+        # unrecovered exhaustion; stdout gets a second line ONLY on late
+        # recovery (VERDICT r4 #1 prescribes re-print + last-line-wins)
+        line = emit_artifact(result)
+    if recovered_late:
+        print(line, file=real_stdout, flush=True)
 
 
 def _run_all(recovery_enabled: bool = True) -> dict:
+    global _CURRENT_RESULT, _RECOVERY_CTX
+    _RECOVERY_CTX = None
     small = os.environ.get("BENCH_SMALL") == "1"
     sections = os.environ.get(
         "BENCH_SECTIONS", "als,svm,serving,svmserve"
     ).split(",")
     result: dict = {}
+    _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
     # the pre-degrade environment: recovery subprocesses must see the
     # operator's config, not the caps/pins the degrade path writes below
     orig_env = dict(os.environ)
@@ -850,11 +978,10 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         devices, platform, backend_error = acquire_devices()
     except Exception as e:
         _log(traceback.format_exc())
-        return {
-            "metric": "als_ml20m_sec_per_iter", "value": None,
-            "unit": "s/iter", "vs_baseline": None, "degraded": True,
-            "backend_error": f"no backend at all: {e}",
-        }
+        result["degraded"] = True
+        result["backend_error"] = f"no backend at all: {e}"
+        _ensure_headline_keys(result)
+        return result
     result["platform"] = platform
     result["n_devices"] = len(devices)
     result["device_kind"] = getattr(devices[0], "device_kind", "unknown")
@@ -925,17 +1052,16 @@ def _run_all(recovery_enabled: bool = True) -> dict:
             try_recover_accelerator(result, orig_env, deadline, sections)
         except Exception:
             _log(traceback.format_exc())
-        # all sections done: if still degraded, spend the remaining
-        # recovery budget probing instead of returning a degraded artifact
-        # early (the loop no-ops when healthy or recovered)
-        final_recovery_loop(result, orig_env, deadline, sections)
+        # End-of-run recovery probing is the CALLER's job (main), run
+        # AFTER the artifact line is on stdout — hand over the context
+        # out-of-band (a ctx key inside `result` would ride os.environ
+        # into any emitted artifact).  Round 4 lost the entire artifact
+        # to a driver SIGKILL inside the final loop because it ran
+        # before emission.
+        _RECOVERY_CTX = (orig_env, deadline, sections)
 
-    if "metric" not in result:
-        # headline section failed: still emit a valid, loud artifact
-        result.setdefault("metric", "als_ml20m_sec_per_iter")
-        result.setdefault("value", None)
-        result.setdefault("unit", "s/iter")
-        result.setdefault("vs_baseline", None)
+    # headline section failed: still emit a valid, loud artifact
+    _ensure_headline_keys(result)
 
     return result
 
