@@ -897,7 +897,9 @@ def _install_sigterm_emitter(real_stdout) -> None:
         except Exception:  # reentrant buffered-IO write mid-print: the
             # raw fd write cannot collide with the buffered layer
             try:
-                os.write(real_stdout.fileno(), (line + "\n").encode())
+                # leading newline: the interrupted print may have flushed
+                # a partial line; never concatenate onto it
+                os.write(real_stdout.fileno(), ("\n" + line + "\n").encode())
             except Exception:
                 pass
         os._exit(124)

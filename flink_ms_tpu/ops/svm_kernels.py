@@ -33,6 +33,14 @@ def _tile() -> int:
     return int(os.environ.get(_ROW_TILE_ENV, 512))
 
 
+_LANE = 128  # TPU vector lane count: (d,) VMEM blocks are padded to a
+# lane multiple so Mosaic never sees a ragged last tile for arbitrary d
+
+
+def _lane_padded(d: int) -> int:
+    return -(-d // _LANE) * _LANE
+
+
 def margin_gather(w, idx, val, out_dtype, platform: str):
     """wx0 (C, H) = Σ_l w[idx[c,h,l]] * val[c,h,l], weight vector VMEM-
     resident, gather fused into the reduction."""
@@ -42,6 +50,9 @@ def margin_gather(w, idx, val, out_dtype, platform: str):
     C, H, L = idx.shape
     n = C * H
     tile = min(_tile(), n)
+    d_pad = _lane_padded(w.shape[0])
+    if d_pad != w.shape[0]:
+        w = jnp.pad(w, (0, d_pad - w.shape[0]))  # idx < d: pad unread
     idx2 = idx.reshape(n, L)
     val2 = val.reshape(n, L)
     n_pad = -(-n // tile) * tile
@@ -83,6 +94,7 @@ def scatter_add_dw(idx, contrib, d, out_dtype, platform: str):
     m = idx.shape[-1]
     rows = n // m
     tile = min(_tile(), rows)
+    d_pad = _lane_padded(d)
     idx2 = idx.reshape(rows, m)
     c2 = contrib.reshape(rows, m)
     rows_pad = -(-rows // tile) * tile
@@ -100,18 +112,19 @@ def scatter_add_dw(idx, contrib, d, out_dtype, platform: str):
         out_ref[:] = out_ref[:].at[idx_ref[:].reshape(-1)].add(
             c_ref[:].reshape(-1).astype(out_dtype))
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=(rows_pad // tile,),
         in_specs=[
             pl.BlockSpec((tile, m), lambda i: (i, 0)),
             pl.BlockSpec((tile, m), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((d,), lambda i: (0,),
+        out_specs=pl.BlockSpec((d_pad,), lambda i: (0,),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((d,), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((d_pad,), out_dtype),
         interpret=platform != "tpu",
     )(idx2, c2)
+    return out[:d]
 
 
 def wx0_choice() -> str:

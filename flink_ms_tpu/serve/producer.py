@@ -46,10 +46,11 @@ def run(params: Params, label: str = "ALS") -> int:
     batch = []
     for line in F.iter_lines(input_path):
         batch.append(line)
-        if len(batch) >= _BATCH:
-            flush_now = (
-                flush_interval_s > 0 and time.monotonic() >= next_flush
-            )
+        # the flush deadline is checked per line, not only when a 10k
+        # batch fills: a source slower than _BATCH lines per interval must
+        # still bound crash loss to one interval (flushOnCheckpoint parity)
+        flush_now = flush_interval_s > 0 and time.monotonic() >= next_flush
+        if len(batch) >= _BATCH or flush_now:
             journal.append(batch, flush=flush_now)
             if flush_now:
                 next_flush = time.monotonic() + flush_interval_s

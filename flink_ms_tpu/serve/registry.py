@@ -53,10 +53,16 @@ def register(job_id: str, host: str, port: int, state_name: str) -> None:
         os.makedirs(registry_dir(), exist_ok=True)
         path = _entry_path(job_id)
         tmp = f"{path}.{os.getpid()}.tmp"
+        import socket
+
         with open(tmp, "w") as f:
             json.dump({
                 "job_id": job_id, "host": host, "port": int(port),
                 "state": state_name, "pid": os.getpid(),
+                # pid_host scopes the pid-liveness check: on a shared-FS
+                # registry a pid is only meaningful on the machine that
+                # recorded it (a wildcard bind says nothing about where)
+                "pid_host": socket.gethostname(),
             }, f)
         os.replace(tmp, path)
     except OSError:
@@ -71,15 +77,56 @@ def unregister(job_id: str) -> None:
 
 
 def resolve(job_id: str) -> Optional[dict]:
-    """-> the registered entry for job_id, or None."""
+    """-> the registered entry for job_id, or None.
+
+    A SIGKILL'd ServingJob never runs its unregister cleanup, so an entry
+    recorded by THIS machine (pid_host matches) whose pid is dead is
+    treated as no-entry (and reaped) — clients then fall back to the
+    explicit-port defaults instead of getting connection-refused on a
+    stale endpoint.  Entries recorded elsewhere (shared-FS registry) are
+    never pid-checked: the pid is meaningless across machines."""
+    path = _entry_path(job_id)
     try:
-        with open(_entry_path(job_id)) as f:
+        with open(path) as f:
             entry = json.load(f)
     except (OSError, ValueError):
         return None
     if not isinstance(entry, dict) or "port" not in entry:
         return None
+    pid = entry.get("pid")
+    if isinstance(pid, int) and _pid_is_ours_and_dead(entry):
+        # narrow the reap TOCTOU: a supervisor may have re-registered the
+        # job at this path since our read — only unlink if the file still
+        # carries the dead pid we just checked
+        try:
+            with open(path) as f:
+                current = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if current.get("pid") == pid:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        return current if isinstance(current, dict) and "port" in current \
+            else None
     return entry
+
+
+def _pid_is_ours_and_dead(entry: dict) -> bool:
+    import socket
+
+    if entry.get("pid_host") != socket.gethostname():
+        return False  # recorded by another machine (or a pre-pid_host
+        # entry): liveness is unknowable here, keep the entry
+    try:
+        os.kill(entry["pid"], 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        pass  # EPERM etc.: the process exists, just not ours
+    return False
 
 
 def merge_endpoint(entry: Optional[dict], explicit_host: Optional[str],

@@ -115,6 +115,105 @@ def test_registry_entry_is_json_file():
     assert registry.resolve("weird/../id") is not None
 
 
+def _rewrite_entry(path, **updates):
+    entry = json.loads(path.read_text())
+    entry.update(updates)
+    path.write_text(json.dumps(entry))
+    return entry
+
+
+def _dead_pid():
+    import subprocess
+    import sys
+
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    return child.pid
+
+
+def test_resolve_reaps_dead_local_pid():
+    """A SIGKILL'd ServingJob never unregisters: an entry recorded by THIS
+    machine whose pid is gone must resolve to None (clients fall back to
+    defaults) and the stale file must be reaped.  Entries recorded by
+    another machine (shared-FS registry) are never pid-checked."""
+    import pathlib
+
+    dead = _dead_pid()
+    registry.register("job-dead", "127.0.0.1", 7009, ALS_STATE)
+    path = next(pathlib.Path(registry.registry_dir()).iterdir())
+    _rewrite_entry(path, pid=dead)
+    assert registry.resolve("job-dead") is None
+    assert not path.exists(), "stale entry not reaped"
+
+    # same dead pid, but recorded by a different machine: liveness is
+    # unknowable here, the entry must survive
+    registry.register("job-remote", "10.9.9.9", 7010, ALS_STATE)
+    path = next(pathlib.Path(registry.registry_dir()).iterdir())
+    _rewrite_entry(path, pid=dead, pid_host="some-other-machine")
+    assert registry.resolve("job-remote")["port"] == 7010
+    assert path.exists()
+
+
+def test_resolve_reap_spares_fresh_reregistration(monkeypatch):
+    """TOCTOU guard: if a supervisor re-registers the job between
+    resolve()'s read of a dead-pid entry and its unlink, the FRESH live
+    entry must be returned, not deleted."""
+    import pathlib
+
+    dead = _dead_pid()
+    registry.register("job-flap", "127.0.0.1", 7011, ALS_STATE)
+    path = next(pathlib.Path(registry.registry_dir()).iterdir())
+    _rewrite_entry(path, pid=dead)
+
+    real_check = registry._pid_is_ours_and_dead
+
+    def check_then_reregister(entry):
+        out = real_check(entry)
+        # the supervisor restart lands exactly in the race window
+        registry.register("job-flap", "127.0.0.1", 7012, ALS_STATE)
+        return out
+
+    monkeypatch.setattr(registry, "_pid_is_ours_and_dead",
+                        check_then_reregister)
+    resolved = registry.resolve("job-flap")
+    assert resolved is not None and resolved["port"] == 7012
+    assert path.exists(), "fresh re-registration was reaped"
+
+
+def test_producer_flushes_slow_source_partial_batch(tmp_path, monkeypatch):
+    """A source slower than one 10k batch per flush interval must still
+    bound crash loss to ~one interval: the deadline is checked per line,
+    so partial batches fsync on cadence (flushOnCheckpoint parity —
+    ALSKafkaProducer.java:35-37)."""
+    from flink_ms_tpu.serve import producer
+
+    model = tmp_path / "model"
+    model.write_text("".join(f"{i},U,0.1;0.2\n" for i in range(10)))
+
+    flushes = []
+    real_append = Journal.append
+
+    def spy_append(self, lines, flush=True):
+        flushes.append((len(lines), bool(flush)))
+        return real_append(self, lines, flush=flush)
+
+    monkeypatch.setattr(Journal, "append", spy_append)
+    clock = [0.0]
+    monkeypatch.setattr(producer.time, "monotonic",
+                        lambda: clock.__setitem__(0, clock[0] + 40.0)
+                        or clock[0])  # +40s/call: every line passes a deadline
+    n = producer.run(Params.from_dict({
+        "journalDir": str(tmp_path / "bus"), "topic": "t",
+        "input": str(model), "flushInterval": 60_000,
+    }))
+    assert n == 10
+    # 10 lines < _BATCH: before the per-line deadline check these would
+    # reach the journal only at end-of-stream (one flush, full loss bound)
+    mid_flushes = [f for f in flushes[:-1] if f[1]]
+    assert mid_flushes, flushes
+    assert all(size < producer._BATCH for size, _ in flushes)
+
+
 def test_producer_flush_interval(tmp_path, monkeypatch):
     """--flushInterval fsyncs mid-load on the checkpoint cadence
     (ALSKafkaProducer.java:35-37 flushes every checkpoint); 0 disables."""
