@@ -38,6 +38,12 @@ def run(params: Params) -> int:
     range_ = params.get_int("range", 1000)
     out_file = params.get_required("outputFile")
     job_id = params.get_required("jobId")
+    # server-side sparse dot (the DOT verb): one round trip per query, no
+    # bucket payloads shipped/parsed here — the realized form of the
+    # reference's range-partitioning goal (fewer RPCs per prediction).
+    # --serverDot false (or a pre-DOT server) falls back to the
+    # query-per-bucket reference shape.
+    server_dot = params.get_bool("serverDot", True)
 
     rng = np.random.default_rng()
     rows = []
@@ -45,6 +51,44 @@ def run(params: Params) -> int:
     with QueryClient(host, port, timeout, job_id) as client:
         for qid in range(num_queries):
             vec = random_sparse_vector(rng, max_features, min_pct)
+            if server_dot:
+                t0 = time.perf_counter()
+                try:
+                    raw_value, missing = client.sparse_dot(
+                        SVM_STATE, range_, vec
+                    )
+                    for bucket in missing:
+                        print(
+                            f"The current Range of Keys {bucket} do not "
+                            "exist in the model. "
+                        )
+                except RuntimeError as e:
+                    if "bad request" in str(e):
+                        server_dot = False  # pre-DOT server: fall back to
+                        # the query-per-bucket reference shape
+                    else:
+                        # transient server-side failure: report it like the
+                        # per-bucket path does, but KEEP the dot mode — a
+                        # silent permanent downgrade would mix two query
+                        # shapes in one latency CSV
+                        print(
+                            "current query failed because of the following "
+                            f"Exception:\n{e}"
+                        )
+                        raw_value = 0.0
+                except Exception as e:
+                    print(
+                        "current query failed because of the following "
+                        f"Exception:\n{e}"
+                    )
+                    raw_value = 0.0
+                if server_dot:
+                    prediction = decide(raw_value, output_decision, threshold)
+                    ms = (time.perf_counter() - t0) * 1000.0
+                    rows.append(
+                        F.format_svm_latency_row(qid, len(vec), prediction, ms)
+                    )
+                    continue
             by_bucket: Dict[int, Dict[int, float]] = defaultdict(dict)
             for fid, val in vec.items():
                 by_bucket[fid // range_][fid] = val

@@ -276,6 +276,36 @@ def parse_svm_range_row(line: str) -> Tuple[int, List[Tuple[int, float]]]:
     return int(bucket_s), list(zip(idx.tolist(), w.tolist()))
 
 
+def sort_dedup_last(idx: np.ndarray, w: np.ndarray) -> Tuple[np.ndarray,
+                                                             np.ndarray]:
+    """Ascending-sort (idx, w) pairs, resolving duplicate ids LAST-wins —
+    the dict-based parse semantics every range-plane consumer has (stable
+    sort keeps input order within a run of equal ids, so the last element
+    of each run is the last occurrence)."""
+    order = np.argsort(idx, kind="stable")
+    si, sw = idx[order], w[order]
+    if si.size:
+        keep = np.concatenate([si[1:] != si[:-1], [True]])
+        si, sw = si[keep], sw[keep]
+    return si, sw
+
+
+def gather_sorted(ref_idx: np.ndarray, ref_w: np.ndarray,
+                  fids) -> Tuple[np.ndarray, np.ndarray]:
+    """Weights for `fids` out of an ascending (ref_idx, ref_w) table.
+
+    -> (weights aligned with ``fids``, boolean hit mask); misses carry
+    weight 0.  One place owns the clamp-then-mask searchsorted subtlety
+    for every range-plane consumer (client cache, DOT merged index)."""
+    fa = np.asarray(fids, np.int64)
+    if ref_idx.size == 0 or fa.size == 0:
+        return np.zeros(fa.size, np.float64), np.zeros(fa.size, bool)
+    pos = np.minimum(np.searchsorted(ref_idx, fa), ref_idx.size - 1)
+    hit = ref_idx[pos] == fa
+    out = np.where(hit, ref_w[pos], 0.0)
+    return out, hit
+
+
 class RangePayloadCache:
     """Payload-keyed cache of parsed+sorted range rows.
 
@@ -284,47 +314,34 @@ class RangePayloadCache:
     model is republished — so the ~0.3 ms C-parse of a ~2000-token payload
     dominates steady-state query latency.  Keying on the payload STRING
     (not the bucket id) makes the cache trivially coherent: a republished
-    bucket arrives as a different string and misses.  Bounded FIFO."""
+    bucket arrives as a different string and misses.  Bounded FIFO;
+    thread-safe (the DOT merged-index rebuild runs on server handler
+    threads, any number of which may share one cache)."""
 
     def __init__(self, max_entries: int = 1024):
+        import threading
+
         self.max_entries = max_entries
         self._cache: dict = {}
+        self._lock = threading.Lock()
 
     def lookup(self, payload: str) -> Tuple[np.ndarray, np.ndarray]:
         """-> (ascending index array, matching weight array)."""
-        hit = self._cache.get(payload)
+        with self._lock:
+            hit = self._cache.get(payload)
         if hit is not None:
             return hit
-        idx, w = parse_svm_range_payload(payload)
-        order = np.argsort(idx, kind="stable")
-        si, sw = idx[order], w[order]
-        if si.size:
-            # duplicate feature ids in one payload resolve LAST-wins, the
-            # dict-based parse semantics every other consumer has (stable
-            # sort keeps payload order within a run of equal ids, so the
-            # last element of each run is the last occurrence)
-            keep = np.concatenate([si[1:] != si[:-1], [True]])
-            si, sw = si[keep], sw[keep]
-        entry = (si, sw)
-        if len(self._cache) >= self.max_entries:
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[payload] = entry
+        entry = sort_dedup_last(*parse_svm_range_payload(payload))
+        with self._lock:
+            while len(self._cache) >= self.max_entries and self._cache:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[payload] = entry
         return entry
 
     def gather(self, payload: str, fids) -> Tuple[np.ndarray, np.ndarray]:
-        """Weights for the requested feature ids.
-
-        -> (weights aligned with ``fids``, boolean hit mask); misses carry
-        weight 0.  One place owns the clamp-then-mask searchsorted
-        subtlety for every range-plane consumer."""
+        """Weights for the requested feature ids (see gather_sorted)."""
         ref_idx, ref_w = self.lookup(payload)
-        fa = np.asarray(fids, np.int64)
-        if ref_idx.size == 0 or fa.size == 0:
-            return np.zeros(fa.size, np.float64), np.zeros(fa.size, bool)
-        pos = np.minimum(np.searchsorted(ref_idx, fa), ref_idx.size - 1)
-        hit = ref_idx[pos] == fa
-        out = np.where(hit, ref_w[pos], 0.0)
-        return out, hit
+        return gather_sorted(ref_idx, ref_w, fids)
 
 
 def parse_svm_range_payload(payload: str) -> Tuple[np.ndarray, np.ndarray]:

@@ -94,6 +94,23 @@ class QueryClient:
                 raise RuntimeError(f"mget item failed: {it!r}")
         return out
 
+    def sparse_dot(self, name: str, range_: int, vec) -> tuple:
+        """Server-side sparse dot over range-partitioned SVM rows — the
+        whole ``{fid: val}`` query in ONE round trip (the DOT verb), no
+        bucket payloads shipped or parsed client-side.
+
+        -> (dot, missing_buckets) where missing_buckets lists the ranges
+        with no model row (the reference prints a console message per
+        missing range, RangePartitionSVMPredict.java:85-90)."""
+        payload = ";".join(f"{int(f)}:{float(v)!r}" for f, v in
+                           (vec.items() if hasattr(vec, "items") else vec))
+        reply = self._roundtrip(f"DOT\t{name}\t{int(range_)}\t{payload}")
+        if not reply.startswith("D\t"):
+            raise RuntimeError(f"dot failed: {reply}")
+        dot_s, _, missing_s = reply[2:].partition("\t")
+        missing = [int(b) for b in missing_s.split(",") if b]
+        return float(dot_s), missing
+
     def topk(self, name: str, user_id: str, k: int):
         """Device-scored top-k recommendations for a user; returns a list of
         (item_id, score) or None if the user is unknown."""

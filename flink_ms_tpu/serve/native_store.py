@@ -311,6 +311,10 @@ class NativeModelTable:
         self.store = store
         self._lock = threading.RLock()
         self.puts = 0
+        # mutation counter, same contract as ModelTable.version: derived
+        # read-side caches (the DOT merged range index) key on it — without
+        # it every DOT request would rescan the whole store
+        self.version = 0
         self._listeners = []
 
     def add_change_listener(self, fn) -> None:
@@ -322,6 +326,7 @@ class NativeModelTable:
         with self._lock:
             self.store.put(key, value)
             self.puts += 1
+            self.version += 1
             for fn in self._listeners:
                 fn(key)
 
@@ -340,6 +345,7 @@ class NativeModelTable:
         with self._lock:
             rows, errs = self.store.ingest_buf(data, mode)
             self.puts += rows
+            self.version += 1
             return rows, errs
 
     def get(self, key: str) -> Optional[str]:

@@ -13,6 +13,14 @@ Line protocol over TCP (persistent connections, thread per client):
                                   hold a slice of the catalog)
               ``COUNT\\t<state_name>\\n``  (key count — ops/metrics surface
                                   and multi-process ingest barrier)
+              ``DOT\\t<state_name>\\t<range>\\t<fid>:<val>;...\\n``  (server-
+                                  side sparse dot against range-partitioned
+                                  SVM rows: the whole sparse query in ONE
+                                  round trip, no bucket payloads shipped or
+                                  parsed client-side — realizing the intent
+                                  of the reference's range partitioning,
+                                  RangePartitionSVMPredict.java:63,80-101,
+                                  which still pays one RPC per bucket)
               ``PING\\n``
     response: ``V\\t<value>\\n``   key found / top-k payload ``item:score;...``
               ``N\\n``            unknown key (client maps to Optional.empty,
@@ -23,6 +31,11 @@ Line protocol over TCP (persistent connections, thread per client):
                                   model rows are CSV/semicolon text)
               ``E\\t<msg>\\n``    error (unknown state name, bad request)
               ``C\\t<n>\\n``      COUNT reply
+              ``D\\t<dot>\\t<missing_buckets_csv>\\n``  DOT reply: float64
+                                  repr of the partial dot over buckets
+                                  present in the state; buckets with no
+                                  row listed so clients can keep the
+                                  reference's missing-range console output
               ``PONG\\t<job_id>\\t<state_name>\\n``
 
 The batched verb exists to beat the reference's serving hot spot: its online
@@ -60,6 +73,14 @@ class LookupServer:
         self.tables = tables
         self.job_id = job_id
         self.topk_handlers = topk_handlers or {}
+        # DOT verb caches: per-payload parse cache (payload-string-keyed =
+        # coherent by construction) feeding a per-state merged sorted index
+        # keyed on the table's mutation version
+        from ..core.formats import RangePayloadCache
+
+        self._dot_cache = RangePayloadCache()
+        self._dot_merged: Dict[str, tuple] = {}
+        self._dot_build_lock = threading.Lock()
         self.requests = 0  # observability; also lets tests assert round trips
         # live persistent connections + their handler threads: clients hold
         # sockets open across many requests, so TCPServer.shutdown() alone
@@ -104,6 +125,69 @@ class LookupServer:
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    def _merged_range_index(self, state: str, table) -> tuple:
+        """(sorted fid array, aligned weight array, bucket-id set) over
+        every parseable bucket row of `state`, rebuilt when the table's
+        mutation version moves.  Per-bucket parses ride the payload-keyed
+        cache, so a rebuild after a republish only re-parses changed rows.
+        Rows whose key is not an int or whose payload is not ``idx:w;...``
+        are skipped — on a flat-model table the index is empty and every
+        queried bucket reports missing, which is what DOT against an
+        un-partitioned state means."""
+        ver = getattr(table, "version", None)
+        cached = self._dot_merged.get(state)
+        if cached is not None and ver is not None and cached[0] == ver:
+            return cached[1], cached[2], cached[3]
+        # single-flight rebuild: with a stale entry available, serve it
+        # rather than pile K handler threads onto K identical O(model)
+        # rebuilds after one mutation (same serve-stale design as the
+        # top-k index); the FIRST build has nothing to serve, so it blocks
+        if not self._dot_build_lock.acquire(blocking=cached is None):
+            return cached[1], cached[2], cached[3]
+        try:
+            return self._rebuild_merged_range_index(state, table)
+        finally:
+            self._dot_build_lock.release()
+
+    def _rebuild_merged_range_index(self, state: str, table) -> tuple:
+        import numpy as np
+
+        ver = getattr(table, "version", None)
+        cached = self._dot_merged.get(state)
+        if cached is not None and ver is not None and cached[0] == ver:
+            return cached[1], cached[2], cached[3]  # built while we waited
+        # the per-payload cache must hold every bucket row, or each rebuild
+        # re-parses the evicted ones forever (FIFO churn at >cap buckets)
+        n_rows = len(table)
+        if n_rows * 2 > self._dot_cache.max_entries:
+            self._dot_cache.max_entries = n_rows * 2
+        fid_parts, w_parts, buckets = [], [], set()
+        for key, payload in table.items():
+            try:
+                bucket = int(key)
+            except ValueError:
+                continue
+            try:
+                idx, w = self._dot_cache.lookup(payload)
+            except ValueError:
+                continue  # not an idx:w;... row (e.g. a flat-model row)
+            buckets.add(bucket)
+            fid_parts.append(idx)
+            w_parts.append(w)
+        if fid_parts:
+            from ..core.formats import sort_dedup_last
+
+            # cross-bucket duplicate fids resolve last-wins, like in-row
+            fids, ws = sort_dedup_last(np.concatenate(fid_parts),
+                                       np.concatenate(w_parts))
+        else:
+            fids = np.zeros(0, np.int64)
+            ws = np.zeros(0, np.float64)
+        buckets = frozenset(buckets)
+        if ver is not None:
+            self._dot_merged[state] = (ver, fids, ws, buckets)
+        return fids, ws, buckets
+
     def _dispatch(self, line: str) -> str:
         self.requests += 1
         parts = line.split("\t")
@@ -135,6 +219,53 @@ class LookupServer:
                 value = table.get(key)
                 items.append("N" if value is None else f"V{value}")
             return "M\t" + "\t".join(items)
+        if parts[0] == "DOT" and len(parts) == 4:
+            # server-side sparse dot over range-partitioned rows: ONE round
+            # trip for the whole sparse query, resolved against a merged
+            # sorted index over every bucket row (version-keyed, so one
+            # searchsorted answers the query instead of one numpy gather
+            # per bucket) — no payload shipping/parsing on the client
+            # (RangePartitionSVMPredict.java:63,80-101 intent)
+            _, state, range_s, qpayload = parts
+            table = self.tables.get(state)
+            if table is None:
+                return f"E\tunknown state: {state}"
+            try:
+                import numpy as np
+
+                range_ = int(range_s)
+                if range_ < 1:
+                    return "E\trange must be >= 1"
+                from ..core.formats import gather_sorted
+
+                # light-weight query parse (the payload is our own client's
+                # wire format): one split, one numpy text-parse pass; any
+                # garbage token raises and returns an E line.  The strict
+                # alternating-separator validator in parse_svm_range_payload
+                # costs more than the whole MGET verb at 70-nnz queries.
+                acc, missing = 0.0, []
+                stripped = qpayload.rstrip(";")
+                if stripped:
+                    toks = stripped.replace(":", ";").split(";")
+                    if len(toks) % 2:
+                        raise ValueError(f"malformed pair in {stripped[:40]!r}")
+                    flat = np.array(toks)
+                    qf = flat[0::2].astype(np.int64)
+                    qv = flat[1::2].astype(np.float64)
+                    fids, ws, bucket_set = self._merged_range_index(
+                        state, table)
+                    got, hit = gather_sorted(fids, ws, qf)
+                    acc = float(qv @ got)
+                    # a bucket with no model row can only show up among the
+                    # missed fids — the common all-hit query skips this
+                    missed = qf[~hit]
+                    if missed.size:
+                        missing = [int(b) for b in
+                                   np.unique(missed // range_).tolist()
+                                   if int(b) not in bucket_set]
+            except Exception as e:
+                return f"E\tdot failed: {e}"
+            return f"D\t{acc!r}\t{','.join(str(b) for b in missing)}"
         if parts[0] in ("TOPK", "TOPKV") and len(parts) == 4:
             # TOPK resolves the user's factors server-side; TOPKV scores an
             # explicit query vector (operands: state, k, payload)

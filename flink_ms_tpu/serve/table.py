@@ -77,6 +77,9 @@ class ModelTable:
         self._shards: List[Dict[str, str]] = [dict() for _ in range(n_shards)]
         self._lock = threading.RLock()
         self.puts = 0  # ingest counter (observability)
+        # bumped on EVERY mutation (put, put_many, restore) — derived
+        # read-side caches (e.g. the DOT merged range index) key on it
+        self.version = 0
         self._listeners: List = []  # change listeners (e.g. the top-k index)
 
     def add_change_listener(self, fn) -> None:
@@ -93,6 +96,7 @@ class ModelTable:
         with self._lock:
             self._shards[self.shard_of(key)][key] = value
             self.puts += 1
+            self.version += 1
             for fn in self._listeners:
                 fn(key)
 
@@ -112,6 +116,7 @@ class ModelTable:
                 for fn in listeners:
                     fn(key)
             self.puts += len(pairs)
+            self.version += 1
 
     def get(self, key: str) -> Optional[str]:
         return self._shards[self.shard_of(key)].get(key)
@@ -194,4 +199,5 @@ class ModelTable:
                             k, _, v = line.partition("\t")
                             shard[k] = v
                 self._shards[idx] = shard
+            self.version += 1
         return int(manifest["offset"])

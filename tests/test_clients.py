@@ -176,6 +176,18 @@ def test_range_partition_harness_matches_flat(svm_serving, tmp_path, rng):
         )
     )
     assert n == 10
+    # the fallback (query-per-bucket, the reference's shape) must keep
+    # working when the server-side dot is declined
+    n2 = range_partition_svm_predict.run(
+        Params.from_args(
+            ["--jobId", job.job_id, "--jobManagerPort", str(job.port),
+             "--jobManagerHost", "127.0.0.1", "--numQueries", "5",
+             "--maxNoOfFeatures", "30", "--range", str(range_),
+             "--outputFile", str(tmp_path / "range_fallback.csv"),
+             "--serverDot", "false"]
+        )
+    )
+    assert n2 == 5
     # cross-check one fixed query against the raw weight vector
     with QueryClient("127.0.0.1", job.port) as c:
         payload = c.query_state(SVM_STATE, "0")

@@ -18,6 +18,7 @@ from flink_ms_tpu.serve import producer
 from flink_ms_tpu.serve.client import QueryClient
 from flink_ms_tpu.serve.consumer import (
     ALS_STATE,
+    SVM_STATE,
     FsStateBackend,
     MemoryStateBackend,
     ServingJob,
@@ -360,6 +361,48 @@ def test_mget_python_server():
                 c.query_states(ALS_STATE, ["has,comma"])
             with pytest.raises(RuntimeError):
                 c.query_states("NO_STATE", ["1-U"])
+    finally:
+        srv.stop()
+
+
+def test_sparse_dot_python_server(rng):
+    """DOT verb: the whole sparse query answered server-side in ONE round
+    trip — exact against client-side computation, missing buckets
+    reported, coherent after a bucket republish, loud errors."""
+    import pytest
+
+    from flink_ms_tpu.serve.server import LookupServer
+
+    table = ModelTable(2)
+    w = np.arange(1, 13, dtype=float) * 0.25
+    for line in F.format_svm_range_rows(w, 4):
+        k, v = parse_svm_record(line)
+        table.put(k, v)
+    srv = LookupServer({SVM_STATE: table}, host="127.0.0.1", port=0).start()
+    try:
+        with QueryClient("127.0.0.1", srv.port) as c:
+            vec = {1: 2.0, 2: -1.0, 7: 0.5, 9: 4.0, 999: 3.0}
+            before = srv.requests
+            dot, missing = c.sparse_dot(SVM_STATE, 4, vec)
+            assert srv.requests == before + 1  # one round trip, whole query
+            expected = sum(w[f - 1] * v for f, v in vec.items()
+                           if f <= len(w))
+            assert dot == pytest.approx(expected, rel=1e-12)
+            assert missing == [999 // 4]
+            # empty query: zero dot, nothing missing
+            assert c.sparse_dot(SVM_STATE, 4, {}) == (0.0, [])
+            # coherence: republishing a bucket must be visible immediately
+            # (the parse cache keys on the payload STRING, not the bucket)
+            table.put("1", "5:10.0")
+            dot2, _ = c.sparse_dot(SVM_STATE, 4, {5: 1.0, 7: 1.0})
+            assert dot2 == pytest.approx(10.0)  # fid 7 gone -> weight 0
+            # loud errors, not silent zeros
+            with pytest.raises(RuntimeError):
+                c.sparse_dot("NO_STATE", 4, {1: 1.0})
+            with pytest.raises(RuntimeError):
+                c.sparse_dot(SVM_STATE, 0, {1: 1.0})
+            assert c._roundtrip(
+                f"DOT\t{SVM_STATE}\t4\t1:oops").startswith("E\t")
     finally:
         srv.stop()
 
