@@ -729,6 +729,25 @@ def run_serving_section(small: bool) -> dict:
             out["mse_live_ratings_per_sec"] = round(n_mse / mse_s)
             out["mse_live_value"] = float(mse_val)
             out["mse_live_rows"] = m_users + m_items
+            # band self-check (VERDICT r4 #8): at the DEFAULT full-scale
+            # config the bounded plane's MSE is deterministic (~4.44,
+            # seeds 29/13) — a value outside +-50% of that flags plane
+            # corruption even if the offline cross-check below also
+            # breaks.  "< 30" would pass a 6x regression.
+            default_cfg = (not small
+                           and "BENCH_MSE_RATINGS" not in os.environ
+                           and "BENCH_SERVE_USERS" not in os.environ
+                           and "BENCH_SERVE_ITEMS" not in os.environ
+                           and "BENCH_SERVE_K" not in os.environ)
+            if default_cfg:
+                expected = 4.44
+                out["mse_expected_band"] = [round(expected * 0.5, 2),
+                                            round(expected * 1.5, 2)]
+                if not (expected * 0.5 <= mse_val <= expected * 1.5):
+                    out["mse_band_error"] = (
+                        f"live MSE {mse_val:.4g} outside "
+                        f"{out['mse_expected_band']} at the default config"
+                    )
             _log(f"[bench:serve] live MSE {mse_val:.4f} over {n_mse} ratings "
                  f"in {mse_s:.1f}s ({out['mse_live_ratings_per_sec']}/s, "
                  f"bounded plane {m_users}+{m_items} rows)")

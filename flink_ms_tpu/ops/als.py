@@ -1399,6 +1399,11 @@ def als_fit(
                 )
                 snap = (start, np.asarray(uf_raw), np.asarray(itf_raw))
         if start > 0:
+            # operational marker — harnesses (and operators) distinguish a
+            # genuine resume from a cold rerun by this line, since snapshot
+            # pruning makes the staging dir's final contents identical
+            print(f"[ALS] staging: resuming from iteration {start} "
+                  f"({temporary_path})", flush=True)
             _, uf_raw, itf_raw = snap
             uf_s, itf_s = _pad_factors(problem, D, k, dtype, uf_raw, itf_raw)
             dev_args[0] = jax.device_put(uf_s, shard3)

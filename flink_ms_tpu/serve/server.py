@@ -161,12 +161,19 @@ class LookupServer:
         n_rows = len(table)
         if n_rows * 2 > self._dot_cache.max_entries:
             self._dot_cache.max_entries = n_rows * 2
-        fid_parts, w_parts, buckets = [], [], set()
+        rows = []
         for key, payload in table.items():
             try:
-                bucket = int(key)
+                rows.append((int(key), payload))
             except ValueError:
                 continue
+        # rows concatenate in ASCENDING BUCKET order (table iteration is
+        # shard-hash order, the native store's is hash-bucket order —
+        # neither is publish order, so cross-row duplicate-fid last-wins
+        # must be pinned to something both planes can reproduce)
+        rows.sort(key=lambda r: r[0])
+        fid_parts, w_parts, buckets = [], [], set()
+        for bucket, payload in rows:
             try:
                 idx, w = self._dot_cache.lookup(payload)
             except ValueError:
@@ -247,7 +254,13 @@ class LookupServer:
                 stripped = qpayload.rstrip(";")
                 if stripped:
                     toks = stripped.replace(":", ";").split(";")
-                    if len(toks) % 2:
+                    # structural check (native-plane parity): exactly one
+                    # colon per segment and no empty interior segments —
+                    # an even token count alone would accept "1:2:3:4"
+                    n_pairs = len(toks) // 2
+                    if (len(toks) % 2
+                            or stripped.count(":") != n_pairs
+                            or stripped.count(";") != n_pairs - 1):
                         raise ValueError(f"malformed pair in {stripped[:40]!r}")
                     flat = np.array(toks)
                     qf = flat[0::2].astype(np.int64)

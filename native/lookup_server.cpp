@@ -58,9 +58,11 @@
 #include <deque>
 #include <cstdlib>
 #include <numeric>
+#include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "tpums.h"
@@ -116,9 +118,23 @@ struct TopkIndex {
   uint64_t ver_bytes = ~0ull;
 };
 
-// One queued unit of top-k work: the raw request operands plus the reply
-// slot already enqueued on the owning connection.  The shared_ptr keeps
-// the slot alive even if the connection closes before the work finishes.
+// Merged sparse-weight index for the DOT verb (serve/server.py
+// _merged_range_index parity): every store row whose key is an integer
+// bucket id and whose payload parses as ``idx:w;...`` contributes its
+// pairs; duplicate feature ids resolve last-wins after a stable sort.
+// Same immutable-snapshot + serve-stale lifecycle as TopkIndex.
+struct DotIndex {
+  std::vector<long long> fids;  // ascending
+  std::vector<double> ws;       // aligned with fids
+  std::unordered_set<long long> buckets;
+  uint64_t ver_count = ~0ull;
+  uint64_t ver_bytes = ~0ull;
+};
+
+// One queued unit of worker-thread work (TOPK/TOPKV/DOT): the raw request
+// operands plus the reply slot already enqueued on the owning connection.
+// The shared_ptr keeps the slot alive even if the connection closes
+// before the work finishes.
 struct TopkTask {
   std::shared_ptr<PendingReply> reply;
   std::string verb, state, query_arg, k_s;
@@ -135,6 +151,10 @@ struct ServerState {
   std::atomic<bool> topk_building{false};
   std::thread topk_builder;      // spawned/reaped on the topk worker thread
                                  // only; final join in tpums_server_stop
+  std::mutex dot_mu;             // guards dot_cur swaps
+  std::shared_ptr<const DotIndex> dot_cur;
+  std::atomic<bool> dot_building{false};
+  std::thread dot_builder;       // same lifecycle as topk_builder
   // TOPK/TOPKV execute on a dedicated worker thread so an O(catalog)
   // index build or score can never head-of-line-block the point-lookup
   // hot path on the epoll thread (the Python plane gets the same
@@ -186,10 +206,9 @@ bool ends_with(const std::string& str, const std::string& suf) {
 // Python picks scientific notation only when |x| >= 1e16 or 0 < |x| < 1e-4;
 // bare to_chars picks whichever is SHORTER (100000.0 -> "1e+05"), so the
 // notation is forced explicitly to keep replies byte-identical.
-std::string format_score(float f) {
-  if (f != f) return "nan";  // Python repr never signs NaN; to_chars
+std::string format_score_d(double d) {
+  if (d != d) return "nan";  // Python repr never signs NaN; to_chars
   // would emit "-nan" for the sign-bit-set QNaN that 0*inf produces
-  double d = static_cast<double>(f);
   char buf[48];
   double a = d < 0 ? -d : d;
   bool scientific = d != 0.0 && (a >= 1e16 || a < 1e-4);
@@ -199,6 +218,11 @@ std::string format_score(float f) {
   std::string out(buf, res.ptr);
   if (out.find_first_of(".enai") == std::string::npos) out += ".0";
   return out;
+}
+
+std::string format_score(float f) {
+  // the f32 score widens to double exactly, so the double repr rule applies
+  return format_score_d(static_cast<double>(f));
 }
 
 // Parse one float token with Python float() semantics: outer ASCII
@@ -635,6 +659,206 @@ std::string handle_line(ServerState* s, const std::string* parts, int n) {
   return "E\tbad request\n";
 }
 
+// ---------------------------------------------------------------------------
+// DOT verb: server-side sparse dot over range-partitioned rows
+// (serve/server.py semantics contract — replies are byte-parity-tested
+// on exactly-representable fixtures).
+
+// Parse one integer token with Python int() semantics: surrounding ASCII
+// whitespace stripped, full consumption required.
+bool parse_int_token(const char* b, const char* e, long long* out) {
+  while (b < e && (*b == ' ' || *b == '\t' || *b == '\r' || *b == '\n'))
+    ++b;
+  while (e > b && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r' ||
+                   e[-1] == '\n'))
+    --e;
+  if (b >= e) return false;
+  std::string tok(b, e);
+  errno = 0;
+  char* endp = nullptr;
+  long long v = strtoll(tok.c_str(), &endp, 10);
+  if (errno != 0 || endp != tok.c_str() + tok.size()) return false;
+  *out = v;
+  return true;
+}
+
+// Parse "<int>:<float>" pairs out of a ';'-separated payload with the
+// Python planes' exact acceptance rules (serve/server.py DOT query parse
+// and core/formats parse_svm_range_payload): ALL trailing semicolons are
+// stripped, an EMPTY interior segment rejects the whole payload, each
+// segment carries exactly one colon, and numbers may be whitespace-padded
+// (Python int()/float() strip).  Rows/queries with any malformed token
+// are rejected whole.
+bool parse_pairs(const std::string& payload,
+                 std::vector<std::pair<long long, double>>* out) {
+  size_t n = payload.size();
+  while (n > 0 && payload[n - 1] == ';') --n;  // rstrip(';') parity
+  size_t start = 0;
+  while (start < n) {
+    size_t semi = payload.find(';', start);
+    if (semi == std::string::npos || semi > n) semi = n;
+    if (semi == start) return false;  // empty interior segment
+    size_t colon = payload.find(':', start);
+    if (colon == std::string::npos || colon >= semi) return false;
+    // exactly one colon per segment (Python's colon-count check)
+    if (payload.find(':', colon + 1) < semi) return false;
+    long long fid = 0;
+    if (!parse_int_token(payload.c_str() + start,
+                         payload.c_str() + colon, &fid)) {
+      return false;
+    }
+    double val = 0.0;
+    if (!parse_float_token(payload.c_str() + colon + 1,
+                           payload.c_str() + semi, &val)) {
+      return false;
+    }
+    out->emplace_back(fid, val);
+    start = semi + 1;
+  }
+  return true;
+}
+
+std::shared_ptr<const DotIndex> build_dot_index(ServerState* s) {
+  auto ix = std::make_shared<DotIndex>();
+  ix->ver_count = tpums_count(s->store);
+  ix->ver_bytes = tpums_log_bytes(s->store);
+  std::vector<std::string> keys;
+  uint64_t cursor = 0;
+  while (tpums_keys_chunk(
+             s->store, &cursor, 8192,
+             [](const char* key, uint32_t klen, void* ctx) {
+               static_cast<std::vector<std::string>*>(ctx)->emplace_back(
+                   key, klen);
+             },
+             &keys) > 0) {
+  }
+  // rows concatenate in ASCENDING BUCKET order on both planes (the store
+  // iterates hash buckets, the Python table dict shards — neither is
+  // publish order, so cross-row duplicate-fid last-wins would otherwise
+  // resolve differently per plane for identical contents)
+  std::vector<std::pair<long long, std::string>> rows;
+  for (const std::string& key : keys) {
+    if (key.empty() || key[0] == '\x01') continue;  // store-internal
+    long long bucket = 0;
+    if (!parse_int_token(key.c_str(), key.c_str() + key.size(), &bucket))
+      continue;
+    uint32_t vlen = 0;
+    int err = 0;
+    char* buf = tpums_get(s->store, key.data(),
+                          static_cast<uint32_t>(key.size()), &vlen, &err);
+    if (!buf) continue;
+    rows.emplace_back(bucket, std::string(buf, vlen));
+    tpums_free_buf(buf);
+  }
+  std::sort(rows.begin(), rows.end(),
+            [](const std::pair<long long, std::string>& a,
+               const std::pair<long long, std::string>& b) {
+              return a.first < b.first;
+            });
+  std::vector<std::pair<long long, double>> pairs;
+  for (const auto& row : rows) {
+    size_t before = pairs.size();
+    if (!parse_pairs(row.second, &pairs)) {
+      pairs.resize(before);  // not an idx:w;... row (e.g. flat model)
+      continue;
+    }
+    ix->buckets.insert(row.first);
+  }
+  // ascending by fid, duplicate ids last-wins (stable sort keeps input
+  // order within a run of equal ids — sort_dedup_last parity)
+  std::stable_sort(pairs.begin(), pairs.end(),
+                   [](const std::pair<long long, double>& a,
+                      const std::pair<long long, double>& b) {
+                     return a.first < b.first;
+                   });
+  ix->fids.reserve(pairs.size());
+  ix->ws.reserve(pairs.size());
+  for (size_t i = 0; i < pairs.size(); ++i) {
+    if (i + 1 < pairs.size() && pairs[i + 1].first == pairs[i].first)
+      continue;
+    ix->fids.push_back(pairs[i].first);
+    ix->ws.push_back(pairs[i].second);
+  }
+  return ix;
+}
+
+std::shared_ptr<const DotIndex> get_dot_index(ServerState* s) {
+  uint64_t count = tpums_count(s->store);
+  uint64_t bytes = tpums_log_bytes(s->store);
+  std::shared_ptr<const DotIndex> cur;
+  {
+    std::lock_guard<std::mutex> g(s->dot_mu);
+    cur = s->dot_cur;
+  }
+  if (cur && cur->ver_count == count && cur->ver_bytes == bytes) return cur;
+  if (!cur) {  // first build: only queued worker tasks wait
+    cur = build_dot_index(s);
+    std::lock_guard<std::mutex> g(s->dot_mu);
+    s->dot_cur = cur;
+    return cur;
+  }
+  bool expected = false;
+  if (s->dot_building.compare_exchange_strong(expected, true)) {
+    if (s->dot_builder.joinable()) s->dot_builder.join();
+    s->dot_builder = std::thread([s]() {
+      auto fresh = build_dot_index(s);
+      {
+        std::lock_guard<std::mutex> g(s->dot_mu);
+        s->dot_cur = std::move(fresh);
+      }
+      s->dot_building.store(false, std::memory_order_release);
+    });
+  }
+  return cur;  // briefly stale while the rebuild runs
+}
+
+std::string handle_dot(ServerState* s, const std::string& state,
+                       const std::string& range_s,
+                       const std::string& payload) {
+  if (state != s->state_name) {
+    return "E\tunknown state: " + state + "\n";
+  }
+  long long range_ = 0;
+  if (!parse_int_token(range_s.c_str(), range_s.c_str() + range_s.size(),
+                       &range_)) {
+    return "E\tdot failed: invalid literal for int() with base 10: '" +
+           range_s + "'\n";
+  }
+  if (range_ < 1) return "E\trange must be >= 1\n";
+  std::vector<std::pair<long long, double>> q;
+  if (!parse_pairs(payload, &q)) {
+    // message parity: the Python plane reports repr(stripped[:40])
+    std::string stripped = payload;
+    while (!stripped.empty() && stripped.back() == ';') stripped.pop_back();
+    return "E\tdot failed: malformed pair in '" +
+           stripped.substr(0, 40) + "'\n";
+  }
+  std::shared_ptr<const DotIndex> ix = get_dot_index(s);
+  double acc = 0.0;
+  std::set<long long> missing;
+  for (const auto& fv : q) {
+    auto it = std::lower_bound(ix->fids.begin(), ix->fids.end(), fv.first);
+    if (it != ix->fids.end() && *it == fv.first) {
+      acc += fv.second * ix->ws[it - ix->fids.begin()];
+    } else {
+      // floor division, matching Python's // for any sign
+      long long b = fv.first / range_;
+      if ((fv.first % range_ != 0) && ((fv.first < 0) != (range_ < 0)))
+        --b;
+      if (!ix->buckets.count(b)) missing.insert(b);
+    }
+  }
+  std::string reply = "D\t" + format_score_d(acc) + "\t";
+  bool first = true;
+  for (long long b : missing) {
+    if (!first) reply.push_back(',');
+    reply += std::to_string(b);
+    first = false;
+  }
+  reply.push_back('\n');
+  return reply;
+}
+
 // Dedicated top-k worker: pops tasks, computes the (possibly O(catalog))
 // reply off the epoll thread, publishes it into the connection's reply
 // slot, and pokes the event loop via the eventfd to flush.
@@ -655,7 +879,10 @@ void topk_worker_loop(ServerState* s) {
     if (task.reply.use_count() > 1) {  // conn still holds its slot — a
       // closed connection's orphaned tasks skip the O(catalog) work
       task.reply->text =
-          handle_topk(s, task.verb, task.state, task.query_arg, task.k_s);
+          task.verb == "DOT"
+              ? handle_dot(s, task.state, task.k_s, task.query_arg)
+              : handle_topk(s, task.verb, task.state, task.query_arg,
+                            task.k_s);
     }
     task.reply->ready.store(true, std::memory_order_release);
     ssize_t wr = write(s->wake_fd, &one, 8);
@@ -684,7 +911,8 @@ bool submit_line(ServerState* s, Conn* c, const std::string& line) {
   // demands "TOPK\ta\tb\tc\td" be a bad request, not a TOPK)
   std::string parts[5];
   int n = split_tabs(line, parts, 5);
-  if ((parts[0] == "TOPK" || parts[0] == "TOPKV") && n == 4) {
+  if ((parts[0] == "TOPK" || parts[0] == "TOPKV" || parts[0] == "DOT") &&
+      n == 4) {
     s->requests.fetch_add(1, std::memory_order_relaxed);
     // slot-count AND byte cap: queued tasks copy the request payload, so
     // a flood of max-size TOPKV lines must trip the same slow-reader
@@ -697,7 +925,8 @@ bool submit_line(ServerState* s, Conn* c, const std::string& line) {
     reply->req_bytes = line.size();
     c->pending_req_bytes += line.size();
     c->pending.push_back(reply);
-    // TOPK operands: state, id, k; TOPKV operands: state, k, payload
+    // TOPK operands: state, id, k; TOPKV operands: state, k, payload;
+    // DOT operands: state, range, payload (range rides the k_s slot)
     TopkTask task{std::move(reply), parts[0], parts[1],
                   parts[0] == "TOPK" ? parts[2] : parts[3],
                   parts[0] == "TOPK" ? parts[3] : parts[2]};
@@ -996,6 +1225,7 @@ void tpums_server_stop(void* srv) {
   s->task_cv.notify_all();
   if (s->topk_worker.joinable()) s->topk_worker.join();
   if (s->topk_builder.joinable()) s->topk_builder.join();
+  if (s->dot_builder.joinable()) s->dot_builder.join();
   destroy(s);
 }
 

@@ -13,7 +13,7 @@ not just exercises:
     identical to an uninterrupted fit),
   - SVM chain stacking (K > D) with convergence at scale.
 
-Writes one JSON artifact (default REHEARSAL_r04.json next to the repo
+Writes one JSON artifact (default REHEARSAL_r05.json next to the repo
 root; override with REHEARSAL_OUT) and exits non-zero on any violated
 invariant.  Runtime on one CPU core is minutes — this is a rehearsal, not
 a benchmark; sec/iter numbers in the artifact are CPU-mesh numbers and
@@ -242,10 +242,138 @@ def main() -> int:
     ok &= check("svm_chains_stack_per_device", K > N_DEV,
                 chains_per_device=-(-K // N_DEV))
 
+    # -- multi-process DCN rehearsal: 2 procs x 4 devices over gloo --------
+    # (VERDICT r4 #7: the distributed code path — parallel/distributed.py,
+    # gloo collectives, single-writer staging, process-0-authoritative
+    # resume — must carry the routed exchange and staging-resume at ~1M
+    # nnz, not just the in-process 8-device mesh.)  Stand-in for the
+    # multi-host run this environment cannot provide.
+    if os.environ.get("REHEARSAL_MULTIPROC", "1") != "0":
+        import socket as _socket
+        import subprocess
+
+        from flink_ms_tpu.core import formats as F
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        mp_dir = tempfile.mkdtemp(prefix="rehearsal_mp_")
+        try:
+            csv = os.path.join(mp_dir, "ratings.csv")
+            F.write_ratings(csv, users, items, ratings)
+
+            def _run_pair(iterations, tag):
+                with _socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    port = s.getsockname()[1]
+                # stdout goes to FILES, not pipes: sequentially draining
+                # two piped children deadlocks if the second fills its
+                # 64 KB pipe mid-collective while we wait on the first
+                procs, handles, logs = [], [], []
+                try:
+                    for pid in (0, 1):
+                        out_dir = os.path.join(mp_dir, f"{tag}-p{pid}")
+                        log_path = os.path.join(mp_dir, f"{tag}-p{pid}.log")
+                        logs.append(log_path)
+                        fh = open(log_path, "wb")
+                        handles.append(fh)
+                        procs.append(subprocess.Popen(
+                            [sys.executable, "-m",
+                             "flink_ms_tpu.train.als_train",
+                             "--input", csv, "--ignoreFirstLine", "false",
+                             "--iterations", str(iterations),
+                             "--numFactors", str(k), "--lambda", "0.1",
+                             "--coordinatorAddress", f"127.0.0.1:{port}",
+                             "--numProcesses", "2", "--processId", str(pid),
+                             "--temporaryPath",
+                             os.path.join(mp_dir, f"stage{pid}"),
+                             "--userFactors", os.path.join(out_dir, "uf"),
+                             "--itemFactors", os.path.join(out_dir, "itf")],
+                            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                                 "XLA_FLAGS":
+                                 "--xla_force_host_platform_device_count=4",
+                                 # pin the routed path on: auto may pick
+                                 # gather for one side, and this section
+                                 # exists to prove routing across processes
+                                 "FLINK_MS_ALS_EXCHANGE_MODE": "routed"},
+                            cwd=repo_root, stdout=fh,
+                            stderr=subprocess.STDOUT))
+                    deadline = time.time() + 1800
+                    rcs = [p.wait(timeout=max(1.0, deadline - time.time()))
+                           for p in procs]
+                except Exception:
+                    # a hung/failed pair must not orphan its sibling while
+                    # the cleanup below deletes its working dir
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                            p.wait(timeout=30)
+                    raise
+                finally:
+                    for fh in handles:
+                        fh.close()
+                outs = [open(lp, errors="replace").read() for lp in logs]
+                return rcs, outs
+
+            t0 = time.time()
+            rcs_a, outs_a = _run_pair(2, "runA")  # "crash" after 2 iters
+            wall_a = round(time.time() - t0, 1)
+            ok &= check("mp_als_2proc_crash_run_exits_zero",
+                        rcs_a == [0, 0], wall_s=wall_a,
+                        tail="" if rcs_a == [0, 0] else outs_a[0][-400:])
+            stage0 = os.path.join(mp_dir, "stage0")
+            pre = sorted(os.listdir(stage0)) if os.path.isdir(stage0) else []
+            t0 = time.time()
+            rcs_b, outs_b = _run_pair(4, "runB")  # new run resumes
+            wall_b = round(time.time() - t0, 1)
+            ok &= check("mp_als_resume_run_exits_zero", rcs_b == [0, 0],
+                        wall_s=wall_b,
+                        tail="" if rcs_b == [0, 0] else outs_b[0][-400:])
+            post = sorted(os.listdir(stage0)) if os.path.isdir(stage0) \
+                else []
+            # the staging dir prunes to a trailing window, so final file
+            # listings cannot distinguish resume from cold rerun — the
+            # als_fit resume marker on process 0's stdout can
+            resumed = "[ALS] staging: resuming from iteration 2" in outs_b[0]
+            ok &= check("mp_als_resume_marker_on_process0", resumed,
+                        pre=pre[:4], post=post[:6])
+            # process-0 output of the resumed run must match an in-process
+            # single-process 4-iteration fit (same CLI defaults: seed 42
+            # init, lambda 0.1) across the CSV round trip
+            if rcs_b == [0, 0]:
+                cfg_cli = ALSConfig(num_factors=k, iterations=4, lambda_=0.1)
+                ref = als_fit(users, items, ratings, cfg_cli, mesh,
+                              problem=problem)
+                ids, kinds, rows = F.read_als_model(
+                    os.path.join(mp_dir, "runB-p0", "uf"))
+                got = {int(i): r for i, kk, r in zip(ids, kinds, rows)}
+                nan_row = np.full(k, np.nan)
+                match = len(got) == len(ref.user_ids) and all(
+                    np.allclose(got.get(int(uid), nan_row), row,
+                                rtol=1e-4, atol=1e-5)
+                    for uid, row in zip(ref.user_ids, ref.user_factors)
+                )
+                ok &= check("mp_als_resumed_matches_inprocess_fit", match,
+                            users=len(got))
+            else:
+                ok &= check("mp_als_resumed_matches_inprocess_fit", False,
+                            skipped="resume run failed")
+            ART["multiproc"] = {
+                "processes": 2, "devices_per_process": 4,
+                "backend": "gloo", "nnz": nnz, "rank": k,
+                "exchange_mode": "routed",
+                "crash_run_2it_s": wall_a, "resume_run_4it_s": wall_b,
+            }
+        except Exception as e:
+            # a crashed harness must still land its earlier checks in the
+            # artifact (ok=false), not lose them to an unhandled traceback
+            ok &= check("mp_section_completes", False,
+                        error=f"{type(e).__name__}: {e}")
+        finally:
+            shutil.rmtree(mp_dir, ignore_errors=True)
+
     ART["ok"] = bool(ok)
     out_path = os.environ.get("REHEARSAL_OUT") or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "REHEARSAL_r04.json",
+        "REHEARSAL_r05.json",
     )
     with open(out_path, "w") as f:
         json.dump(ART, f, indent=1)

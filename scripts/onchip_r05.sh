@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Round-5 on-chip measurement plan — run at first tunnel recovery.
+#
+# The tunnel was wedged for ALL of round 4 and (so far) round 5, so the
+# r4 queue (scripts/onchip_r04.sh: fused-assembly probe + A/B, SVM
+# boundary-kernel probe + A/B, full bench last to warm the driver's
+# compile cache) is still the unmeasured backlog — run it verbatim, then
+# add the one A/B lost to the round-3 wedge: bf16 factor exchange at the
+# full ML-20M scale, judged on als_rmse_ref_delta (the kernel default
+# stays f32 unless the quality delta is clean; chip timing said +20%
+# throughput at the 5M probe, BASELINE.md solver matrix).
+#
+# Usage: bash scripts/onchip_r05.sh [outdir]   (default scripts/onchip_r05)
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-scripts/onchip_r05}"
+mkdir -p "$OUT"
+log() { echo "[onchip_r05 $(date +%H:%M:%S)] $*"; }
+
+bash scripts/onchip_r04.sh "$OUT"
+rc=$?
+if [ $rc -ne 0 ]; then
+  log "r4 backlog aborted (rc=$rc) — not queueing the bf16 quality A/B"
+  exit $rc
+fi
+
+log "bf16 exchange quality A/B at ML-20M scale (lost to the r3 wedge)"
+timeout 2400 env BENCH_SECTIONS=als BENCH_ALS_EXCHANGE=bf16 \
+  BENCH_SKIP_CPU=1 python bench.py --sections-json als \
+  >"$OUT/als_bf16_quality.log" 2>&1
+log "bf16 step rc=$? — compare als_rmse_ref_delta vs the f32 run in"
+log "$OUT/bench_full.detail.json before flipping any default"

@@ -346,6 +346,23 @@ def test_sections_json_entry_point(tmp_path):
     assert not (tmp_path / "should_not_exist.json").exists()
 
 
+def test_host_reference_op_is_quick_and_stable():
+    """Every artifact carries a fixed host-op timing so cross-round
+    throughput swings are attributable to environment vs regression
+    (r4's SGD rate halved with nothing in the artifact to say why)."""
+    import time as _time
+
+    import bench
+
+    t0 = _time.time()
+    a = bench.host_reference_ms()
+    b = bench.host_reference_ms()
+    assert _time.time() - t0 < 30
+    assert 0.1 < a < 10_000 and 0.1 < b < 10_000
+    # medians of 5 on the same box: same order of magnitude
+    assert max(a, b) / min(a, b) < 5, (a, b)
+
+
 def test_final_recovery_loop_has_its_own_budget(monkeypatch):
     """Round 4 lost the artifact because the final loop's deadline (3000 s
     from start) outlived the driver's budget.  The loop must now respect

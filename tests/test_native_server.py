@@ -453,3 +453,69 @@ def test_native_topkv_nan_scores_deterministic(tmp_path):
             assert out == b"V\t1:inf;2:inf\n"
     finally:
         store.close()
+
+
+def test_native_dot_byte_parity(tmp_path):
+    """DOT verb across planes (round 5): the native server answers the
+    server-side sparse dot byte-identically to the Python contract plane
+    on exact-grid fixtures — valid dots, in-row duplicate fids resolving
+    last-wins, missing-bucket reporting, empty query, bad range, unknown
+    state, and wrong arity."""
+    from flink_ms_tpu.serve.consumer import SVM_STATE
+
+    rows = [
+        ("0", "1:1.0;2:0.5;3:-2.0"),
+        ("1", "5:0.25;7:2.0"),
+        ("2", "9:1.0;9:2.5"),       # duplicate fid: last wins (2.5)
+        ("3", "13:4.0;"),           # trailing ';' must parse
+    ]
+    table = ModelTable(2)
+    for k, v in rows:
+        table.put(k, v)
+    pysrv = LookupServer({SVM_STATE: table}, host="127.0.0.1", port=0,
+                         job_id="jid").start()
+    store = _als_store(tmp_path, rows)
+    requests = (
+        b"DOT\tSVM_MODEL\t4\t1:2.0;2:-4.0;7:0.5\n"   # all-hit dot
+        b"DOT\tSVM_MODEL\t4\t9:1.0\n"                # dup fid -> 2.5
+        b"DOT\tSVM_MODEL\t4\t1:2.0;17:1.0;100:3.0\n" # missing buckets 4,25
+        b"DOT\tSVM_MODEL\t4\t13:0.25;15:1.0\n"       # fid miss, bucket hit
+        b"DOT\tSVM_MODEL\t4\t\n"                     # empty query
+        b"DOT\tSVM_MODEL\t0\t1:1.0\n"                # range < 1
+        b"DOT\tSVM_MODEL\tx\t1:1.0\n"                # non-integer range
+        b"DOT\tOTHER\t4\t1:1.0\n"                    # unknown state
+        b"DOT\tSVM_MODEL\t4\n"                       # arity -> bad request
+        b"DOT\tSVM_MODEL\t 4 \t 1 : 2.0 \n"          # whitespace padding
+        b"DOT\tSVM_MODEL\t4\t5:0.25;;;\n"            # trailing ';' run ok
+        b"DOT\tSVM_MODEL\t4\t1:1.0;;2:0.5\n"         # empty interior seg
+        b"DOT\tSVM_MODEL\t4\t1:2.0:3.0\n"            # two colons in a pair
+    )
+    try:
+        with NativeLookupServer(store, SVM_STATE, job_id="jid",
+                                port=0) as nsrv:
+            native = _raw(nsrv.port, requests)
+            python = _raw(pysrv.port, requests)
+            assert native == python, (native, python)
+            # pin the actual semantics, not just agreement
+            lines = python.decode().splitlines()
+            assert lines[0] == "D\t1.0\t"       # 2-2+1
+            assert lines[1] == "D\t2.5\t"
+            assert lines[2] == "D\t2.0\t4,25"
+            assert lines[3] == "D\t1.0\t"
+            assert lines[4] == "D\t0.0\t"
+            assert lines[5] == "E\trange must be >= 1"
+            assert lines[8] == "E\tbad request"
+            assert lines[9] == "D\t2.0\t"
+            assert lines[10] == "D\t0.0625\t"
+            assert lines[11].startswith("E\tdot failed: malformed pair")
+            assert lines[12].startswith("E\tdot failed: malformed pair")
+            # numeric-literal failures: both planes reject (E), but the
+            # message text is plane-specific (numpy vs strtod) — compare
+            # acceptance only
+            for bad in (b"DOT\tSVM_MODEL\t4\t1:abc\n",
+                        b"DOT\tSVM_MODEL\t4\tzz:1.0\n"):
+                assert _raw(nsrv.port, bad).startswith(b"E\tdot failed")
+                assert _raw(pysrv.port, bad).startswith(b"E\tdot failed")
+    finally:
+        pysrv.stop()
+        store.close()
