@@ -438,6 +438,8 @@ def run_svm_serving_section(small: bool) -> dict:
         ms_rd = []
         dot_check = None
         with QueryClient("127.0.0.1", rjob.port, timeout_s=60) as c:
+            c.sparse_dot(SVM_STATE, range_, [(1, 1.0)])  # index build —
+            # untimed on BOTH planes so the timed samples compare
             for feats in queries:
                 q_vec = [(int(f), 1.0) for f in feats]
                 t0 = time.perf_counter()
@@ -464,6 +466,52 @@ def run_svm_serving_section(small: bool) -> dict:
         out.update(
             {f"svmserve_range_dot_{q}_ms": v for q, v in _pcts(ms_rd).items()}
         )
+        # native plane: the same range rows through the C++ store + epoll
+        # server's DOT (byte-parity-tested against the plane above) —
+        # error-isolated like the ALS native section
+        try:
+            from flink_ms_tpu.serve.native_store import (
+                NativeLookupServer,
+                NativeStore,
+            )
+
+            nstore = NativeStore(os.path.join(tmp, "dot_store"))
+            try:
+                with open(os.path.join(tmp, "model"), "rb") as f:
+                    n_ing, n_errs = nstore.ingest_buf(f.read(), 1)
+                if n_ing != n_buckets or n_errs:
+                    raise RuntimeError(
+                        f"partial native ingest: {n_ing}/{n_buckets} rows, "
+                        f"{n_errs} errors — timings would score a smaller "
+                        "index"
+                    )
+                with NativeLookupServer(nstore, SVM_STATE, job_id="bench",
+                                        port=0) as nsrv:
+                    ms_nd = []
+                    with QueryClient("127.0.0.1", nsrv.port,
+                                     timeout_s=60) as c:
+                        c.sparse_dot(SVM_STATE, range_,
+                                     [(1, 1.0)])  # index build
+                        for feats in queries:
+                            q_vec = [(int(f), 1.0) for f in feats]
+                            t0 = time.perf_counter()
+                            ndot, _miss = c.sparse_dot(SVM_STATE, range_,
+                                                       q_vec)
+                            ms_nd.append(
+                                (time.perf_counter() - t0) * 1000.0)
+                    out.update({f"svmserve_native_dot_{q}_ms": v
+                                for q, v in _pcts(ms_nd).items()})
+                    if dot_check is not None and abs(ndot - dot_check) \
+                            > 1e-9 * max(1.0, abs(dot_check)):
+                        out["svmserve_native_dot_error"] = (
+                            f"native DOT={ndot!r} != python {dot_check!r}"
+                        )
+                    _log(f"[bench:svmserve] native DOT {_pcts(ms_nd)} ms")
+            finally:
+                nstore.close()
+        except Exception:
+            _log(traceback.format_exc())
+            out["svmserve_native_error"] = traceback.format_exc(limit=3)
         out["svmserve_features"] = n_feat
         out["svmserve_buckets"] = n_buckets
         _log(f"[bench:svmserve] flat {_pcts(ms)} ms, "
