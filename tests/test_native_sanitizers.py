@@ -28,8 +28,12 @@ d = tempfile.mkdtemp()
 store = NativeStore(d)
 for i in range(100):
     store.put(f"{i}-U", "0.5;1.5;2.5")
+    store.put(f"{i}-I", "1.5;0.5;2.0")
+for b in range(10):
+    store.put(str(b), f"{b * 4 + 1}:0.5;{b * 4 + 2}:1.5")  # DOT bucket rows
 
-with NativeLookupServer(store, "ALS_MODEL", job_id="san", port=0) as srv:
+with NativeLookupServer(store, "ALS_MODEL", job_id="san", port=0,
+                        topk_suffixes=("-I", "-U")) as srv:
     stop = threading.Event()
     errors = []
 
@@ -37,6 +41,10 @@ with NativeLookupServer(store, "ALS_MODEL", job_id="san", port=0) as srv:
         i = 0
         while not stop.is_set():
             store.put(f"{i % 100}-U", f"{i};{i + 1}")
+            # republish a DOT bucket row: every put moves the store
+            # version, racing the serve-stale dot/topk index builder
+            # threads against the scan below
+            store.put(str(i % 10), f"{i % 40 + 1}:{i % 9}.5")
             if i % 7 == 0:
                 # the bulk-ingest path shares the mutex with reads: keep
                 # it under the race gate too
@@ -58,7 +66,27 @@ with NativeLookupServer(store, "ALS_MODEL", job_id="san", port=0) as srv:
         except Exception as e:  # noqa: BLE001
             errors.append(repr(e))
 
+    def worker_verbs():
+        # DOT + TOPKV run on the worker thread with O(catalog) index
+        # builds behind serve-stale swaps — the cross-thread machinery
+        # added in rounds 4-5
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port), timeout=10) as s:
+                f = s.makefile("rb")
+                for i in range(150):
+                    s.sendall(b"DOT\tALS_MODEL\t4\t%d:1.0;2:0.5\n" % (i % 40 + 1))
+                    line = f.readline()
+                    if not line.startswith(b"D\t"):
+                        errors.append("bad DOT reply: %r" % line)
+                    s.sendall(b"TOPKV\tALS_MODEL\t3\t1.0;0.5;0.25\n")
+                    line = f.readline()
+                    if not line.startswith(b"V\t"):
+                        errors.append("bad TOPKV reply: %r" % line)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
     threads = [threading.Thread(target=querier) for _ in range(4)]
+    threads += [threading.Thread(target=worker_verbs) for _ in range(2)]
     wt = threading.Thread(target=writer)
     wt.start()
     for t in threads:
