@@ -21,6 +21,10 @@ NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 # the stop/join handoff.
 WORKLOAD = r"""
 import os, socket, threading, sys, tempfile
+# gate-validity marker: the runner asserts the sanitizer runtime is
+# actually mapped, else a broken LD_PRELOAD would pass the gate vacuously
+print("sanitizer-maps:", open("/proc/self/maps").read().count("san.so"),
+      file=sys.stderr)
 sys.path.insert(0, os.environ["REPO_ROOT"])
 from flink_ms_tpu.serve.native_store import NativeStore, NativeLookupServer
 
@@ -127,6 +131,13 @@ def _run_gate(variant: str, runtime_so: str, extra_env: dict) -> None:
     )
     report = proc.stdout + proc.stderr
     assert "WORKLOAD-OK" in report, report
+    import re
+
+    m = re.search(r"sanitizer-maps: (\d+)", report)
+    assert m and int(m.group(1)) > 0, (
+        "sanitizer runtime not mapped in the workload child — the race "
+        "gate would pass vacuously\n" + report
+    )
     # only reports that implicate our code fail the gate; the uninstrumented
     # interpreter can trip unrelated interceptor noise.  Scan whole report
     # stanzas, not just the SUMMARY line: tsan/asan summaries show a single
