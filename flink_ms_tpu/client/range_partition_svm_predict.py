@@ -62,26 +62,20 @@ def run(params: Params) -> int:
                             f"The current Range of Keys {bucket} do not "
                             "exist in the model. "
                         )
-                except RuntimeError as e:
-                    if "bad request" in str(e):
+                except Exception as e:
+                    if isinstance(e, RuntimeError) and "bad request" in str(e):
                         server_dot = False  # pre-DOT server: fall back to
                         # the query-per-bucket reference shape
                     else:
-                        # transient server-side failure: report it like the
-                        # per-bucket path does, but KEEP the dot mode — a
-                        # silent permanent downgrade would mix two query
-                        # shapes in one latency CSV
+                        # transient failure: report it like the per-bucket
+                        # path does, but KEEP the dot mode — a silent
+                        # permanent downgrade would mix two query shapes
+                        # in one latency CSV
                         print(
                             "current query failed because of the following "
                             f"Exception:\n{e}"
                         )
                         raw_value = 0.0
-                except Exception as e:
-                    print(
-                        "current query failed because of the following "
-                        f"Exception:\n{e}"
-                    )
-                    raw_value = 0.0
                 if server_dot:
                     prediction = decide(raw_value, output_decision, threshold)
                     ms = (time.perf_counter() - t0) * 1000.0

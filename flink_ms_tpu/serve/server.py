@@ -58,6 +58,7 @@ import socketserver
 import threading
 from typing import Dict, Optional
 
+from ..core.formats import RangePayloadCache, gather_sorted, sort_dedup_last
 from .table import ModelTable
 
 
@@ -76,8 +77,6 @@ class LookupServer:
         # DOT verb caches: per-payload parse cache (payload-string-keyed =
         # coherent by construction) feeding a per-state merged sorted index
         # keyed on the table's mutation version
-        from ..core.formats import RangePayloadCache
-
         self._dot_cache = RangePayloadCache()
         self._dot_merged: Dict[str, tuple] = {}
         self._dot_build_lock = threading.Lock()
@@ -182,8 +181,6 @@ class LookupServer:
             fid_parts.append(idx)
             w_parts.append(w)
         if fid_parts:
-            from ..core.formats import sort_dedup_last
-
             # cross-bucket duplicate fids resolve last-wins, like in-row
             fids, ws = sort_dedup_last(np.concatenate(fid_parts),
                                        np.concatenate(w_parts))
@@ -243,8 +240,6 @@ class LookupServer:
                 range_ = int(range_s)
                 if range_ < 1:
                     return "E\trange must be >= 1"
-                from ..core.formats import gather_sorted
-
                 # light-weight query parse (the payload is our own client's
                 # wire format): one split, one numpy text-parse pass; any
                 # garbage token raises and returns an E line.  The strict

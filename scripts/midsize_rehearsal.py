@@ -260,48 +260,35 @@ def main() -> int:
             csv = os.path.join(mp_dir, "ratings.csv")
             F.write_ratings(csv, users, items, ratings)
 
-            def _run_pair(iterations, tag):
+            def _run_pair(tag, argv_for, extra_env=None):
+                """Launch a 2-process CLI pair over a fresh coordinator
+                port.  stdout goes to FILES, not pipes: sequentially
+                draining two piped children deadlocks if the second fills
+                its 64 KB pipe mid-collective while we wait on the first.
+                A hung/failed pair must not orphan its sibling while the
+                cleanup below deletes its working dir."""
                 with _socket.socket() as s:
                     s.bind(("127.0.0.1", 0))
                     port = s.getsockname()[1]
-                # stdout goes to FILES, not pipes: sequentially draining
-                # two piped children deadlocks if the second fills its
-                # 64 KB pipe mid-collective while we wait on the first
                 procs, handles, logs = [], [], []
                 try:
                     for pid in (0, 1):
-                        out_dir = os.path.join(mp_dir, f"{tag}-p{pid}")
                         log_path = os.path.join(mp_dir, f"{tag}-p{pid}.log")
                         logs.append(log_path)
                         fh = open(log_path, "wb")
                         handles.append(fh)
                         procs.append(subprocess.Popen(
-                            [sys.executable, "-m",
-                             "flink_ms_tpu.train.als_train",
-                             "--input", csv, "--ignoreFirstLine", "false",
-                             "--iterations", str(iterations),
-                             "--numFactors", str(k), "--lambda", "0.1",
-                             "--coordinatorAddress", f"127.0.0.1:{port}",
-                             "--numProcesses", "2", "--processId", str(pid),
-                             "--temporaryPath",
-                             os.path.join(mp_dir, f"stage{pid}"),
-                             "--userFactors", os.path.join(out_dir, "uf"),
-                             "--itemFactors", os.path.join(out_dir, "itf")],
+                            argv_for(pid, port),
                             env={**os.environ, "JAX_PLATFORMS": "cpu",
                                  "XLA_FLAGS":
                                  "--xla_force_host_platform_device_count=4",
-                                 # pin the routed path on: auto may pick
-                                 # gather for one side, and this section
-                                 # exists to prove routing across processes
-                                 "FLINK_MS_ALS_EXCHANGE_MODE": "routed"},
+                                 **(extra_env or {})},
                             cwd=repo_root, stdout=fh,
                             stderr=subprocess.STDOUT))
                     deadline = time.time() + 1800
                     rcs = [p.wait(timeout=max(1.0, deadline - time.time()))
                            for p in procs]
                 except Exception:
-                    # a hung/failed pair must not orphan its sibling while
-                    # the cleanup below deletes its working dir
                     for p in procs:
                         if p.poll() is None:
                             p.kill()
@@ -313,8 +300,29 @@ def main() -> int:
                 outs = [open(lp, errors="replace").read() for lp in logs]
                 return rcs, outs
 
+            def _als_argv(iterations, tag):
+                def argv_for(pid, port):
+                    out_dir = os.path.join(mp_dir, f"{tag}-p{pid}")
+                    return [sys.executable, "-m",
+                            "flink_ms_tpu.train.als_train",
+                            "--input", csv, "--ignoreFirstLine", "false",
+                            "--iterations", str(iterations),
+                            "--numFactors", str(k), "--lambda", "0.1",
+                            "--coordinatorAddress", f"127.0.0.1:{port}",
+                            "--numProcesses", "2", "--processId", str(pid),
+                            "--temporaryPath",
+                            os.path.join(mp_dir, f"stage{pid}"),
+                            "--userFactors", os.path.join(out_dir, "uf"),
+                            "--itemFactors", os.path.join(out_dir, "itf")]
+                return argv_for
+
+            # pin the routed path on: auto may pick gather for one side,
+            # and this section exists to prove routing across processes
+            _routed = {"FLINK_MS_ALS_EXCHANGE_MODE": "routed"}
+
             t0 = time.time()
-            rcs_a, outs_a = _run_pair(2, "runA")  # "crash" after 2 iters
+            rcs_a, outs_a = _run_pair("runA", _als_argv(2, "runA"),
+                                      _routed)  # "crash" after 2 iters
             wall_a = round(time.time() - t0, 1)
             ok &= check("mp_als_2proc_crash_run_exits_zero",
                         rcs_a == [0, 0], wall_s=wall_a,
@@ -322,7 +330,8 @@ def main() -> int:
             stage0 = os.path.join(mp_dir, "stage0")
             pre = sorted(os.listdir(stage0)) if os.path.isdir(stage0) else []
             t0 = time.time()
-            rcs_b, outs_b = _run_pair(4, "runB")  # new run resumes
+            rcs_b, outs_b = _run_pair("runB", _als_argv(4, "runB"),
+                                      _routed)  # new run resumes
             wall_b = round(time.time() - t0, 1)
             ok &= check("mp_als_resume_run_exits_zero", rcs_b == [0, 0],
                         wall_s=wall_b,
@@ -362,6 +371,57 @@ def main() -> int:
                 "exchange_mode": "routed",
                 "crash_run_2it_s": wall_a, "resume_run_4it_s": wall_b,
             }
+
+            # CoCoA SVM over the same 2-proc x 4-device gloo mesh: chains
+            # split by the deterministic layout, deltas psum'd over DCN —
+            # process-0 output must equal the in-process fit
+            svm_lines = []
+            for r in range(n_ex):
+                lo, hi = indptr[r], indptr[r + 1]
+                tok = " ".join(f"{int(j) + 1}:{v}" for j, v in
+                               zip(indices[lo:hi], values[lo:hi]))
+                svm_lines.append(f"{int(labels[r])} {tok}")  # +-1 labels:
+                # a 0/1 encoding would alias -1 onto sign(0) -> +1 in
+                # prepare_svm_blocked
+            svm_train_path = os.path.join(mp_dir, "svm_train.libsvm")
+            with open(svm_train_path, "w") as f:
+                f.write("\n".join(svm_lines) + "\n")
+            def _svm_argv(pid, port):
+                return [sys.executable, "-m",
+                        "flink_ms_tpu.train.svm_train",
+                        "--training", svm_train_path,
+                        "--blocks", "64", "--iteration", "3",
+                        "--localIterations", "20",
+                        "--coordinatorAddress", f"127.0.0.1:{port}",
+                        "--numProcesses", "2", "--processId", str(pid),
+                        "--output", os.path.join(mp_dir, f"svm-w{pid}")]
+
+            t0 = time.time()
+            sv_rcs, sv_outs = _run_pair("svm", _svm_argv)
+            wall_svm = round(time.time() - t0, 1)
+            ok &= check("mp_svm_2proc_exits_zero", sv_rcs == [0, 0],
+                        wall_s=wall_svm,
+                        tail="" if sv_rcs == [0, 0] else sv_outs[0][-400:])
+            if sv_rcs == [0, 0]:
+                sp = prepare_svm_blocked(data, 64, seed=0)
+                ref_cfg = SVMConfig(iterations=3, local_iterations=20,
+                                    regularization=1.0)
+                ref_w = svm_fit(data, ref_cfg, mesh, problem=sp).weights
+                got_w = F.read_svm_model(
+                    os.path.join(mp_dir, "svm-w0"), n_features=n_feat)
+                ok &= check(
+                    "mp_svm_matches_inprocess_fit",
+                    np.allclose(got_w, ref_w, rtol=1e-4, atol=1e-6),
+                    d=n_feat,
+                )
+                # single-writer output contract across processes
+                ok &= check("mp_svm_single_writer",
+                            not os.path.exists(
+                                os.path.join(mp_dir, "svm-w1")))
+                ART["multiproc"]["svm_2proc_3rounds_s"] = wall_svm
+            else:
+                ok &= check("mp_svm_matches_inprocess_fit", False,
+                            skipped="svm pair failed")
         except Exception as e:
             # a crashed harness must still land its earlier checks in the
             # artifact (ok=false), not lose them to an unhandled traceback
