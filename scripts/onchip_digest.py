@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Digest an onchip_r0N output directory into a decision table.
+
+Each A/B step in scripts/onchip_r04.sh / onchip_r05.sh writes a log whose
+LAST JSON-parseable line is the bench `--sections-json` artifact (probe
+steps print their own summaries).  This prints the headline key per step
+side by side and states the knob decision each pair implies, so a short
+tunnel-recovery window is spent measuring, not log-grubbing.
+
+  python scripts/onchip_digest.py [outdir]   (default scripts/onchip_r05)
+"""
+
+import json
+import os
+import sys
+
+
+def last_json(path):
+    try:
+        lines = open(path, errors="replace").read().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "onchip_r05")
+    if not os.path.isdir(out):
+        sys.exit(f"no such outdir: {out}")
+
+    def val(name, *keys):
+        """Headline key from a step's artifact — CHIP runs only: a wedged
+        step degrades to CPU (or gets SIGTERM'd) yet still emits a full
+        artifact, and comparing that against a chip number would flip the
+        recommendation (bench.py's own recovery-merge applies the same
+        platform/degraded guard)."""
+        j = last_json(os.path.join(out, name + ".log"))
+        if j is None:
+            return None
+        if (j.get("platform") == "cpu" or j.get("degraded")
+                or j.get("terminated")):
+            print(f"  !! {name}: artifact is {j.get('platform')}/"
+                  f"degraded={j.get('degraded')} — NOT a chip number, "
+                  "excluded")
+            return None
+        for k in keys:
+            if j.get(k) is not None:
+                return j[k]
+        return None
+
+    print(f"== {out} ==")
+    # ALS assembly A/B (5M-nnz probe config): sec/iter, lower wins
+    ax = val("als_ab_xla", "value")
+    ap = val("als_ab_pallas", "value")
+    print(f"ALS assembly   xla={ax}  pallas={ap}  s/iter")
+    if ax and ap:
+        win = "pallas" if ap < ax else "xla"
+        print(f"  -> FLINK_MS_ALS_ASSEMBLY auto should resolve to {win} "
+              f"({min(ax, ap) / max(ax, ap):.2f}x)")
+
+    # SVM boundary A/B at RCV1 scale: sec/round, lower wins
+    sb = val("svm_ab_base", "svm_rcv1_sec_per_round")
+    sp = val("svm_ab_pallas", "svm_rcv1_sec_per_round")
+    print(f"SVM boundary   base={sb}  pallas={sp}  s/round")
+    if sb and sp:
+        win = "pallas" if sp < sb else "einsum/direct"
+        print(f"  -> FLINK_MS_SVM_WX0/DW auto should stay/become {win} "
+              f"({min(sb, sp) / max(sb, sp):.2f}x)")
+        host = 0.339  # BASELINE.md "RCV1 ... Gram inner loop" host-r3 row
+        best = min(sb, sp)
+        print(f"  -> vs the host 0.339 s/round (BASELINE.md host-r3 row — "
+              f"re-check that row before trusting): {host / best:.2f}")
+
+    # full bench: headline + quality anchor
+    fb = last_json(os.path.join(out, "bench_full.log"))
+    if fb:
+        print(f"full bench     {fb.get('metric')}={fb.get('value')} "
+              f"{fb.get('unit')} vs_baseline={fb.get('vs_baseline')} "
+              f"mfu={fb.get('mfu')} rmse_ref_delta="
+              f"{fb.get('als_rmse_ref_delta')} "
+              f"[platform={fb.get('platform')} "
+              f"degraded={fb.get('degraded')}]")
+
+    # bf16 exchange quality at full scale (r05 extra step)
+    bq = last_json(os.path.join(out, "als_bf16_quality.log"))
+    if bq:
+        print(f"bf16 exchange  rmse_ref_delta={bq.get('als_rmse_ref_delta')} "
+              f"value={bq.get('value')} s/iter "
+              f"(CPU-measured quality: -5.4e-6 @5M, +3.1e-6 @20M — "
+              f"BASELINE.md)")
+
+    for probe in ("gather_probe_small", "gather_probe_ml20m",
+                  "gather_tile16", "gather_tile32", "svm_probe"):
+        p = os.path.join(out, probe + ".log")
+        if os.path.exists(p):
+            tail = open(p, errors="replace").read().splitlines()[-3:]
+            print(f"-- {probe}: " + " | ".join(t.strip() for t in tail))
+
+
+if __name__ == "__main__":
+    main()
