@@ -318,6 +318,10 @@ def run_worker(params: Params) -> ServingJob:
         host=params.get("host", "0.0.0.0"),
         port=params.get_int("port", 0),
         job_id=params.get("jobId", f"worker-{worker_index}"),
+        # the C++ epoll plane per shard (requires --stateBackend rocksdb):
+        # point lookups and catalog-scored TOPKV straight from each
+        # worker's persistent store slice
+        native_server=params.get_bool("nativeServer", False),
     ).start()
     print(
         f"[serve:sharded] worker {worker_index}/{num_workers} "

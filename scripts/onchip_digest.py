@@ -76,7 +76,7 @@ def main():
               f"({min(sb, sp) / max(sb, sp):.2f}x)")
         host = 0.339  # BASELINE.md "RCV1 ... Gram inner loop" host-r3 row
         best = min(sb, sp)
-        print(f"  -> vs the host 0.339 s/round (BASELINE.md host-r3 row — "
+        print(f"  -> vs the host {host} s/round (BASELINE.md host-r3 row — "
               f"re-check that row before trusting): {host / best:.2f}")
 
     # full bench: headline + quality anchor

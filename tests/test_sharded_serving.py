@@ -27,58 +27,71 @@ def _spawn_worker(tmp_path, idx, extra=()):
     if port_file.exists():
         port_file.unlink()
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "flink_ms_tpu.serve.sharded",
-         "--workerIndex", str(idx), "--numWorkers", str(N_WORKERS),
-         "--journalDir", str(tmp_path / "bus"), "--topic", "models",
-         "--stateBackend", "fs",
-         "--checkpointDataUri", str(tmp_path / "chk"),
-         "--checkPointInterval", "200",
-         "--host", "127.0.0.1", "--port", "0",
-         "--portFile", str(port_file), *extra],
-        env=env, cwd=REPO,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-    )
+    # worker output goes to a file so a startup death is diagnosable
+    # (e.g. --nativeServer on a box without the native build raises a
+    # deliberate ValueError that DEVNULL would swallow)
+    log_path = tmp_path / f"worker-{idx}.log"
+    log_fh = open(log_path, "wb")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "flink_ms_tpu.serve.sharded",
+             "--workerIndex", str(idx), "--numWorkers", str(N_WORKERS),
+             "--journalDir", str(tmp_path / "bus"), "--topic", "models",
+             "--stateBackend", "fs",
+             "--checkpointDataUri", str(tmp_path / "chk"),
+             "--checkPointInterval", "200",
+             "--host", "127.0.0.1", "--port", "0",
+             "--portFile", str(port_file), *extra],
+            env=env, cwd=REPO,
+            stdout=log_fh, stderr=subprocess.STDOUT,
+        )
+    finally:
+        log_fh.close()
     deadline = time.time() + 60
     while time.time() < deadline:
         if port_file.exists() and port_file.stat().st_size > 0:
             with open(port_file) as f:
                 return proc, json.load(f)["port"]
         if proc.poll() is not None:
-            raise RuntimeError(f"worker {idx} died rc={proc.returncode}")
+            raise RuntimeError(
+                f"worker {idx} died rc={proc.returncode}:\n"
+                + log_path.read_text(errors="replace")[-800:]
+            )
         time.sleep(0.05)
     proc.kill()
     raise RuntimeError(f"worker {idx} never published its port")
 
 
-@pytest.fixture
-def cluster(tmp_path):
+def _seed_and_spawn(tmp_path, seed, extra=()):
+    """Seed the journal with a small ALS model and spawn N workers —
+    shared by the Python-plane cluster fixture and the native-plane
+    test, which differ only in rng seed and worker flags."""
     journal = Journal(str(tmp_path / "bus"), "models")
     k = 4
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     uf = rng.normal(size=(20, k))
     itf = rng.normal(size=(30, k))
     rows = [F.format_als_row(u, "U", uf[u]) for u in range(20)]
     rows += [F.format_als_row(i, "I", itf[i]) for i in range(30)]
     journal.append(rows)
+    procs, ports = [], []
+    for idx in range(N_WORKERS):
+        proc, port = _spawn_worker(tmp_path, idx, extra)
+        procs.append(proc)
+        ports.append(port)
+    return journal, procs, ports, uf, itf
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from flink_ms_tpu.serve.sharded import stop_worker_procs
 
     procs = []
-    ports = []
     try:
-        for idx in range(N_WORKERS):
-            proc, port = _spawn_worker(tmp_path, idx)
-            procs.append(proc)
-            ports.append(port)
+        journal, procs, ports, uf, itf = _seed_and_spawn(tmp_path, 0)
         yield journal, procs, ports, uf, itf, tmp_path
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        stop_worker_procs(procs)
 
 
 def _wait_keys(client, keys, timeout=30):
@@ -201,3 +214,39 @@ def test_sharded_ingest_filter_counts():
             kept += 1
             assert owner_of(parsed[0], N_WORKERS) == 1
     assert 0 < kept < 40
+
+
+def test_native_worker_cluster_serves_and_fans_out(tmp_path):
+    """--nativeServer true per shard (round 5): the C++ epoll plane over
+    each worker's rocksdb slice answers the same routing, MGET, and
+    TOPKV-fan-out contract as the Python-plane cluster."""
+    from flink_ms_tpu.serve.sharded import stop_worker_procs
+
+    procs = []
+    try:
+        _journal, procs, ports, uf, itf = _seed_and_spawn(
+            tmp_path, 1,
+            extra=("--stateBackend", "rocksdb", "--nativeServer", "true"),
+        )
+        with ShardedQueryClient(
+            [("127.0.0.1", p) for p in ports], timeout_s=30
+        ) as client:
+            assert _wait_keys(
+                client,
+                [f"{u}-U" for u in range(20)] + [f"{i}-I" for i in range(30)],
+            )
+            # hash routing + batched MGET through the C++ plane
+            got = client.query_states(
+                "ALS_MODEL", ["3-U", "17-I", "nope-U"])
+            assert got[0] is not None and got[1] is not None
+            assert got[2] is None
+            # catalog-scored TOPKV fan-out + merge across native workers
+            got_topk = client.topk("ALS_MODEL", "7", 5)
+            scores = itf @ uf[7]
+            best = np.argsort(-scores)[:5]
+            assert [item for item, _ in got_topk] == [str(i) for i in best]
+            np.testing.assert_allclose(
+                [s for _, s in got_topk], scores[best], rtol=1e-5
+            )
+    finally:
+        stop_worker_procs(procs)
