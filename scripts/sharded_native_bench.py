@@ -1,0 +1,65 @@
+import os, sys, time, json, subprocess, tempfile
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, "/root/repo")
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.serve.journal import Journal
+from flink_ms_tpu.serve.sharded import ShardedQueryClient, stop_worker_procs
+
+tmp = tempfile.mkdtemp()
+n_items, n_users, k, W = 300_000, 1000, 16, 3
+rng = np.random.default_rng(0)
+vals = rng.normal(size=(n_items + n_users, k)).astype(np.float32)
+j = Journal(tmp + "/bus", "models")
+rows = [F.format_als_row(i + 1, "I", vals[i]) for i in range(n_items)]
+rows += [F.format_als_row(u + 1, "U", vals[n_items + u]) for u in range(n_users)]
+j.append(rows, flush=True)
+print("seeded", flush=True)
+
+procs, ports = [], []
+env = {**os.environ, "PYTHONPATH": "/root/repo"}
+for idx in range(W):
+    pf = f"{tmp}/port-{idx}.json"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "flink_ms_tpu.serve.sharded",
+         "--workerIndex", str(idx), "--numWorkers", str(W),
+         "--journalDir", tmp + "/bus", "--topic", "models",
+         "--stateBackend", "rocksdb", "--nativeServer", "true",
+         "--checkpointDataUri", f"{tmp}/chk",
+         "--host", "127.0.0.1", "--port", "0", "--portFile", pf],
+        env=env, cwd="/root/repo",
+        stdout=open(f"{tmp}/w{idx}.log", "wb"), stderr=subprocess.STDOUT))
+try:
+    for idx in range(W):
+        pf = f"{tmp}/port-{idx}.json"
+        for _ in range(1200):
+            if os.path.exists(pf) and os.path.getsize(pf) > 0:
+                ports.append(json.load(open(pf))["port"]); break
+            if procs[idx].poll() is not None:
+                raise RuntimeError(open(f"{tmp}/w{idx}.log", errors="replace").read()[-500:])
+            time.sleep(0.1)
+    with ShardedQueryClient([("127.0.0.1", p) for p in ports], timeout_s=600) as c:
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if c.query_state("ALS_MODEL", f"{n_items}-I") is not None and \
+               c.query_state("ALS_MODEL", "1-U") is not None:
+                break
+            time.sleep(0.5)
+        c.topk("ALS_MODEL", "1", 10)  # index builds
+        mg, tk = [], []
+        for q in range(200):
+            u = int(rng.integers(1, n_users + 1)); i = int(rng.integers(1, n_items + 1))
+            t0 = time.perf_counter()
+            c.query_states("ALS_MODEL", [f"{u}-U", f"{i}-I"])
+            mg.append((time.perf_counter() - t0) * 1e3)
+        for q in range(60):
+            u = int(rng.integers(1, n_users + 1))
+            t0 = time.perf_counter()
+            c.topk("ALS_MODEL", str(u), 10)
+            tk.append((time.perf_counter() - t0) * 1e3)
+        mg.sort(); tk.sort()
+        print(f"sharded-native({W} workers, {n_items} items): "
+              f"MGET p50 {mg[99]:.3f} p95 {mg[189]:.3f} ms, "
+              f"TOPK p50 {tk[29]:.3f} p95 {tk[56]:.3f} ms")
+finally:
+    stop_worker_procs(procs)
