@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Compile-level chip probe: distinguishes a truly usable tunnel from the
+wedge state where the relay answers `jax.devices()` but every remote
+compile hangs (observed all of round 4 and twice in round 5 — the
+onchip_r04.sh sanity probe passed in that state and the plan then burned
+its full sequential timeout budget against a dead compiler).
+
+Exit codes:
+  0  chip answered AND a tiny jit compile+execute completed
+  2  devices listed but platform is cpu (degraded / no tunnel)
+  3  backend init or compile raised
+  (a HANG is handled by the caller's `timeout` -> rc 124)
+
+Prints one line: `compile-ok <platform> <secs>` on success.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from flink_ms_tpu.parallel.mesh import honor_platform_env
+
+    honor_platform_env()
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.monotonic()
+    try:
+        d = jax.devices()[0]
+        if d.platform == "cpu":
+            print(f"devices-cpu {d}")
+            return 2
+        out = jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128)))
+        jax.block_until_ready(out)
+    except Exception as e:  # noqa: BLE001 - probe reports, caller decides
+        print(f"compile-raise {type(e).__name__}: {str(e)[:200]}")
+        return 3
+    print(f"compile-ok {d.platform} {time.monotonic() - t0:.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,14 +25,12 @@ run_step() { # name timeout_s cmd...
   return $rc
 }
 
-# 0. sanity probe: is the chip actually answering?
-run_step probe 180 python -c "
-from flink_ms_tpu.parallel.mesh import honor_platform_env
-honor_platform_env()
-import jax; d = jax.devices()[0]
-assert d.platform != 'cpu', d
-print('chip:', d, d.device_kind)
-" || { log "chip not answering — abort"; exit 1; }
+# 0. sanity probe: is the chip actually COMPILING?  A devices() listing
+#    passes in the observed wedge state (relay up, remote compiles hang),
+#    which once burned this plan's whole sequential timeout budget — the
+#    probe must round-trip a real jit compile+execute.
+run_step probe 240 python scripts/compile_probe.py \
+  || { log "chip not compiling — abort"; exit 1; }
 
 # 1. fused gather+contract probe (decides FLINK_MS_ALS_ASSEMBLY):
 #    ML-20M user-half-sweep shape (item table 12k->27k rows, k=64)

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Round-5 recovery watcher: loop a COMPILE-level probe (scripts/
+# compile_probe.py — a devices() listing is not evidence, see the r4/r5
+# wedge) and launch the queued measurement plan (scripts/onchip_r05.sh)
+# the moment a real jit round-trips.  One plan launch per watcher life;
+# the digest (scripts/onchip_digest.py) is left to the operator so a
+# short window is spent measuring.
+#
+# Usage: nohup bash scripts/onchip_watch_r05.sh &   (log: $LOG)
+LOG="${LOG:-scripts/onchip_watch_r05.log}"
+DEADLINE_S="${DEADLINE_S:-36000}"   # 10h
+SLEEP_S="${SLEEP_S:-240}"
+HANG_SLEEP_S="${HANG_SLEEP_S:-900}" # a hung probe IS the wedge signature —
+                                    # back off so a long outage costs one
+                                    # 240s hang per window, not per loop
+                                    # (mirrors chip_probe.sh's policy)
+start=$(date +%s)
+cd "$(dirname "$0")/.."
+echo "$(date +%H:%M:%S) watcher up (compile-level probe)" >> "$LOG"
+while :; do
+  now=$(date +%s)
+  if (( now - start > DEADLINE_S )); then
+    echo "$(date +%H:%M:%S) deadline — compiles never recovered" >> "$LOG"
+    exit 1
+  fi
+  out=$(timeout 240 python scripts/compile_probe.py 2>/dev/null)
+  rc=$?
+  out=${out##*$'\n'}
+  if [ "$rc" -eq 0 ]; then
+    echo "$(date +%H:%M:%S) COMPILES OK ($out) — launching onchip_r05" >> "$LOG"
+    bash scripts/onchip_r05.sh scripts/onchip_r05 \
+      > scripts/onchip_r05_driver.log 2>&1
+    echo "$(date +%H:%M:%S) plan finished rc=$? — run scripts/onchip_digest.py" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) not ready (rc=$rc ${out:-hang})" >> "$LOG"
+  if [ "$rc" -eq 124 ]; then
+    sleep "$HANG_SLEEP_S"
+  else
+    sleep "$SLEEP_S"
+  fi
+done
