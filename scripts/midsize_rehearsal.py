@@ -260,19 +260,20 @@ def main() -> int:
             csv = os.path.join(mp_dir, "ratings.csv")
             F.write_ratings(csv, users, items, ratings)
 
-            def _run_pair(tag, argv_for, extra_env=None):
-                """Launch a 2-process CLI pair over a fresh coordinator
+            def _run_group(tag, argv_for, extra_env=None, n_procs=2,
+                           dev_per_proc=4):
+                """Launch an n-process CLI group over a fresh coordinator
                 port.  stdout goes to FILES, not pipes: sequentially
-                draining two piped children deadlocks if the second fills
-                its 64 KB pipe mid-collective while we wait on the first.
-                A hung/failed pair must not orphan its sibling while the
-                cleanup below deletes its working dir."""
+                draining piped children deadlocks if a later one fills
+                its 64 KB pipe mid-collective while we wait on an earlier
+                one.  A hung/failed member must not orphan its siblings
+                while the cleanup below deletes its working dir."""
                 with _socket.socket() as s:
                     s.bind(("127.0.0.1", 0))
                     port = s.getsockname()[1]
                 procs, handles, logs = [], [], []
                 try:
-                    for pid in (0, 1):
+                    for pid in range(n_procs):
                         log_path = os.path.join(mp_dir, f"{tag}-p{pid}.log")
                         logs.append(log_path)
                         fh = open(log_path, "wb")
@@ -281,7 +282,8 @@ def main() -> int:
                             argv_for(pid, port),
                             env={**os.environ, "JAX_PLATFORMS": "cpu",
                                  "XLA_FLAGS":
-                                 "--xla_force_host_platform_device_count=4",
+                                 "--xla_force_host_platform_device_count="
+                                 f"{dev_per_proc}",
                                  **(extra_env or {})},
                             cwd=repo_root, stdout=fh,
                             stderr=subprocess.STDOUT))
@@ -321,7 +323,7 @@ def main() -> int:
             _routed = {"FLINK_MS_ALS_EXCHANGE_MODE": "routed"}
 
             t0 = time.time()
-            rcs_a, outs_a = _run_pair("runA", _als_argv(2, "runA"),
+            rcs_a, outs_a = _run_group("runA", _als_argv(2, "runA"),
                                       _routed)  # "crash" after 2 iters
             wall_a = round(time.time() - t0, 1)
             ok &= check("mp_als_2proc_crash_run_exits_zero",
@@ -330,7 +332,7 @@ def main() -> int:
             stage0 = os.path.join(mp_dir, "stage0")
             pre = sorted(os.listdir(stage0)) if os.path.isdir(stage0) else []
             t0 = time.time()
-            rcs_b, outs_b = _run_pair("runB", _als_argv(4, "runB"),
+            rcs_b, outs_b = _run_group("runB", _als_argv(4, "runB"),
                                       _routed)  # new run resumes
             wall_b = round(time.time() - t0, 1)
             ok &= check("mp_als_resume_run_exits_zero", rcs_b == [0, 0],
@@ -397,7 +399,7 @@ def main() -> int:
                         "--output", os.path.join(mp_dir, f"svm-w{pid}")]
 
             t0 = time.time()
-            sv_rcs, sv_outs = _run_pair("svm", _svm_argv)
+            sv_rcs, sv_outs = _run_group("svm", _svm_argv)
             wall_svm = round(time.time() - t0, 1)
             ok &= check("mp_svm_2proc_exits_zero", sv_rcs == [0, 0],
                         wall_s=wall_svm,
@@ -422,6 +424,56 @@ def main() -> int:
             else:
                 ok &= check("mp_svm_matches_inprocess_fit", False,
                             skipped="svm pair failed")
+
+            # N>2 process group (VERDICT r4 held the comm cell at
+            # "partial — never exercised beyond 2 procs"): 4 procs x
+            # 2 devices over gloo — same 8 global devices, so the
+            # blocked layout and the in-process reference fit are
+            # unchanged; what varies is process count, per-process
+            # addressable shards, and the routed exchange now crossing
+            # three process boundaries.
+            def _als4_argv(pid, port):
+                out_dir = os.path.join(mp_dir, f"run4-p{pid}")
+                return [sys.executable, "-m",
+                        "flink_ms_tpu.train.als_train",
+                        "--input", csv, "--ignoreFirstLine", "false",
+                        "--iterations", "2",
+                        "--numFactors", str(k), "--lambda", "0.1",
+                        "--coordinatorAddress", f"127.0.0.1:{port}",
+                        "--numProcesses", "4", "--processId", str(pid),
+                        "--userFactors", os.path.join(out_dir, "uf"),
+                        "--itemFactors", os.path.join(out_dir, "itf")]
+
+            t0 = time.time()
+            rcs4, outs4 = _run_group("run4", _als4_argv, _routed,
+                                     n_procs=4, dev_per_proc=2)
+            wall4 = round(time.time() - t0, 1)
+            ok &= check("mp_als_4proc_exits_zero", rcs4 == [0] * 4,
+                        wall_s=wall4,
+                        tail="" if rcs4 == [0] * 4 else outs4[0][-400:])
+            if rcs4 == [0] * 4:
+                cfg2_cli = ALSConfig(num_factors=k, iterations=2,
+                                     lambda_=0.1)
+                ref2 = als_fit(users, items, ratings, cfg2_cli, mesh,
+                               problem=problem)
+                ids, kinds, rows = F.read_als_model(
+                    os.path.join(mp_dir, "run4-p0", "uf"))
+                got = {int(i): r for i, kk, r in zip(ids, kinds, rows)}
+                nan_row = np.full(k, np.nan)
+                match4 = len(got) == len(ref2.user_ids) and all(
+                    np.allclose(got.get(int(uid), nan_row), row,
+                                rtol=1e-4, atol=1e-5)
+                    for uid, row in zip(ref2.user_ids, ref2.user_factors))
+                ok &= check("mp_als_4proc_matches_inprocess_fit", match4,
+                            users=len(got))
+            else:
+                ok &= check("mp_als_4proc_matches_inprocess_fit", False,
+                            skipped="4-proc run failed")
+            ART["multiproc"]["als_4proc_2dev_2it_s"] = wall4
+            ART["multiproc"]["groups"] = [
+                {"processes": 2, "devices_per_process": 4},
+                {"processes": 4, "devices_per_process": 2},
+            ]
         except Exception as e:
             # a crashed harness must still land its earlier checks in the
             # artifact (ok=false), not lose them to an unhandled traceback
