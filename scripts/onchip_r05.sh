@@ -1,14 +1,16 @@
 #!/usr/bin/env bash
-# Round-5 on-chip measurement plan — run at first tunnel recovery.
+# Round-5 on-chip measurement plan — run at first tunnel recovery
+# (scripts/onchip_watch_r05.sh launches it on the first successful
+# COMPILE-level probe).
 #
-# The tunnel was wedged for ALL of round 4 and (so far) round 5, so the
-# r4 queue (scripts/onchip_r04.sh: fused-assembly probe + A/B, SVM
-# boundary-kernel probe + A/B, full bench last to warm the driver's
-# compile cache) is still the unmeasured backlog — run it verbatim, then
-# add the one A/B lost to the round-3 wedge: bf16 factor exchange at the
-# full ML-20M scale, judged on als_rmse_ref_delta (the kernel default
-# stays f32 unless the quality delta is clean; chip timing said +20%
-# throughput at the 5M probe, BASELINE.md solver matrix).
+# Ordered for a SHORT window, not for decision flow: after four rounds
+# with zero driver-witnessed chip numbers, the single most valuable
+# artifact is a full bench at the headline config under the known-good
+# r3 defaults — so that runs FIRST (also warming the driver's compile
+# cache), and the r4 kernel-decision backlog (probes, A/Bs, bf16 step)
+# follows in value order.  Every step runs in its own subprocess under
+# `timeout`; steps are strictly sequential (concurrent compiles through
+# the tunnel are the one observed wedge trigger).
 #
 # Usage: bash scripts/onchip_r05.sh [outdir]   (default scripts/onchip_r05)
 set -u
@@ -17,16 +19,72 @@ OUT="${1:-scripts/onchip_r05}"
 mkdir -p "$OUT"
 log() { echo "[onchip_r05 $(date +%H:%M:%S)] $*"; }
 
-bash scripts/onchip_r04.sh "$OUT"
-rc=$?
-if [ $rc -ne 0 ]; then
-  log "r4 backlog aborted (rc=$rc) — not queueing the bf16 quality A/B"
-  exit $rc
+run_step() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  log "step $name (timeout ${t}s): $*"
+  timeout "$t" "$@" >"$OUT/$name.log" 2>&1
+  local rc=$?
+  log "step $name rc=$rc"
+  tail -20 "$OUT/$name.log"
+  return $rc
+}
+
+# 0. sanity: the chip must answer a real jit compile (a devices() listing
+#    passes in the observed wedge state — see scripts/compile_probe.py)
+run_step probe 240 python scripts/compile_probe.py \
+  || { log "chip not compiling — abort"; exit 1; }
+
+# 1. FULL bench, known-good defaults (pallas solver, bf16 exchange,
+#    auto assembly->xla): banks the headline chip artifact this round has
+#    never had, and warms the persistent compile cache for the driver's
+#    end-of-round run.
+run_step bench_full 3000 python bench.py
+cp -f BENCH_DETAIL.json "$OUT/bench_full.detail.json" 2>/dev/null || true
+
+# 2. fused gather+contract probe (decides FLINK_MS_ALS_ASSEMBLY):
+#    ML-20M user-half-sweep shape (item table 12k->27k rows, k=64)
+run_step gather_probe_small 600 python scripts/gather_kernel_probe.py \
+  --nnz 5000000 --w 128 --table 12000 --k 64
+probe_rc=$?
+run_step gather_probe_ml20m 600 python scripts/gather_kernel_probe.py \
+  --nnz 5000000 --w 128 --table 27000 --k 64
+# row-tile sweep on the winning shape (only if the probe step SUCCEEDED
+# and the kernel compiled — a timeout/crash leaves no FAILED marker but
+# must not trigger 20 more minutes of sweeps against a wedged chip)
+if [ "$probe_rc" -eq 0 ] && ! grep -q FAILED "$OUT/gather_probe_small.log"; then
+  run_step gather_tile16 600 python scripts/gather_kernel_probe.py \
+    --nnz 5000000 --w 128 --table 12000 --k 64 --row-tile 16
+  run_step gather_tile32 600 python scripts/gather_kernel_probe.py \
+    --nnz 5000000 --w 128 --table 12000 --k 64 --row-tile 32
 fi
 
-log "bf16 exchange quality A/B at ML-20M scale (lost to the r3 wedge)"
-timeout 2400 env BENCH_SECTIONS=als BENCH_ALS_EXCHANGE=bf16 \
-  BENCH_SKIP_CPU=1 python bench.py --sections-json als \
-  >"$OUT/als_bf16_quality.log" 2>&1
-log "bf16 step rc=$? — compare als_rmse_ref_delta vs the f32 run in"
-log "$OUT/bench_full.detail.json before flipping any default"
+# 3. ALS assembly A/B at the 5M-nnz probe config (the r3 solver-matrix
+#    config): xla vs pallas assembly under the pallas solver
+run_step als_ab_xla 900 env BENCH_SECTIONS=als BENCH_NNZ=5000000 \
+  BENCH_USERS=60000 BENCH_ITEMS=12000 BENCH_RANK=50 BENCH_SKIP_CPU=1 \
+  BENCH_SKIP_QUALITY=1 BENCH_ALS_BF16_AB=0 FLINK_MS_ALS_ASSEMBLY=xla \
+  python bench.py --sections-json als
+run_step als_ab_pallas 900 env BENCH_SECTIONS=als BENCH_NNZ=5000000 \
+  BENCH_USERS=60000 BENCH_ITEMS=12000 BENCH_RANK=50 BENCH_SKIP_CPU=1 \
+  BENCH_SKIP_QUALITY=1 BENCH_ALS_BF16_AB=0 FLINK_MS_ALS_ASSEMBLY=pallas \
+  python bench.py --sections-json als
+
+# 4. SVM boundary probe (decides FLINK_MS_SVM_WX0 / FLINK_MS_SVM_DW)
+#    + the per-device boundary-shrink table at nnz/D
+run_step svm_probe 600 python scripts/svm_kernel_probe.py --nnz 49000000
+
+# 5. SVM round A/B at RCV1 scale: production path vs pallas boundary
+run_step svm_ab_base 1200 env BENCH_SECTIONS=svm BENCH_SKIP_CPU=1 \
+  python bench.py --sections-json svm
+run_step svm_ab_pallas 1200 env BENCH_SECTIONS=svm BENCH_SKIP_CPU=1 \
+  FLINK_MS_SVM_WX0=pallas FLINK_MS_SVM_DW=pallas \
+  python bench.py --sections-json svm
+
+# 6. bf16 exchange quality+timing A/B at ML-20M scale (lost to the r3
+#    wedge; quality already pinned device-independently on the host —
+#    BASELINE.md — so this re-witnesses in-artifact and times it)
+run_step als_bf16_quality 2400 env BENCH_SECTIONS=als \
+  BENCH_ALS_EXCHANGE=bf16 BENCH_SKIP_CPU=1 \
+  python bench.py --sections-json als
+
+log "done — run: python scripts/onchip_digest.py $OUT"
