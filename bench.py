@@ -838,6 +838,7 @@ _COMPACT_KEYS = (
     "als_rmse_at_iters", "als_rmse_ref_delta",
     "svm_rcv1_sec_per_round", "svm_rcv1_vs_baseline", "svm_secs_to_target",
     "serving_mget_p50_ms", "serving_topk_p50_ms", "serving_shard_mget_p50_ms",
+    "serving_topk_batched_c64_qps", "serving_topk_batched_speedup_c64",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -850,7 +851,10 @@ def emit_artifact(result: dict, sidecar: bool = True) -> str:
     write — the watchdog thread emits snapshots while the main thread may
     be mid-emit itself, and two writers would interleave in the file."""
     if not sidecar:
-        result.setdefault("detail", os.path.basename(_DETAIL_PATH))
+        # a snapshot emission writes no sidecar — claiming the detail file
+        # here would point the driver at stale (or absent) contents from a
+        # previous run (r5 advisor); null says "no sidecar for this line"
+        result.setdefault("detail", None)
     else:
         try:
             with open(_DETAIL_PATH, "w") as f:
@@ -920,16 +924,31 @@ def _install_sigterm_emitter(real_stdout) -> None:
                 "metric": "als_ml20m_sec_per_iter", "value": None,
                 "unit": "s/iter", "vs_baseline": None, "terminated": True,
             })
+        # serialize against a watchdog snapshot mid-print — but only
+        # try-acquire: the handler may be interrupting the very thread
+        # that holds the lock, and blocking here would deadlock a dying
+        # process (r5 advisor).  Either way set _ARTIFACT_PRINTED BEFORE
+        # printing so a watchdog wake-up between our print and _exit
+        # cannot emit a snapshot AFTER the terminal line (last-line-wins).
+        lock, printed = _PRINT_LOCK, _ARTIFACT_PRINTED
+        acquired = lock.acquire(blocking=False) if lock is not None else False
         try:
-            print(line, file=real_stdout, flush=True)
-        except Exception:  # reentrant buffered-IO write mid-print: the
-            # raw fd write cannot collide with the buffered layer
+            if printed is not None:
+                printed.set()
             try:
-                # leading newline: the interrupted print may have flushed
-                # a partial line; never concatenate onto it
-                os.write(real_stdout.fileno(), ("\n" + line + "\n").encode())
-            except Exception:
-                pass
+                print(line, file=real_stdout, flush=True)
+            except Exception:  # reentrant buffered-IO write mid-print: the
+                # raw fd write cannot collide with the buffered layer
+                try:
+                    # leading newline: the interrupted print may have
+                    # flushed a partial line; never concatenate onto it
+                    os.write(real_stdout.fileno(),
+                             ("\n" + line + "\n").encode())
+                except Exception:
+                    pass
+        finally:
+            if acquired:
+                lock.release()
         os._exit(124)
 
     try:

@@ -529,6 +529,189 @@ def run_svm_serving_section(small: bool) -> dict:
 # serving section: generator -> producer -> consumer -> latency harnesses
 # ---------------------------------------------------------------------------
 
+def _topk_closed_loop(port, state, n_users, k, concurrency, total_queries,
+                      seed):
+    """`total_queries` TOPKs spread over `concurrency` closed-loop client
+    threads (one persistent connection each) -> (qps, pcts dict).  The
+    clock runs from a start barrier to the last reply, so qps includes
+    queueing — exactly what a loaded serving plane's caller sees."""
+    import threading
+
+    from flink_ms_tpu.serve.client import QueryClient
+
+    per_thread = max(total_queries // concurrency, 1)
+    lat_ms = [[] for _ in range(concurrency)]
+    errors = []
+    barrier = threading.Barrier(concurrency + 1)
+
+    def worker(widx):
+        rng = np.random.default_rng(seed + widx)
+        try:
+            with QueryClient("127.0.0.1", port, timeout_s=600) as c:
+                c.ping()  # connection + handler thread up before the clock
+                barrier.wait()
+                for _ in range(per_thread):
+                    uid = int(rng.integers(1, n_users + 1))
+                    t0 = time.perf_counter()
+                    # raw round trip: reply PARSING is caller-side cost,
+                    # not serving cost, and it would water down the
+                    # batched-vs-unbatched ratio equally in both arms
+                    r = c._roundtrip(f"TOPK\t{state}\t{uid}\t{k}")
+                    lat_ms[widx].append((time.perf_counter() - t0) * 1000.0)
+                    if not r or r[0] not in "VN":
+                        raise RuntimeError(f"bad topk reply: {r!r}")
+        except Exception as e:  # pragma: no cover - surfaced via errors
+            errors.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    flat = [x for lane in lat_ms for x in lane]
+    return round(len(flat) / elapsed, 1), _pcts(flat)
+
+
+def _topk_pipelined_loop(port, state, n_users, k, window, total_queries,
+                         seed):
+    """`total_queries` TOPKs down ONE connection with `window` requests in
+    flight (the PR's pipelined client) -> qps.  The server's burst framing
+    reads the in-flight window in one sweep and the microbatcher coalesces
+    it into shared dispatches — this is the co-designed data plane, vs the
+    thread-per-connection strict request/reply loop."""
+    from flink_ms_tpu.serve.client import QueryClient
+
+    rng = np.random.default_rng(seed)
+    reqs = [
+        f"TOPK\t{state}\t{int(rng.integers(1, n_users + 1))}\t{k}"
+        for _ in range(total_queries)
+    ]
+    with QueryClient("127.0.0.1", port, timeout_s=600) as c:
+        c.ping()
+        t0 = time.perf_counter()
+        replies = c.pipeline(reqs, window=window)
+        elapsed = time.perf_counter() - t0
+    bad = [r for r in replies if not r or r[0] not in "VN"]
+    if bad:
+        raise RuntimeError(f"bad topk replies: {bad[:3]!r}")
+    return round(len(replies) / elapsed, 1)
+
+
+def run_topk_batched_subsection(job, state, n_users, k, small: bool) -> dict:
+    """Cross-request microbatching A/B on the live (warm) serving job,
+    same catalog and same index for every cell (the handler's
+    ``batching`` flag is flipped in-process, so the arms share every
+    other cost).  Two client modes x two arms:
+
+    - threads mode: N closed-loop connections, strict request/reply —
+      the pre-PR data plane.  Reports qps + p50/p95/p99 per arm at
+      concurrency 1/8/64.  Batching here converts the index-lock convoy
+      into orderly dispatches (tails drop) but one core still runs N
+      client threads, so the qps gap understates the device-side win.
+    - pipelined mode: ONE connection with `conc` requests in flight (the
+      PR's pipelined client + server burst framing).  The in-flight
+      window coalesces into shared dispatches — this is the co-designed
+      path and the throughput headline.
+
+    ``serving_topk_batched_speedup_c64`` is the full-stack ratio: the
+    batched pipelined plane over the unbatched thread-per-request plane
+    at 64 in-flight requests (the pre-PR serving plane had neither
+    batching nor pipelining).  Same-client-mode ratios are also emitted
+    (``..._threads_speedup_c*`` / ``..._pipe_speedup_c*``) so no cell of
+    the matrix is hidden."""
+    out = {}
+    handler = job.server.topk_handlers.get(state)
+    if handler is None or getattr(handler, "batcher", None) is None:
+        out["serving_topk_batched_error"] = "no batching handler on job"
+        return out
+    total = int(os.environ.get(
+        "BENCH_SERVE_TOPKB_QUERIES", 128 if small else 512))
+    concurrencies = (1, 8, 64)
+    pipe_windows = (8, 64)
+    was_batching = handler.batching
+    # one-time cost per process, paid up front: compile every padded
+    # batch-shape bucket before the clock (a compile landing inside a
+    # live dispatch charges tens of ms to every request in that batch)
+    handler.index.warm_batch_shapes(k, handler.batcher.max_batch)
+    try:
+        for arm in ("unbatched", "batched"):
+            handler.batching = arm == "batched"
+            # steady-state warm-up in both client modes (dispatcher
+            # thread, handler threads, socket buffers)
+            _topk_closed_loop(
+                job.port, state, n_users, k, max(concurrencies),
+                4 * max(concurrencies), seed=3)
+            _topk_pipelined_loop(
+                job.port, state, n_users, k, max(pipe_windows),
+                4 * max(pipe_windows), seed=4)
+            # the batched threads-mode cells carry an explicit _threads_
+            # tag; bare serving_topk_batched_c64_qps is reserved for the
+            # headline (the pipelined cell) below
+            prefix = (f"serving_topk_{arm}" if arm == "unbatched"
+                      else f"serving_topk_{arm}_threads")
+            for conc in concurrencies:
+                qps, pcts = _topk_closed_loop(
+                    job.port, state, n_users, k, conc,
+                    max(total, conc * 2), seed=7 + conc)
+                out[f"{prefix}_c{conc}_qps"] = qps
+                out.update({
+                    f"{prefix}_c{conc}_{q}_ms": v
+                    for q, v in pcts.items()
+                })
+                _log(f"[bench:serve] topk {arm} threads c{conc}: {qps} "
+                     f"qps, {pcts} ms")
+            for win in pipe_windows:
+                qps = _topk_pipelined_loop(
+                    job.port, state, n_users, k, win,
+                    max(2 * total, win * 4), seed=17 + win)
+                out[f"serving_topk_{arm}_pipe_c{win}_qps"] = qps
+                _log(f"[bench:serve] topk {arm} pipelined c{win}: "
+                     f"{qps} qps")
+    finally:
+        handler.batching = was_batching
+    for conc in concurrencies:
+        ub = out.get(f"serving_topk_unbatched_c{conc}_qps")
+        b = out.get(f"serving_topk_batched_threads_c{conc}_qps")
+        if ub and b:
+            out[f"serving_topk_threads_speedup_c{conc}"] = round(b / ub, 2)
+    for win in pipe_windows:
+        ub = out.get(f"serving_topk_unbatched_pipe_c{win}_qps")
+        b = out.get(f"serving_topk_batched_pipe_c{win}_qps")
+        if ub and b:
+            out[f"serving_topk_pipe_speedup_c{win}"] = round(b / ub, 2)
+    # the headline: co-designed plane (pipelined + batched) vs the pre-PR
+    # plane (thread-per-request, unbatched), both at 64 in flight
+    old = out.get("serving_topk_unbatched_c64_qps")
+    new = out.get("serving_topk_batched_pipe_c64_qps")
+    if old and new:
+        out["serving_topk_batched_c64_qps"] = new
+        out["serving_topk_batched_speedup_c64"] = round(new / old, 2)
+    # lone-request cost of batching: bounded by the coalescing window
+    # (the idle fast path should keep it near zero)
+    ub = out.get("serving_topk_unbatched_c1_p50_ms")
+    b = out.get("serving_topk_batched_threads_c1_p50_ms")
+    if ub is not None and b is not None:
+        out["serving_topk_batched_c1_p50_regression_ms"] = round(b - ub, 3)
+    batcher = handler.batcher
+    out["serving_topk_batch_dispatches"] = batcher.dispatches
+    out["serving_topk_batch_queries"] = batcher.batched_queries
+    out["serving_topk_batch_max_seen"] = batcher.max_batch_seen
+    out["serving_topk_batch_inline"] = batcher.inline_singles
+    return out
+
+
 def run_serving_section(small: bool) -> dict:
     from flink_ms_tpu.client import als_predict_random
     from flink_ms_tpu.core.params import Params
@@ -653,7 +836,16 @@ def run_serving_section(small: bool) -> dict:
         _log(f"[bench:serve] GET {get_p} ms, TOPK {_pcts(tk_ms)} ms "
              f"(build {out['serving_topk_build_s']}s)")
 
-        # 5b. checkpoint/restore wall time at serving scale (the recovery
+        # 5b. cross-request microbatching A/B: qps + p50/p95/p99 at
+        # concurrency 1/8/64 over the same warm index, batched vs unbatched
+        try:
+            out.update(run_topk_batched_subsection(
+                job, ALS_STATE, n_users, topk_k, small))
+        except Exception:
+            _log(traceback.format_exc())
+            out["serving_topk_batched_error"] = traceback.format_exc(limit=3)
+
+        # 5c. checkpoint/restore wall time at serving scale (the recovery
         # path's cost: fixed-delay restart replays snapshot + journal tail)
         try:
             ckpt_dir = os.path.join(tmp, "ckpt")

@@ -47,9 +47,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
-from ..parallel.mesh import BLOCK_AXIS, block_sharding, num_blocks
+from ..parallel.mesh import (
+    BLOCK_AXIS,
+    block_sharding,
+    num_blocks,
+    shard_map,  # version-compat shim (jax.experimental on 0.4.x)
+)
 
 # ---------------------------------------------------------------------------
 # config + host-side problem layout

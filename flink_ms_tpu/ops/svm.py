@@ -50,11 +50,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.formats import SparseData
-from ..parallel.mesh import BLOCK_AXIS, block_sharding, num_blocks
+from ..parallel.mesh import (
+    BLOCK_AXIS,
+    block_sharding,
+    num_blocks,
+    shard_map,  # version-compat shim (jax.experimental on 0.4.x)
+)
 
 
 @dataclasses.dataclass(frozen=True)

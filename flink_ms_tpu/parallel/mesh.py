@@ -18,6 +18,29 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # jax 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+try:
+    import inspect as _inspect
+
+    _SHARD_MAP_PARAMS = frozenset(
+        _inspect.signature(_shard_map_impl).parameters
+    )
+except (TypeError, ValueError):  # signature unavailable: assume modern names
+    _SHARD_MAP_PARAMS = frozenset(("check_vma",))
+
+
+def shard_map(*args, **kwargs):
+    """`jax.shard_map` across jax versions: jax >= 0.6 renamed the
+    replication-check knob `check_rep` -> `check_vma`; kernels here use the
+    modern spelling and this shim translates it for older jax."""
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map_impl(*args, **kwargs)
+
 BLOCK_AXIS = "blocks"
 
 # Platform names whose presence in JAX_PLATFORMS counts as ambient launcher

@@ -111,6 +111,83 @@ class QueryClient:
         missing = [int(b) for b in missing_s.split(",") if b]
         return float(dot_s), missing
 
+    def pipeline(self, requests, window: int = 32) -> list:
+        """Pipelined round trips: keep up to ``window`` requests in flight
+        on this connection before reading replies (the protocol answers
+        one reply line per request, strictly in order, so replies map back
+        positionally).  The server drains a burst of in-flight requests
+        into one read and submits its TOPK/TOPKV members to the
+        microbatcher together — a single pipelining client can therefore
+        fill cross-request batches all by itself, where a strict
+        request/reply client would serialize one dispatch per query.
+
+        No transparent reconnect here (unlike ``_roundtrip``): a broken
+        pipe mid-window leaves an unknown number of requests processed,
+        so the error propagates to the caller."""
+        requests = list(requests)
+        for req in requests:
+            if "\n" in req:
+                raise ValueError("requests must be single lines")
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        if self._sock is None:
+            self._connect()
+        replies, sent = [], 0
+        # refill at a low watermark (half the window) instead of one-for-
+        # one per reply: one-for-one degenerates into lockstep singles —
+        # the server answers its burst, the client trickles requests back
+        # one at a time, and no two requests are ever in the socket buffer
+        # together for the microbatcher to coalesce
+        low = max(1, window // 2)
+        while len(replies) < len(requests):
+            inflight = sent - len(replies)
+            if sent < len(requests) and window - inflight >= low:
+                burst_end = min(len(requests), len(replies) + window)
+                data = "".join(
+                    req + "\n" for req in requests[sent:burst_end]
+                )
+                self._sock.sendall(data.encode("utf-8"))
+                sent = burst_end
+                continue
+            line = self._rfile.readline()
+            if not line:
+                raise ConnectionError(
+                    "lookup server closed the connection mid-pipeline"
+                )
+            replies.append(line.decode("utf-8").rstrip("\n"))
+        return replies
+
+    def topk_pipelined(self, name: str, user_ids, k: int,
+                       window: int = 32) -> list:
+        """Batched device-scored top-k for many users: all queries ride
+        one pipelined window, so the server coalesces them into shared
+        dispatches.  Returns one result list per user id, in order (None
+        per unknown user)."""
+        reqs = []
+        for uid in user_ids:
+            if "\t" in uid or "\n" in uid:
+                raise ValueError("user ids must not contain tabs/newlines")
+            reqs.append(f"TOPK\t{name}\t{uid}\t{k}")
+        return [self._parse_topk_reply(r)
+                for r in self.pipeline(reqs, window)]
+
+    def topk_by_vector_pipelined(self, name: str, factor_payloads, k: int,
+                                 window: int = 32) -> list:
+        """TOPKV over many explicit query vectors in one pipelined window
+        (the sharded fan-out's bulk path).  Returns one result list per
+        payload, in order."""
+        reqs = []
+        for payload in factor_payloads:
+            if "\t" in payload or "\n" in payload:
+                raise ValueError(
+                    "factor payloads must not contain tabs/newlines")
+            reqs.append(f"TOPKV\t{name}\t{k}\t{payload}")
+        out = []
+        for reply in self.pipeline(reqs, window):
+            parsed = self._parse_topk_reply(reply)
+            out.append([] if parsed is None else parsed)
+        return out
+
     def topk(self, name: str, user_id: str, k: int):
         """Device-scored top-k recommendations for a user; returns a list of
         (item_id, score) or None if the user is unknown."""

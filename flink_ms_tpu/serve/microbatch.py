@@ -1,0 +1,249 @@
+"""Cross-request top-k microbatching — the serving plane's adaptive
+batching lever (the Clipper / TF-Serving idea): TOPK/TOPKV requests
+arriving on the thread-per-client lookup server enqueue into a coalescing
+queue; ONE dispatcher thread drains up to ``max_batch`` waiting queries
+(after at most a ``max_wait_us`` coalescing window) and executes a single
+batched matmul + ``top_k`` over the catalog (``DeviceFactorIndex
+.topk_many``), then scatters per-query results back to the parked handler
+threads.
+
+Why: the unbatched path scores one query vector per device dispatch, so B
+concurrent requests serialize on the index lock and re-read the whole
+catalog from memory B times.  Batching reads the catalog once per
+dispatch and amortizes the fixed dispatch cost B-fold — throughput scales
+with concurrency instead of flat-lining at 1/dispatch-latency.
+
+The wire protocol is unchanged; batching is server-internal (the native
+C++ plane's byte-parity contract is untouched).  Knobs, read once per
+batcher at construction:
+
+- ``TPUMS_TOPK_BATCH``          "1" (default) enable, "0" disable
+- ``TPUMS_TOPK_BATCH_MAX``      max queries per device dispatch (default 32)
+- ``TPUMS_TOPK_BATCH_WAIT_US``  coalescing window in microseconds
+                                (default 200) — the worst-case latency a
+                                lone request pays for the chance to share
+                                a dispatch.  While a dispatch executes,
+                                new arrivals queue up naturally, so under
+                                saturation batches fill without waiting.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def batching_enabled() -> bool:
+    return os.environ.get("TPUMS_TOPK_BATCH", "1") != "0"
+
+
+class PendingTopK:
+    """One enqueued query: the submitting handler thread parks on
+    ``wait()`` while the dispatcher scores the coalesced batch and
+    scatters results (or the per-group error) back."""
+
+    __slots__ = ("vec", "k", "result", "error", "_event")
+
+    def __init__(self, vec: np.ndarray, k: int):
+        self.vec = vec
+        self.k = k
+        self.result: Optional[List[Tuple[str, float]]] = None
+        self.error: Optional[BaseException] = None
+        self._event = threading.Event()
+
+    def _finish(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("batched top-k still queued at deadline")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class TopKBatcher:
+    """Coalesces concurrent top-k queries into shared device dispatches.
+
+    ``submit(vec, k)`` is non-blocking (returns a :class:`PendingTopK`);
+    ``score(vec, k)`` is the blocking submit-and-wait convenience.  The
+    dispatcher thread starts lazily on first submit and groups drained
+    queries by ``(k, vector shape)`` — ``k`` is a static argument of the
+    jitted program and mixed widths cannot stack — so a pathological mix
+    degrades to several smaller dispatches, never to an error for the
+    well-formed queries sharing the batch.
+
+    Adaptive idle fast path: once the dispatcher exists, a submit that
+    finds the batcher fully idle (empty queue, nothing executing) scores
+    inline in the caller's thread via the single-query program — zero
+    added latency at concurrency 1, where a coalescing window could never
+    pay off anyway.  Under queuing pressure (a dispatch in flight or a
+    window already open) arrivals enqueue and coalesce as usual.
+
+    Observability (test hooks, bench counters): ``submitted`` /
+    ``dispatches`` / ``batched_queries`` / ``max_batch_seen`` /
+    ``inline_singles``.  ``dispatches < submitted`` is the signature of
+    coalescing actually happening.
+    """
+
+    def __init__(self, index, max_batch: Optional[int] = None,
+                 max_wait_us: Optional[float] = None):
+        self.index = index
+        self.max_batch = int(
+            os.environ.get("TPUMS_TOPK_BATCH_MAX", 32)
+            if max_batch is None else max_batch
+        )
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_wait_s = float(
+            os.environ.get("TPUMS_TOPK_BATCH_WAIT_US", 200)
+            if max_wait_us is None else max_wait_us
+        ) / 1e6
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._flush = False
+        self._executing = 0  # in-flight scorings: dispatcher + inline
+        self.submitted = 0
+        self.dispatches = 0
+        self.batched_queries = 0
+        self.max_batch_seen = 0
+        self.inline_singles = 0
+
+    # -- submit side --------------------------------------------------------
+
+    def submit(self, vec: np.ndarray, k: int,
+               allow_inline: bool = True) -> PendingTopK:
+        """``allow_inline=False`` forces enqueueing even when idle — the
+        server passes it for every member of a multi-line pipelined burst,
+        where the NEXT submit is already in hand (an inline execution
+        would serialize the burst back into singles)."""
+        pending = PendingTopK(np.asarray(vec, dtype=np.float32), int(k))
+        inline = False
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="topk-batcher", daemon=True
+                )
+                self._thread.start()
+            elif allow_inline and not self._queue and self._executing == 0:
+                # idle fast path: nothing to coalesce WITH, so the window
+                # could only add latency — score in the caller's thread
+                # via the (bit-identical) single-query program
+                inline = True
+                self._executing += 1
+            self.submitted += 1
+            if not inline:
+                self._queue.append(pending)
+                self._cond.notify_all()
+        if inline:
+            try:
+                self.inline_singles += 1
+                pending._finish(result=self.index.topk(pending.vec,
+                                                       pending.k))
+            except BaseException as e:
+                pending._finish(error=e)
+            finally:
+                with self._cond:
+                    self._executing -= 1
+        return pending
+
+    def score(self, vec: np.ndarray, k: int,
+              timeout: Optional[float] = None):
+        return self.submit(vec, k).wait(timeout)
+
+    def flush(self) -> None:
+        """Hint that the submitting burst is complete: the dispatcher
+        stops holding the coalescing window open and dispatches what is
+        queued right now (new arrivals still coalesce into later
+        batches)."""
+        with self._cond:
+            self._flush = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop the dispatcher (drains the queue first so no submitter is
+        left parked forever).  Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue and self._closed:
+                    return
+                # coalescing window: give concurrent arrivals max_wait_s
+                # to share this dispatch, but never hold a full batch
+                if (len(self._queue) < self.max_batch
+                        and self.max_wait_s > 0 and not self._flush):
+                    deadline = time.monotonic() + self.max_wait_s
+                    while (len(self._queue) < self.max_batch
+                           and not self._closed and not self._flush):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                self._flush = False
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(len(self._queue), self.max_batch))
+                ]
+                # arrivals during the dispatch must enqueue (to coalesce
+                # into the NEXT batch), not take the idle fast path
+                self._executing += 1
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # the loop must survive anything —
+                # a dead dispatcher would park every future submitter
+                for p in batch:
+                    if not p._event.is_set():
+                        p._finish(error=e)
+            finally:
+                with self._cond:
+                    self._executing -= 1
+
+    def _dispatch(self, batch: List[PendingTopK]) -> None:
+        groups: dict = {}
+        for p in batch:
+            groups.setdefault((p.k, p.vec.shape), []).append(p)
+        for (k, _shape), group in groups.items():
+            try:
+                if len(group) == 1:
+                    # a lone query runs the exact single-query program, so
+                    # sequential traffic is BIT-identical to the unbatched
+                    # path (the native plane's byte-parity tests replay
+                    # one-at-a-time queries through here)
+                    results = [self.index.topk(group[0].vec, k)]
+                else:
+                    results = self.index.topk_many(
+                        np.stack([p.vec for p in group]), k
+                    )
+            except Exception as e:
+                # a bad group (e.g. width mismatch vs the index) fails its
+                # own members; other groups in the batch still score
+                for p in group:
+                    p._finish(error=e)
+                continue
+            self.dispatches += 1
+            self.batched_queries += len(group)
+            if len(group) > self.max_batch_seen:
+                self.max_batch_seen = len(group)
+            for p, result in zip(group, results):
+                p._finish(result=result)

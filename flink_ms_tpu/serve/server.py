@@ -43,6 +43,19 @@ SGD pays two Netty round trips per rating (SGD.java:172-173) and its MSE job
 one per rating plus one per user group (MSE.java:129-158); MGET folds each
 of those into a single round trip.
 
+TOPK/TOPKV additionally ride a server-internal CROSS-REQUEST MICROBATCHER
+(``microbatch.py``): concurrent top-k queries — from many connections, or
+from one connection's pipelined in-flight window — coalesce into ONE
+batched matmul + ``top_k`` device dispatch instead of serializing on the
+index lock, reading the catalog once per dispatch rather than once per
+query.  Knobs: ``TPUMS_TOPK_BATCH`` (default on; ``0`` disables),
+``TPUMS_TOPK_BATCH_MAX`` (queries per dispatch, default 32),
+``TPUMS_TOPK_BATCH_WAIT_US`` (coalescing window, default 200 — the
+worst-case extra latency a lone request pays).  The wire protocol is
+UNCHANGED: batching never reorders a connection's replies, and a lone
+query runs the exact single-query program, so the native plane's
+byte-parity contract below is untouched.
+
 A C++ epoll implementation of the same protocol
 (``native/lookup_server.cpp``, wrapped by
 ``native_store.NativeLookupServer``, enabled with ``--nativeServer true`` on
@@ -60,6 +73,24 @@ from typing import Dict, Optional
 
 from ..core.formats import RangePayloadCache, gather_sorted, sort_dedup_last
 from .table import ModelTable
+
+
+class _DeferredReply:
+    """A reply whose value is still in flight in the top-k microbatcher.
+    ``resolve()`` parks until the dispatcher scatters the result back and
+    renders the same wire reply the synchronous path would have."""
+
+    __slots__ = ("_resolver",)
+
+    def __init__(self, resolver):
+        self._resolver = resolver
+
+    def resolve(self) -> str:
+        try:
+            payload = self._resolver()
+        except Exception as e:
+            return f"E\ttopk failed: {e}"
+        return "N" if payload is None else f"V\t{payload}"
 
 
 class LookupServer:
@@ -93,23 +124,90 @@ class LookupServer:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                """Line loop with explicit framing (not rfile.readline):
+                after blocking for the first request, every further
+                COMPLETE line already buffered or immediately readable is
+                drained into the same burst, and the burst's TOPK/TOPKV
+                queries are all submitted to the microbatcher BEFORE any
+                reply is awaited — so a pipelined client's in-flight
+                window coalesces into one batched dispatch exactly like
+                concurrent connections do.  Replies keep strict request
+                order (the wire contract is unchanged)."""
+                import select
+
                 with outer._conn_lock:
                     outer._conns.add(self.connection)
                     outer._conn_threads.add(threading.current_thread())
+                sock = self.connection
+                buf = bytearray()
+                eof = False
                 try:
                     while True:
+                        # block for at least one complete line (or EOF)
+                        while not eof and buf.find(b"\n") < 0:
+                            try:
+                                chunk = sock.recv(65536)
+                            except (ConnectionResetError, OSError):
+                                return
+                            if not chunk:
+                                eof = True
+                                break
+                            buf += chunk
+                        # opportunistic non-blocking drain: whatever the
+                        # client already put on the wire joins this burst
+                        while not eof:
+                            try:
+                                readable, _, _ = select.select(
+                                    [sock], [], [], 0)
+                            except (OSError, ValueError):
+                                break
+                            if not readable:
+                                break
+                            try:
+                                chunk = sock.recv(65536)
+                            except (ConnectionResetError, OSError):
+                                chunk = b""
+                            if not chunk:
+                                eof = True
+                                break
+                            buf += chunk
+                        lines = []
+                        while True:
+                            nl = buf.find(b"\n")
+                            if nl < 0:
+                                break
+                            lines.append(buf[:nl].decode("utf-8"))
+                            del buf[:nl + 1]
+                        if eof and buf:
+                            # trailing request without a newline is still
+                            # answered (readline()-at-EOF parity, pinned by
+                            # the native plane's protocol tests)
+                            lines.append(buf.decode("utf-8"))
+                            buf.clear()
+                        if not lines:
+                            return
+                        # submit ALL, then resolve in order
+                        replies = [
+                            outer._dispatch_async(ln, burst=len(lines))
+                            for ln in lines
+                        ]
+                        if len(lines) > 1:
+                            # the burst is fully submitted: let the
+                            # dispatcher fire without waiting out the
+                            # coalescing window for arrivals that were
+                            # never coming
+                            outer._flush_batchers()
+                        out = b"".join(
+                            (r.resolve() if isinstance(r, _DeferredReply)
+                             else r).encode("utf-8") + b"\n"
+                            for r in replies
+                        )
                         try:
-                            line = self.rfile.readline()
-                        except (ConnectionResetError, OSError):
-                            break
-                        if not line:
-                            break
-                        reply = outer._dispatch(
-                            line.decode("utf-8").rstrip("\n"))
-                        try:
-                            self.wfile.write(reply.encode("utf-8") + b"\n")
+                            self.wfile.write(out)
                         except (BrokenPipeError, OSError):
-                            break
+                            return
+                        if eof:
+                            return
                 finally:
                     with outer._conn_lock:
                         outer._conns.discard(self.connection)
@@ -193,6 +291,29 @@ class LookupServer:
         return fids, ws, buckets
 
     def _dispatch(self, line: str) -> str:
+        """Synchronous dispatch (compat surface): resolves any deferred
+        top-k reply before returning."""
+        reply = self._dispatch_async(line)
+        return reply.resolve() if isinstance(reply, _DeferredReply) else reply
+
+    def _flush_batchers(self) -> None:
+        """Release every handler's coalescing window (burst submitted)."""
+        for handler in self.topk_handlers.values():
+            batcher = getattr(handler, "batcher", None)
+            if batcher is not None:
+                try:
+                    batcher.flush()
+                except Exception:
+                    pass
+
+    def _dispatch_async(self, line: str, burst: int = 1):
+        """-> reply str, or a _DeferredReply for TOPK/TOPKV riding the
+        microbatcher (the handler loop submits a whole pipelined burst
+        before resolving any, so the burst shares a device dispatch).
+        ``burst`` is the number of lines in the read burst this line
+        belongs to — burst members must enqueue rather than take the
+        batcher's idle inline path, or the burst serializes back into
+        singles."""
         self.requests += 1
         parts = line.split("\t")
         if parts[0] == "PING":
@@ -286,11 +407,18 @@ class LookupServer:
                 parts[0] == "TOPKV" and not hasattr(handler, "by_vector")
             ):
                 return f"E\tno topk index for state: {state}"
-            fn = handler if parts[0] == "TOPK" else handler.by_vector
             try:
                 k = int(k_s)
                 if k < 1:
                     return "E\tk must be >= 1"
+                submit = getattr(handler, "submit_query", None)
+                if submit is not None:
+                    # enqueue NOW, render the reply at resolve time: the
+                    # caller can submit a whole burst before parking, so
+                    # pipelined requests coalesce in the microbatcher
+                    return _DeferredReply(
+                        submit(parts[0], query_arg, k, burst=burst))
+                fn = handler if parts[0] == "TOPK" else handler.by_vector
                 payload = fn(query_arg, k)
             except Exception as e:
                 return f"E\ttopk failed: {e}"
@@ -325,6 +453,16 @@ class LookupServer:
                 pass
         for t in threads:
             t.join(timeout=5)
+        # stop the top-k microbatcher dispatchers (drains their queues
+        # first, so no late in-flight query parks forever); handlers
+        # without a close() — plain callables in tests — are fine as-is
+        for h in self.topk_handlers.values():
+            close = getattr(h, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:
+                    pass
         # the quiesce guarantee must be ENFORCED, not assumed: a handler
         # wedged in _dispatch (e.g. a long device-side TOPK) surviving the
         # join would race the caller's store teardown — make it loud

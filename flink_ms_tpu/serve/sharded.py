@@ -156,22 +156,45 @@ class ShardedQueryClient:
     def topk(self, name: str, user_id: str, k: int):
         """Fan-out top-k: returns the merged [(item, score)] best-k across
         every worker's catalog slice (scored concurrently), or None if the
-        user is unknown."""
-        user_payload = self.query_state(name, f"{user_id}-U")
-        if user_payload is None:
-            return None
+        user is unknown.  Server-side, each worker's TOPKV lands in its
+        cross-request microbatcher, so concurrent fan-outs from many
+        clients share device dispatches per worker."""
+        out = self.topk_many(name, [user_id], k)[0]
+        return out
+
+    def topk_many(self, name: str, user_ids: Sequence[str], k: int) -> list:
+        """Bulk fan-out top-k for many users in one sweep: ONE MGET per
+        owning worker resolves every user's factor row, then each worker
+        scores ALL the query vectors through a single pipelined TOPKV
+        stream (``topk_by_vector_pipelined``).  Arriving back-to-back on
+        one connection, the vectors coalesce in the worker's microbatcher
+        into batched device dispatches — the whole sweep costs each worker
+        ~ceil(B / max_batch) catalog passes instead of B.
+
+        Returns one merged best-k list per user id, in order; None per
+        unknown user."""
+        user_ids = list(user_ids)
+        payloads = self.query_states(name, [f"{u}-U" for u in user_ids])
+        known = [i for i, p in enumerate(payloads) if p is not None]
+        out: list = [None] * len(user_ids)
+        if not known:
+            return out
+        vecs = [payloads[i] for i in known]
         from concurrent.futures import wait as _futures_wait
 
         futs = [
-            self._pool.submit(c.topk_by_vector, name, user_payload, k)
+            self._pool.submit(c.topk_by_vector_pipelined, name, vecs, k)
             for c in self._clients
         ]
         _futures_wait(futs)  # join all before any result() can raise
-        merged: List[Tuple[str, float]] = []
-        for f in futs:
-            merged.extend(f.result())
-        merged.sort(key=lambda it: -it[1])
-        return merged[:k]
+        per_worker = [f.result() for f in futs]
+        for j, i in enumerate(known):
+            merged: List[Tuple[str, float]] = []
+            for worker_results in per_worker:
+                merged.extend(worker_results[j])
+            merged.sort(key=lambda it: -it[1])
+            out[i] = merged[:k]
+        return out
 
     def ping_all(self) -> List[str]:
         return [c.ping() for c in self._clients]

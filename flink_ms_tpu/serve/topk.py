@@ -142,6 +142,7 @@ class DeviceFactorIndex:
         self._n_real = 0
         self._k_real = 0  # real factor width
         self._topk_fn = None
+        self._topk_many_fn = None
         self._built_once = False
         # dirty-key plumbing: the table's writer thread appends, the query
         # path drains.  Tables without listener support (none in-tree) fall
@@ -367,50 +368,57 @@ class DeviceFactorIndex:
 
     # -- querying -----------------------------------------------------------
 
+    def _maintain_locked(self) -> None:
+        """Index maintenance shared by the single and batched query paths
+        (called under self._lock): (re)build on first use / counter tick,
+        then drain-or-peek the dirty set exactly as the class docstring
+        describes.  A batched query pays this ONCE for the whole batch."""
+        if self._counter_mode:
+            if self.table.puts != self._built_at:
+                built_at = self.table.puts
+                self._build_locked()
+                self._built_at = built_at
+        elif not self._built_once:
+            self._build_locked()
+        else:
+            rebuilding = (
+                self._rebuild_thread is not None
+                and self._rebuild_thread.is_alive()
+            )
+            with self._dirty_lock:
+                backlog = len(self._dirty)
+            if rebuilding:
+                # PEEK, don't drain: a key drained now but missing from
+                # the in-flight rebuild's snapshot would lose its update
+                # at swap time.  Applying from the live table is
+                # idempotent, so re-applying after the swap is safe —
+                # but keys applied once during THIS rebuild are skipped
+                # (cleared at swap), so an unchanged backlog is free.
+                import itertools
+
+                with self._dirty_lock:
+                    dirty = set(itertools.islice(
+                        (key for key in self._dirty
+                         if key not in self._peek_applied),
+                        self.apply_cap,
+                    ))
+                if dirty:
+                    self._apply_updates_locked(dirty, allow_rebuild=False)
+                    self._peek_applied |= dirty
+            elif backlog > self.rebuild_backlog:
+                # writer is outrunning the query path: one background
+                # rebuild absorbs the whole backlog off-path (its
+                # snapshot reads current values; the peeked set stays
+                # for idempotent re-apply)
+                self._start_rebuild_locked()
+            else:
+                dirty = self._drain_dirty(limit=self.apply_cap)
+                if dirty:
+                    self._apply_updates_locked(dirty, allow_rebuild=True)
+
     def topk(self, user_factors: np.ndarray, k: int) -> List[Tuple[str, float]]:
         with self._lock:
-            if self._counter_mode:
-                if self.table.puts != self._built_at:
-                    built_at = self.table.puts
-                    self._build_locked()
-                    self._built_at = built_at
-            elif not self._built_once:
-                self._build_locked()
-            else:
-                rebuilding = (
-                    self._rebuild_thread is not None
-                    and self._rebuild_thread.is_alive()
-                )
-                with self._dirty_lock:
-                    backlog = len(self._dirty)
-                if rebuilding:
-                    # PEEK, don't drain: a key drained now but missing from
-                    # the in-flight rebuild's snapshot would lose its update
-                    # at swap time.  Applying from the live table is
-                    # idempotent, so re-applying after the swap is safe —
-                    # but keys applied once during THIS rebuild are skipped
-                    # (cleared at swap), so an unchanged backlog is free.
-                    import itertools
-
-                    with self._dirty_lock:
-                        dirty = set(itertools.islice(
-                            (key for key in self._dirty
-                             if key not in self._peek_applied),
-                            self.apply_cap,
-                        ))
-                    if dirty:
-                        self._apply_updates_locked(dirty, allow_rebuild=False)
-                        self._peek_applied |= dirty
-                elif backlog > self.rebuild_backlog:
-                    # writer is outrunning the query path: one background
-                    # rebuild absorbs the whole backlog off-path (its
-                    # snapshot reads current values; the peeked set stays
-                    # for idempotent re-apply)
-                    self._start_rebuild_locked()
-                else:
-                    dirty = self._drain_dirty(limit=self.apply_cap)
-                    if dirty:
-                        self._apply_updates_locked(dirty, allow_rebuild=True)
+            self._maintain_locked()
             if self._matrix is None:
                 return []
             n = self._n_real
@@ -427,6 +435,79 @@ class DeviceFactorIndex:
                 for i, s in zip(np.asarray(idx), np.asarray(scores))
             ]
 
+    def topk_many(
+        self, queries: np.ndarray, k: int
+    ) -> List[List[Tuple[str, float]]]:
+        """Batched top-k: ONE device dispatch scores every row of the
+        ``(B, n_factors)`` query matrix against the catalog — the catalog
+        is read from memory once for the whole batch instead of once per
+        query, and the fixed dispatch cost amortizes B-fold (the
+        cross-request microbatching lever, see ``microbatch.py``).
+
+        Returns a list of B result lists; row i equals ``topk(queries[i],
+        k)`` over the same index state (maintenance — dirty-row scatter /
+        rebuild kick — runs once up front for the whole batch, so batched
+        queries see streaming updates exactly like single queries do).
+
+        B is padded up to the next power of two by repeating the first
+        row (rows are scored independently, so pad rows cannot perturb
+        real rows' results and their outputs are sliced off) — the same
+        pad-to-bucket idiom as the ALS degree buckets and the update
+        scatter's fixed shape: XLA compiles a handful of batch shapes,
+        not one per in-flight batch size."""
+        with self._lock:
+            self._maintain_locked()
+            q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+            n_queries = q.shape[0]
+            if self._matrix is None:
+                return [[] for _ in range(n_queries)]
+            if q.shape[1] != self._k_real:
+                raise ValueError(
+                    f"queries have {q.shape[1]} factors, index has "
+                    f"{self._k_real}"
+                )
+            k_eff = min(k, self._n_real)
+            b_pad = 1 << (n_queries - 1).bit_length() if n_queries > 1 else 1
+            if b_pad != n_queries:
+                q = np.concatenate(
+                    [q, np.broadcast_to(q[:1], (b_pad - n_queries, q.shape[1]))]
+                )
+            if self._topk_many_fn is None:
+                import jax
+                from functools import partial
+
+                @partial(jax.jit, static_argnums=2)
+                def topk_many_fn(matrix, qs, k):
+                    scores = qs @ matrix.T  # (B, n_items) — one MXU pass
+                    return jax.lax.top_k(scores, k)
+
+                self._topk_many_fn = topk_many_fn
+            scores, idx = self._topk_many_fn(self._matrix, q, k_eff)
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+            ids = self._ids
+            return [
+                [(ids[int(i)], float(s)) for i, s in zip(idx[b], scores[b])]
+                for b in range(n_queries)
+            ]
+
+    def warm_batch_shapes(self, k: int, max_batch: int = 32) -> None:
+        """Pre-compile every padded-bucket batched program (power-of-two
+        batch shapes up to ``max_batch``) for the given ``k``.  First use
+        of a bucket otherwise pays its XLA compile inside a live dispatch,
+        charging tens of milliseconds to every request sharing that batch
+        — a one-time cost per process that belongs at build time, not in
+        the serving tail."""
+        with self._lock:
+            self._maintain_locked()
+            if self._matrix is None:
+                return
+            width = self._k_real
+        b = 1
+        while b <= max_batch:
+            self.topk_many(np.zeros((b, width), dtype=np.float32), k)
+            b *= 2
+
 
 class ALSTopkHandler:
     """Lookup-server top-k handlers over a table's item factors.
@@ -436,11 +517,24 @@ class ALSTopkHandler:
     supplied by the caller) — the verb sharded serving uses to fan a top-k
     out across workers that each hold only a slice of the catalog (the
     user's row lives on exactly one worker, so peers cannot resolve it
-    locally)."""
+    locally).
 
-    def __init__(self, table: ModelTable):
+    Scoring routes through the cross-request microbatcher
+    (``microbatch.TopKBatcher``) unless ``TPUMS_TOPK_BATCH=0``: concurrent
+    TOPK/TOPKV requests coalesce into one batched device dispatch instead
+    of serializing on the index lock.  ``batching`` can be flipped live
+    (the bench harness A/Bs both paths on one warm index)."""
+
+    def __init__(self, table: ModelTable, batcher=None):
         self.table = table
         self.index = DeviceFactorIndex(table, "-I")
+        if batcher is None:
+            from .microbatch import TopKBatcher, batching_enabled
+
+            if batching_enabled():
+                batcher = TopKBatcher(self.index)
+        self.batcher = batcher
+        self.batching = batcher is not None
 
     def __call__(self, user_id: str, k: int) -> Optional[str]:  # TOPK verb
         payload = self.table.get(f"{user_id}-U")
@@ -449,11 +543,43 @@ class ALSTopkHandler:
         return self.by_vector(payload, k)
 
     def by_vector(self, factors_payload: str, k: int) -> str:  # TOPKV verb
-        vec = np.asarray(
-            [float(t) for t in factors_payload.split(";") if t]
+        return self.submit_query("TOPKV", factors_payload, k)()
+
+    def submit_query(self, verb: str, query_arg: str, k: int,
+                     burst: int = 1):
+        """Enqueue one TOPK/TOPKV query NOW; returns a zero-arg callable
+        resolving to the wire payload (``item:score;...``) or None for an
+        unknown user.  The split lets the server submit every query of a
+        pipelined burst before parking on any result, so a single
+        connection's in-flight window coalesces into one dispatch just
+        like concurrent connections do.  ``burst`` (the read-burst line
+        count) disables the batcher's idle inline path for burst members —
+        the rest of the burst is already in hand and must share the
+        dispatch.  Parse errors raise here, at submit time (the server
+        maps them to an E reply)."""
+        if verb == "TOPK":
+            payload = self.table.get(f"{query_arg}-U")
+            if payload is None:
+                return lambda: None
+        else:
+            payload = query_arg
+        # numpy parses the token list at C speed (same idiom as the index
+        # build); float()-per-token costs ~2x on the hot path
+        vec = np.array(
+            [t for t in payload.split(";") if t], dtype=np.float32
         )
-        results = self.index.topk(vec, k)
-        return ";".join(f"{item}:{score}" for item, score in results)
+        if self.batching and self.batcher is not None:
+            pending = self.batcher.submit(vec, k, allow_inline=(burst <= 1))
+            return lambda: _format_topk(pending.wait())
+        return lambda: _format_topk(self.index.topk(vec, k))
+
+    def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()
+
+
+def _format_topk(results) -> str:
+    return ";".join(f"{item}:{score}" for item, score in results)
 
 
 def make_als_topk_handler(table: ModelTable) -> ALSTopkHandler:

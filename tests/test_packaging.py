@@ -6,7 +6,14 @@ only at submit time)."""
 
 import importlib
 import os
-import tomllib
+
+try:  # Python >= 3.11
+    import tomllib
+except ModuleNotFoundError:  # 3.10: tomli if present, else a minimal reader
+    try:
+        import tomli as tomllib
+    except ModuleNotFoundError:
+        tomllib = None
 
 import pytest
 
@@ -16,7 +23,28 @@ _PYPROJECT = os.path.join(
 )
 
 
+def _scripts_minimal_toml():
+    """Last-ditch reader for `[project.scripts]` only: flat
+    ``name = "module:fn"`` string pairs (exactly the shape this repo's
+    pyproject uses) — enough to keep the guard armed on interpreters
+    with neither tomllib nor tomli."""
+    scripts, in_scripts = {}, False
+    with open(_PYPROJECT, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("["):
+                in_scripts = line == "[project.scripts]"
+                continue
+            if not in_scripts or "=" not in line or line.startswith("#"):
+                continue
+            name, _, target = line.partition("=")
+            scripts[name.strip().strip('"')] = target.strip().strip('"')
+    return scripts
+
+
 def _scripts():
+    if tomllib is None:
+        return sorted(_scripts_minimal_toml().items())
     with open(_PYPROJECT, "rb") as f:
         return sorted(tomllib.load(f)["project"]["scripts"].items())
 
