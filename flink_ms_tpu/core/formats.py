@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Sequence, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -411,6 +411,163 @@ def read_svm_model(path: str, n_features: int = 0,
     for idx1, v in entries:
         w[idx1 - 1] = v
     return w
+
+
+# ---------------------------------------------------------------------------
+# columnar journal-chunk parsing (the serving ingest hot path)
+# ---------------------------------------------------------------------------
+
+# chunk-parse modes, shared with the native bulk-ingest plane
+# (tpums_ingest_buf) and the per-row parsers in serve/consumer.py
+CHUNK_ALS = 0  # ``id,T,payload``  -> key "id-T", value payload
+CHUNK_SVM = 1  # ``key,payload``   -> key raw first token, value rest
+
+
+def _fnv1a_ranges(buf: "np.ndarray", starts: "np.ndarray",
+                  ends: "np.ndarray") -> Optional["np.ndarray"]:
+    """Vectorized 32-bit FNV-1a over byte ranges of ``buf`` — the same
+    hash ``serve.table._fnv1a`` computes over each key's utf-8 bytes, but
+    straight from the chunk buffer: no per-key ``str.encode`` calls.
+    Returns None when a range is oversized (caller falls back to the
+    per-key path)."""
+    n = len(starts)
+    if n == 0:
+        return np.empty(0, np.uint32)
+    lens = (ends - starts).astype(np.int64)
+    L = int(lens.max())
+    if L > 256:
+        return None  # degenerate key; don't build an (n, L) buffer for it
+    h = np.full(n, 0x811C9DC5, np.uint32)
+    if L == 0:
+        return h
+    padded = np.zeros((n, L), np.uint8)
+    total = int(lens.sum())
+    row = np.repeat(np.arange(n), lens)
+    col = np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens)
+    padded[row, col] = buf[np.repeat(starts, lens) + col]
+    prime = np.uint32(0x01000193)
+    for j in range(L):
+        hx = (h ^ padded[:, j]) * prime
+        h = np.where(j < lens, hx, h)
+    return h
+
+
+def split_journal_chunk(data: bytes, mode: int, with_hashes: bool = False):
+    """Columnar parse of a whole journal byte chunk -> (keys, values,
+    parse_errors), or with ``with_hashes`` -> (keys, values, parse_errors,
+    hashes) where ``hashes`` is the per-key uint32 FNV-1a array (the shard
+    routing hash, computed from the chunk bytes with zero per-key Python
+    work) or None when the chunk had degenerate keys.
+
+    The scalar ingest path pays one ``str.split`` + f-string + exception
+    frame per row; at 1M-row replays that Python loop IS the ingest
+    bottleneck.  This parser instead locates every newline and comma with
+    numpy byte scans, rewrites the key/value separators in ONE buffer
+    pass, and materializes all key/value strings with a single C-level
+    ``str.split`` — per-row Python work is zero.
+
+    Semantics are pinned byte-identical to the per-row parsers
+    (``parse_als_record`` / ``parse_svm_record``, tests assert parity):
+
+    - ALS rows need >= 2 commas; the first comma becomes the "-" of the
+      ``<id>-<T>`` key, the payload may itself contain commas.  Rows with
+      fewer commas count as parse errors (skip-and-count).
+    - SVM rows split at the FIRST comma; a row with no comma yields
+      (row, "") and is NOT an error (str.partition semantics).
+    - empty lines are skipped silently; a trailing "\\r" (CRLF input) is
+      stripped like ``str.splitlines`` does.
+    """
+    if mode not in (CHUNK_ALS, CHUNK_SVM):
+        raise ValueError(f"unknown chunk mode: {mode}")
+    if not data:
+        return ([], [], 0, None) if with_hashes else ([], [], 0)
+    if data[-1:] != b"\n":
+        data = data + b"\n"  # journal chunks end at a newline; be defensive
+    buf = np.frombuffer(data, np.uint8)
+    nl = np.nonzero(buf == ord("\n"))[0]
+    starts = np.empty_like(nl)
+    starts[0] = 0
+    starts[1:] = nl[:-1] + 1
+    ends = nl.copy()  # exclusive end of line content
+    # CRLF tolerance, matching splitlines() on the scalar path
+    cr = buf[np.maximum(ends - 1, 0)] == ord("\r")
+    cr &= ends > starts
+    ends = ends - cr
+    nonempty = ends > starts
+    cpos = np.nonzero(buf == ord(","))[0]
+    if len(cpos) == 0:
+        # no commas anywhere: ALS -> all nonempty lines are errors; SVM ->
+        # every nonempty line is (line, "")
+        if mode == CHUNK_ALS:
+            errs = int(nonempty.sum())
+            return ([], [], errs, None) if with_hashes else ([], [], errs)
+        text = data.decode("utf-8")
+        keys = [ln for ln in text.splitlines() if ln]
+        values = [""] * len(keys)
+        if with_hashes:
+            hashes = _fnv1a_ranges(buf, starts[nonempty], ends[nonempty])
+            return keys, values, 0, hashes
+        return keys, values, 0
+    j1 = np.searchsorted(cpos, starts)
+    safe1 = np.minimum(j1, len(cpos) - 1)
+    c1 = cpos[safe1]
+    has1 = (j1 < len(cpos)) & (c1 < ends)
+    out = buf.copy()
+    errors = 0
+    loners = None
+    if mode == CHUNK_ALS:
+        j2 = j1 + 1
+        safe2 = np.minimum(j2, len(cpos) - 1)
+        c2 = cpos[safe2]
+        has2 = has1 & (j2 < len(cpos)) & (c2 < ends)
+        keep_line = nonempty & has2
+        errors = int((nonempty & ~has2).sum())
+        out[c1[keep_line]] = ord("-")   # "id,T" -> "id-T"
+        out[c2[keep_line]] = ord("\n")  # key/value separator
+        key_ends = c2  # key is "id-T": start of line .. second comma
+    else:
+        keep_line = nonempty  # str.partition never fails a row
+        out[c1[nonempty & has1]] = ord("\n")
+        # comma-less SVM rows yield (row, "") — they get an extra "\n"
+        # spliced in after the mask pass so the key/value alternation
+        # holds WITHOUT reordering (last-writer-wins depends on order)
+        loners = np.nonzero(nonempty & ~has1)[0]
+        key_ends = np.where(has1, c1, ends)
+    no_loners = loners is None or len(loners) == 0
+    if bool(keep_line.all()) and not bool(cr.any()):
+        # clean chunk (the overwhelmingly common case): every byte is
+        # kept, so skip the O(bytes) mask build and boolean gather
+        kept_arr = out
+        if not no_loners:
+            kept_arr = np.insert(
+                kept_arr, nl[loners] + 1, np.uint8(ord("\n"))
+            )
+    else:
+        # drop malformed/empty lines (and CR bytes) in one mask pass
+        line_lens = nl - starts + 1
+        mask = np.repeat(keep_line, line_lens)
+        mask[ends[cr]] = False
+        kept_arr = out[mask]
+        if not no_loners:
+            # position just past each loner's newline in the kept stream
+            cum = np.cumsum(mask)
+            kept_arr = np.insert(
+                kept_arr, cum[nl[loners]], np.uint8(ord("\n"))
+            )
+    # decode + split ONCE: parts alternate key, value, key, value, ...
+    kept = kept_arr.tobytes()
+    if kept:
+        parts = kept.decode("utf-8").split("\n")
+        parts.pop()  # buffer ends with "\n" -> one trailing empty
+        keys, values = parts[0::2], parts[1::2]
+    else:
+        keys, values = [], []
+    if not with_hashes:
+        return keys, values, errors
+    # per-key shard hashes straight from the (rewritten) chunk bytes, in
+    # kept-line order — the key bytes ARE each key string's utf-8 bytes
+    hashes = _fnv1a_ranges(out, starts[keep_line], key_ends[keep_line])
+    return keys, values, errors, hashes
 
 
 # ---------------------------------------------------------------------------

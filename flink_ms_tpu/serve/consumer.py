@@ -35,10 +35,11 @@ import time
 import uuid
 from typing import Callable, List, Optional, Tuple
 
+from ..core.formats import CHUNK_ALS, CHUNK_SVM, split_journal_chunk
 from ..core.params import Params
 from .journal import Journal
 from .server import LookupServer
-from .table import ModelTable
+from .table import ModelTable, _fnv1a_batch
 
 ALS_STATE = "ALS_MODEL"
 SVM_STATE = "SVM_MODEL"
@@ -58,6 +59,11 @@ def parse_svm_record(line: str) -> Tuple[str, str]:
 # byte-for-byte; tests pin the parity)
 parse_als_record.native_mode = 0
 parse_svm_record.native_mode = 1
+# columnar chunk-parse mode ids (core.formats.split_journal_chunk mirrors
+# these parsers line-for-line; tests pin the parity).  A parse_fn without
+# this attribute (custom parsers) always takes the scalar per-line path.
+parse_als_record.columnar_mode = CHUNK_ALS
+parse_svm_record.columnar_mode = CHUNK_SVM
 
 
 # ---------------------------------------------------------------------------
@@ -157,9 +163,22 @@ class ServingJob:
         restart_delay_s: float = 10.0,
         native_server: bool = False,
         start_from: str = "earliest",
+        ingest_mode: Optional[str] = None,
+        topk_index: bool = True,
     ):
         if start_from not in ("earliest", "latest"):
             raise ValueError("start_from must be earliest|latest")
+        # journal->state application strategy (TPUMS_INGEST_MODE / CLI
+        # --ingestMode): "columnar" splits whole byte chunks with numpy and
+        # applies them through put_many_columns; "scalar" is the per-line
+        # reference path; "auto" (default) picks columnar whenever the
+        # parser advertises a columnar_mode.  The native C++ bulk path
+        # (no listeners + rocksdb table) outranks both.
+        if ingest_mode is None:
+            ingest_mode = os.environ.get("TPUMS_INGEST_MODE", "auto")
+        if ingest_mode not in ("auto", "columnar", "scalar"):
+            raise ValueError("ingest_mode must be auto|columnar|scalar")
+        self.ingest_mode = ingest_mode
         self.journal = journal
         self.state_name = state_name
         self.host = host
@@ -190,6 +209,15 @@ class ServingJob:
         # and replay the whole retained backlog it was configured to skip
         self._seed_offset = self.offset
         self.parse_errors = 0
+        # ingest-plane observability: which path ran last, how many rows /
+        # chunks it applied, and the wall time spent inside state
+        # application (parse + put + listener fan-out) — the bench's
+        # cold-start rows/sec and the ingest_profile tool read these
+        self.ingest_path = "idle"
+        self.ingest_rows = 0
+        self.ingest_batches = 0
+        self.ingest_apply_s = 0.0
+        self.checkpoints_deferred = 0
         self._stopped = False
         self._stop = threading.Event()
         self._consumer_thread: Optional[threading.Thread] = None
@@ -219,7 +247,7 @@ class ServingJob:
             )
         else:
             topk_handlers = {}
-            if state_name == ALS_STATE:
+            if state_name == ALS_STATE and topk_index:
                 # device-scored top-k over the live item factors (serve/topk.py)
                 from .topk import make_als_topk_handler
 
@@ -354,8 +382,18 @@ class ServingJob:
                         file=sys.stderr,
                     )
 
+    # a wall-clock checkpoint is deferred while a replay backlog is live
+    # (every poll still moving >= half a chunk cap of bytes), but never past
+    # this many checkpoint intervals — bounds the at-least-once replay debt
+    # a crash mid-replay can accumulate
+    CHECKPOINT_MAX_DEFER_INTERVALS = 5.0
+    # one ingest poll's byte budget (both native and columnar paths): caps
+    # how long one state-application critical section can run
+    CHUNK_CAP = 2 << 20
+
     def _consume_loop(self) -> None:
         last_checkpoint = time.time()
+        chunk_cap = self.CHUNK_CAP
         while not self._stop.is_set():
             # native fast path: rocksdb-parity table + a standard parser +
             # no change listeners -> the whole chunk (parse, key-derive,
@@ -363,29 +401,67 @@ class ServingJob:
             # force the Python path so they keep seeing every key.  The
             # chunk is capped at 2 MiB (~15k rows) because the ingest call
             # holds the store mutex the C++ lookup server's reads take —
-            # same starvation bound as the Python path's 10k-row chunks.
+            # same starvation bound as the Python path's row-sliced chunks.
             native_mode = getattr(self.parse_fn, "native_mode", None)
+            columnar_mode = getattr(self.parse_fn, "columnar_mode", None)
+            t0 = time.perf_counter()
             if (
                 native_mode is not None
                 and hasattr(self.table, "ingest_lines")
                 and not getattr(self.table, "_listeners", True)
             ):
+                self.ingest_path = "native"
                 chunk, next_offset = self.journal.read_bytes_from(
-                    self.offset, max_bytes=2 << 20
+                    self.offset, max_bytes=chunk_cap
                 )
                 got_any = bool(chunk)
                 if chunk:
                     rows, errs = self.table.ingest_lines(chunk, native_mode)
                     self.parse_errors += errs
+                    self.ingest_rows += rows
+                    self.ingest_batches += 1
+            elif columnar_mode is not None and self.ingest_mode != "scalar":
+                # columnar path: numpy splits the whole byte chunk into
+                # key/value columns, ownership filtering and shard routing
+                # are vectorized, and listeners get ONE batched callback
+                self.ingest_path = "columnar"
+                chunk, next_offset = self.journal.read_bytes_from(
+                    self.offset, max_bytes=chunk_cap
+                )
+                got_any = bool(chunk)
+                if chunk:
+                    self._apply_chunk_columnar(chunk, columnar_mode)
+                    self.ingest_batches += 1
             else:
-                lines, next_offset = self.journal.read_from(self.offset)
+                self.ingest_path = "scalar"
+                lines, next_offset = self.journal.read_from(
+                    self.offset, max_bytes=chunk_cap
+                )
                 got_any = bool(lines)
-                self._apply_lines(lines)
+                if lines:
+                    self._apply_lines(lines)
+                    self.ingest_batches += 1
+            if got_any:
+                self.ingest_apply_s += time.perf_counter() - t0
+            bytes_advanced = next_offset - self.offset
             self.offset = next_offset
             now = time.time()
             if now - last_checkpoint >= self.checkpoint_interval_s:
-                self.backend.snapshot(self.table, self.offset)
-                last_checkpoint = now
+                # a full-chunk poll means we're inside a cold-start replay
+                # backlog: snapshotting the whole table now would stall
+                # ingest behind a multi-second critical section and commit
+                # an offset we'll blow past within milliseconds — defer,
+                # bounded so a crash can't replay unboundedly
+                backlog = got_any and bytes_advanced >= chunk_cap // 2
+                overdue = now - last_checkpoint >= (
+                    self.checkpoint_interval_s
+                    * self.CHECKPOINT_MAX_DEFER_INTERVALS
+                )
+                if backlog and not overdue:
+                    self.checkpoints_deferred += 1
+                else:
+                    self.backend.snapshot(self.table, self.offset)
+                    last_checkpoint = now
             if not got_any:
                 self._stop.wait(self.poll_interval_s)
 
@@ -410,6 +486,53 @@ class ServingJob:
         # queries behind one multi-second critical section
         for s in range(0, len(batch), 10_000):
             self.table.put_many(batch[s:s + 10_000])
+        self.ingest_rows += len(batch)
+
+    def _apply_chunk_columnar(self, chunk: bytes, mode: int) -> None:
+        """Vectorized equivalent of read_from + _apply_lines: same skipped
+        rows, same parse-error counts, same last-writer-wins table state
+        (tests pin byte-identical parity against the scalar path).  The
+        shard-routing hashes ride along from the chunk parser so neither
+        the ownership filter nor the table re-hashes the keys."""
+        keys, values, errs, hashes = split_journal_chunk(
+            chunk, mode, with_hashes=True
+        )
+        self.parse_errors += errs
+        shard_filter = getattr(self.parse_fn, "shard_filter", None)
+        if shard_filter is not None and keys:
+            # sharded worker: vectorized ownership filter replaces the
+            # per-row "parsed is None" checks of the scalar wrapper
+            worker_index, num_workers = shard_filter
+            import numpy as np
+
+            if hashes is None:
+                hashes = _fnv1a_batch(keys)
+            mine = hashes % num_workers == worker_index
+            if not mine.all():
+                keys = np.asarray(keys, dtype=object)[mine].tolist()
+                values = np.asarray(values, dtype=object)[mine].tolist()
+                hashes = hashes[mine]
+        # row-sliced like the scalar path so one chunk can't starve
+        # concurrent queries behind a single table-lock hold (the
+        # vectorized apply is ~5x faster per row, hence the larger slice)
+        for s in range(0, len(keys), 50_000):
+            self.table.put_many_columns(
+                keys[s:s + 50_000], values[s:s + 50_000],
+                hashes=None if hashes is None else hashes[s:s + 50_000],
+            )
+        self.ingest_rows += len(keys)
+
+    def ingest_stats(self) -> dict:
+        """Ingest-plane counters for benches and monitoring."""
+        return {
+            "path": self.ingest_path,
+            "rows": self.ingest_rows,
+            "batches": self.ingest_batches,
+            "apply_s": self.ingest_apply_s,
+            "parse_errors": self.parse_errors,
+            "checkpoints_deferred": self.checkpoints_deferred,
+            "offset": self.offset,
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -474,6 +597,7 @@ def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
         job_id=params.get("jobId"),
         native_server=params.get_bool("nativeServer", False),
         start_from=params.get("startFrom", "earliest"),
+        ingest_mode=params.get("ingestMode"),
     )
     print(
         f"[serve] {state_name} serving topic '{journal.topic}' on port "

@@ -316,11 +316,14 @@ class NativeModelTable:
         # it every DOT request would rescan the whole store
         self.version = 0
         self._listeners = []
+        self._batch_listeners = []
 
-    def add_change_listener(self, fn) -> None:
-        """fn(key) on every put (same contract as ModelTable)."""
+    def add_change_listener(self, fn, batch_fn=None) -> None:
+        """fn(key) on every put (same contract as ModelTable); optional
+        ``batch_fn(keys)`` replaces the per-key calls for batched ingest."""
         with self._lock:
             self._listeners.append(fn)
+            self._batch_listeners.append(batch_fn)
 
     def put(self, key: str, value: str) -> None:
         with self._lock:
@@ -332,9 +335,33 @@ class NativeModelTable:
 
     def put_many(self, pairs) -> None:
         """Batched ingest (same contract as ModelTable.put_many)."""
+        pairs = list(pairs)
+        if not pairs:
+            return
+        self.put_many_columns([k for k, _ in pairs], [v for _, v in pairs])
+
+    def put_many_columns(self, keys, values, hashes=None) -> None:
+        """Columnar batched ingest (same contract as
+        ``ModelTable.put_many_columns``; ``hashes`` accepted and unused —
+        the store hashes internally): one lock acquisition and one
+        batched listener notification per chunk.  The store writes stay
+        per-row (each is one FFI append), but the listener fan-out no
+        longer costs a Python call per key."""
+        n = len(keys)
+        if n == 0:
+            return
         with self._lock:
-            for key, value in pairs:
-                self.put(key, value)
+            store_put = self.store.put
+            for key, value in zip(keys, values):
+                store_put(key, value)
+            self.puts += n
+            self.version += 1
+            for fn, batch_fn in zip(self._listeners, self._batch_listeners):
+                if batch_fn is not None:
+                    batch_fn(keys)
+                else:
+                    for key in keys:
+                        fn(key)
 
     def ingest_lines(self, data: bytes, mode: int) -> Tuple[int, int]:
         """Native bulk ingest of a journal chunk — ONE FFI call instead of

@@ -70,6 +70,14 @@ def sharded_parse(
             return None
         return key, value
 
+    # advertise the wrapped parser's columnar mode plus the ownership
+    # predicate so the consume loop's columnar path can split the chunk
+    # with numpy and apply the SAME filter vectorized (consumer.py
+    # _apply_chunk_columnar); the closure above stays the scalar fallback
+    columnar_mode = getattr(parse_fn, "columnar_mode", None)
+    if columnar_mode is not None:
+        parse.columnar_mode = columnar_mode
+        parse.shard_filter = (worker_index, num_workers)
     return parse
 
 
@@ -345,6 +353,7 @@ def run_worker(params: Params) -> ServingJob:
         # point lookups and catalog-scored TOPKV straight from each
         # worker's persistent store slice
         native_server=params.get_bool("nativeServer", False),
+        ingest_mode=params.get("ingestMode"),
     ).start()
     print(
         f"[serve:sharded] worker {worker_index}/{num_workers} "

@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
+import numpy as np
+
 MANIFEST = "MANIFEST.json"
 
 
@@ -40,8 +42,6 @@ def _fnv1a_batch(keys) -> "np.ndarray":
     Byte-identical to ``_fnv1a`` per key; the per-character loop runs over
     the LONGEST key only, with shorter keys masked out — ~10x less Python
     bytecode per key at ingest batch sizes.  Returns uint32 hashes."""
-    import numpy as np
-
     bs = [k.encode("utf-8") for k in keys]
     n = len(bs)
     L = max((len(b) for b in bs), default=0)
@@ -81,13 +81,22 @@ class ModelTable:
         # read-side caches (e.g. the DOT merged range index) key on it
         self.version = 0
         self._listeners: List = []  # change listeners (e.g. the top-k index)
+        # parallel list: optional batched callbacks, one entry per listener
+        # (None = fall back to per-key fn inside put_many)
+        self._batch_listeners: List = []
 
-    def add_change_listener(self, fn) -> None:
+    def add_change_listener(self, fn, batch_fn=None) -> None:
         """Register fn(key) to be called on every put.  Callbacks run on
         the writer thread under the table lock — keep them O(1) (the top-k
-        index just records the key in its dirty set)."""
+        index just records the key in its dirty set).
+
+        ``batch_fn(keys)``, when given, replaces the per-key calls for
+        batched ingest (``put_many``/``put_many_columns``): ONE callback
+        per chunk instead of one per row, so a listener can take its own
+        lock once per chunk (the top-k index's dirty set)."""
         with self._lock:
             self._listeners.append(fn)
+            self._batch_listeners.append(batch_fn)
 
     def shard_of(self, key: str) -> int:
         return _fnv1a(key) % self.n_shards
@@ -107,16 +116,67 @@ class ModelTable:
         pairs = list(pairs)
         if not pairs:
             return
-        shard_ids = _fnv1a_batch([k for k, _ in pairs]) % self.n_shards
+        self.put_many_columns([k for k, _ in pairs], [v for _, v in pairs])
+
+    def put_many_columns(self, keys, values, hashes=None) -> None:
+        """Columnar batched ingest: keys/values as parallel sequences.
+
+        The per-row Python work of ``put_many`` (tuple unpack, per-key
+        dict insert bytecode, per-key listener call) is replaced by a
+        stable shard-sort and ONE ``dict.update`` per touched shard, plus
+        one batched listener notification per chunk — the whole row loop
+        runs in C.  Last-writer-wins order is preserved: the sort is
+        stable, so within a shard duplicates keep input order.
+
+        ``hashes``, when given, is the per-key uint32 FNV-1a array (the
+        columnar chunk parser computes it from the raw bytes, skipping
+        the per-key encode of ``_fnv1a_batch``); it must match
+        ``_fnv1a(key)`` per key."""
+        n = len(keys)
+        if n == 0:
+            return
+        if not isinstance(keys, list):
+            keys = list(keys)
+        if n < 32:
+            # tiny batch: the argsort/array machinery costs more than the
+            # plain loop it replaces
+            shard_ids = (
+                _fnv1a_batch(keys) if hashes is None else hashes
+            ) % self.n_shards
+            with self._lock:
+                for key, value, sid in zip(keys, values, shard_ids):
+                    self._shards[sid][key] = value
+                self.puts += n
+                self.version += 1
+                self._notify_locked(keys)
+            return
+        shard_ids = (
+            _fnv1a_batch(keys) if hashes is None else hashes
+        ) % self.n_shards
+        order = np.argsort(shard_ids, kind="stable")
+        ks = np.asarray(keys, dtype=object)[order]
+        vs = np.asarray(values, dtype=object)[order]
+        bounds = np.searchsorted(
+            shard_ids[order], np.arange(self.n_shards + 1)
+        )
         with self._lock:
-            shards = self._shards
-            listeners = self._listeners
-            for (key, value), sid in zip(pairs, shard_ids):
-                shards[sid][key] = value
-                for fn in listeners:
-                    fn(key)
-            self.puts += len(pairs)
+            for sid in range(self.n_shards):
+                s, e = bounds[sid], bounds[sid + 1]
+                if s < e:
+                    self._shards[sid].update(
+                        zip(ks[s:e].tolist(), vs[s:e].tolist())
+                    )
+            self.puts += n
             self.version += 1
+            self._notify_locked(keys)
+
+    def _notify_locked(self, keys) -> None:
+        for fn, batch_fn in zip(self._listeners, self._batch_listeners):
+            if batch_fn is not None:
+                batch_fn(keys)
+            else:
+                for key in keys:
+                    fn(key)
 
     def get(self, key: str) -> Optional[str]:
         return self._shards[self.shard_of(key)].get(key)

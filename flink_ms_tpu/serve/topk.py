@@ -149,11 +149,21 @@ class DeviceFactorIndex:
         # back to counter-triggered full rebuilds.
         self._dirty_lock = threading.Lock()
         self._dirty: set = set()
+        # rows absorbed from replay-scale batches that are pending a full
+        # rebuild (a count, not keys: storing 1M keys per cold-start chunk
+        # in the dirty set was measured ingest overhead with zero value —
+        # the rebuild snapshots the whole table anyway)
+        self._replay_backlog = 0
         self._rebuild_thread: Optional[threading.Thread] = None
         self._counter_mode = not hasattr(table, "add_change_listener")
         self._built_at = -1
         if not self._counter_mode:
-            table.add_change_listener(self._on_put)
+            try:
+                # batched registration: ingest chunks notify once per chunk
+                # (one dirty-lock acquisition), not once per row
+                table.add_change_listener(self._on_put, self._on_put_many)
+            except TypeError:  # older table: per-key contract only
+                table.add_change_listener(self._on_put)
         # per-query work bound: at most this many dirty rows are parsed and
         # scattered on the query path; a backlog beyond the rebuild
         # threshold (a writer outrunning the query rate) is absorbed by ONE
@@ -173,6 +183,31 @@ class DeviceFactorIndex:
         if key.endswith(self.suffix) and not key.startswith("MEAN"):
             with self._dirty_lock:
                 self._dirty.add(key)
+
+    def _on_put_many(self, keys) -> None:  # writer thread, table lock held
+        """Batched change notification: the dirty lock is taken ONCE per
+        ingest chunk — the per-key lock acquisition was half the
+        listener-path ingest cost at replay scale.
+
+        Small batches run the exact suffix filter (one C-level
+        comprehension).  Replay-scale batches skip even that per-key pass:
+        a batch this size pushes the backlog past the rebuild threshold by
+        itself, so only a COUNT is recorded — the next query triggers one
+        background rebuild whose table snapshot (filtered by suffix there)
+        absorbs every absorbed row.  Filtering or storing 100k keys per
+        chunk at ingest would be pure wasted time on the writer thread."""
+        if len(keys) >= self.rebuild_backlog:
+            with self._dirty_lock:
+                self._replay_backlog += len(keys)
+            return
+        suffix = self.suffix
+        relevant = [
+            k for k in keys
+            if k.endswith(suffix) and not k.startswith("MEAN")
+        ]
+        if relevant:
+            with self._dirty_lock:
+                self._dirty.update(relevant)
 
     def _drain_dirty(self, limit: Optional[int] = None) -> set:
         with self._dirty_lock:
@@ -259,6 +294,8 @@ class DeviceFactorIndex:
 
         # keys changed while we snapshot stay dirty for the next query
         self._drain_dirty()
+        with self._dirty_lock:
+            self._replay_backlog = 0  # full build absorbs the replay rows
         ids, rows, width = self._snapshot_rows()
         self._ids = ids
         self._id_pos = {id_: i for i, id_ in enumerate(ids)}
@@ -284,22 +321,61 @@ class DeviceFactorIndex:
     def _apply_updates_locked(self, dirty: set, allow_rebuild: bool = True) -> None:
         """In-place device update of already-indexed rows; new ids kick one
         background rebuild and stay invisible (stale index) until it
-        lands."""
-        updates_pos, updates_vec = [], []
+        lands.
+
+        The payload parse is vectorized: all in-index rows of the batch
+        are joined and parsed with ONE numpy C float pass into a (B, k)
+        matrix, then scattered into the device matrix in a single op —
+        per-row ``float()`` loops only run on the fallback path (payloads
+        with empty/non-numeric tokens), preserving its exact semantics."""
+        suffix = self.suffix
+        suffix_len = len(suffix)
+        k_real = self._k_real
+        candidates_pos, candidates_payload = [], []
+        slow: list = []  # (pos, payload) needing the per-row parse
         structural = False
         for key in dirty:
-            id_ = key[: -len(self.suffix)]
+            if not key.endswith(suffix) or key.startswith("MEAN"):
+                continue  # foreign key from an unfiltered replay batch
             payload = self.table.get(key)
             if payload is None:
                 continue
-            pos = self._id_pos.get(id_)
-            vec = [float(t) for t in payload.split(";") if t]
-            if pos is None or len(vec) != self._k_real:
-                structural = True  # new item (or width change): needs rebuild
+            pos = self._id_pos.get(key[:-suffix_len])
+            if pos is None:
+                structural = True  # new item: needs rebuild
                 continue
-            updates_pos.append(pos)
-            updates_vec.append(vec)
-        if updates_pos and self._matrix is not None:
+            p = payload.rstrip(";")
+            if p.count(";") + 1 == k_real and p:
+                candidates_pos.append(pos)
+                candidates_payload.append(p)
+            else:
+                slow.append((pos, payload))
+        updates_pos, updates_vec = [], []
+        if candidates_pos:
+            try:
+                flat = np.array(
+                    ";".join(candidates_payload).split(";"), dtype=np.float32
+                )
+                updates_pos = candidates_pos
+                updates_vec = flat.reshape(len(candidates_pos), k_real)
+            except ValueError:
+                # an empty/garbled token somewhere in the batch: re-route
+                # every candidate through the exact per-row path
+                slow.extend(zip(candidates_pos, candidates_payload))
+                updates_pos, updates_vec = [], []
+        if slow:
+            updates_pos = list(updates_pos)
+            updates_vec = (
+                [v for v in updates_vec] if len(updates_vec) else []
+            )
+            for pos, payload in slow:
+                vec = [float(t) for t in payload.split(";") if t]
+                if len(vec) != k_real:
+                    structural = True  # width change: needs rebuild
+                    continue
+                updates_pos.append(pos)
+                updates_vec.append(vec)
+        if len(updates_pos) and self._matrix is not None:
             m = len(updates_pos)
             self._scatter_rows_locked(updates_pos, updates_vec)
             self.inplace_updates += m
@@ -325,13 +401,19 @@ class DeviceFactorIndex:
 
         def rebuild():
             drained = set()
+            replay_snap = 0
             try:
                 # drain BEFORE the snapshot: every drained key's latest
                 # value is then included in the snapshot by construction,
                 # while keys put during the snapshot re-enter the dirty set
                 # and survive the swap.  (Queries peek, never drain, while
-                # this thread is alive.)
+                # this thread is alive.)  The replay counter resets at the
+                # same moment: replay batches landing after this point
+                # re-arm it and trigger a follow-up rebuild.
                 drained = self._drain_dirty()
+                with self._dirty_lock:
+                    replay_snap = self._replay_backlog
+                    self._replay_backlog = 0
                 ids, rows, width = self._snapshot_rows()
                 matrix = self._pack(rows) if len(rows) else None
                 if matrix is not None:
@@ -356,6 +438,7 @@ class DeviceFactorIndex:
                 # keys) re-triggers a rebuild
                 with self._dirty_lock:
                     self._dirty |= drained
+                    self._replay_backlog += replay_snap
                 with self._lock:
                     self._peek_applied.clear()
                 print(f"[topk] background rebuild failed: {e}",
@@ -405,11 +488,12 @@ class DeviceFactorIndex:
                 if dirty:
                     self._apply_updates_locked(dirty, allow_rebuild=False)
                     self._peek_applied |= dirty
-            elif backlog > self.rebuild_backlog:
-                # writer is outrunning the query path: one background
-                # rebuild absorbs the whole backlog off-path (its
-                # snapshot reads current values; the peeked set stays
-                # for idempotent re-apply)
+            elif self._replay_backlog or backlog > self.rebuild_backlog:
+                # writer is outrunning the query path (or a replay-scale
+                # batch was absorbed by count): one background rebuild
+                # absorbs the whole backlog off-path (its snapshot reads
+                # current values; the peeked set stays for idempotent
+                # re-apply)
                 self._start_rebuild_locked()
             else:
                 dirty = self._drain_dirty(limit=self.apply_cap)

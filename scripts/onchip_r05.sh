@@ -50,12 +50,28 @@ run_step gather_probe_ml20m 600 python scripts/gather_kernel_probe.py \
   --nnz 5000000 --w 128 --table 27000 --k 64
 # row-tile sweep on the winning shape (only if the probe step SUCCEEDED
 # and the kernel compiled — a timeout/crash leaves no FAILED marker but
-# must not trigger 20 more minutes of sweeps against a wedged chip)
+# must not trigger 20 more minutes of sweeps against a wedged chip).
+# "Winning" = the probe table where the pallas kernel shows the larger
+# win over xla (smaller pallas/xla ratio) — that is the shape where tile
+# tuning has the most to gain; an unparseable or FAILED probe leaves the
+# 12000-row default.
+pick_ratio() { # logfile -> pallas_ms/xla_ms, empty if either is missing
+  awk '/^ *xla:/ {x=$2} /^ *pallas:/ {p=$2} \
+       END {if (x+0 > 0 && p+0 > 0) printf "%.6f", p / x}' "$1" 2>/dev/null
+}
 if [ "$probe_rc" -eq 0 ] && ! grep -q FAILED "$OUT/gather_probe_small.log"; then
+  TILE_TABLE=12000
+  r_small=$(pick_ratio "$OUT/gather_probe_small.log")
+  r_ml20m=$(pick_ratio "$OUT/gather_probe_ml20m.log")
+  if [ -n "$r_small" ] && [ -n "$r_ml20m" ] && \
+     awk -v a="$r_ml20m" -v b="$r_small" 'BEGIN {exit !(a < b)}'; then
+    TILE_TABLE=27000
+  fi
+  log "row-tile sweep table=$TILE_TABLE (pallas/xla small=${r_small:-n/a} ml20m=${r_ml20m:-n/a})"
   run_step gather_tile16 600 python scripts/gather_kernel_probe.py \
-    --nnz 5000000 --w 128 --table 12000 --k 64 --row-tile 16
+    --nnz 5000000 --w 128 --table "$TILE_TABLE" --k 64 --row-tile 16
   run_step gather_tile32 600 python scripts/gather_kernel_probe.py \
-    --nnz 5000000 --w 128 --table 12000 --k 64 --row-tile 32
+    --nnz 5000000 --w 128 --table "$TILE_TABLE" --k 64 --row-tile 32
 fi
 
 # 3. ALS assembly A/B at the 5M-nnz probe config (the r3 solver-matrix

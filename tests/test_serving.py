@@ -204,7 +204,9 @@ def test_checkpoint_restart_replays_from_offset(tmp_path):
         assert _wait_until(lambda: backend.restore(ModelTable(8)) is not None)
 
         # simulate a task failure by making the next poll raise once
-        original = journal.read_from
+        # (read_bytes_from is the shared byte-level read under BOTH the
+        # scalar and columnar ingest paths)
+        original = journal.read_bytes_from
         calls = {"n": 0}
 
         def flaky(offset, max_bytes=1 << 24):
@@ -213,7 +215,7 @@ def test_checkpoint_restart_replays_from_offset(tmp_path):
                 raise OSError("injected failure")
             return original(offset, max_bytes)
 
-        journal.read_from = flaky
+        journal.read_bytes_from = flaky
         journal.append([F.format_als_row(100, "U", [4.2])])
         assert _wait_until(lambda: job.table.get("100-U") == "4.2", timeout=15)
         assert len(job.table) == 21
@@ -235,7 +237,7 @@ def test_latest_restart_without_checkpoint_keeps_seed_offset(tmp_path):
         host="127.0.0.1", port=0, poll_interval_s=0.01,
         restart_delay_s=0.05, start_from="latest",
     )
-    original = journal.read_from
+    original = journal.read_bytes_from
     calls = {"n": 0}
 
     def flaky(offset, max_bytes=1 << 24):
@@ -244,7 +246,7 @@ def test_latest_restart_without_checkpoint_keeps_seed_offset(tmp_path):
             raise OSError("injected failure")
         return original(offset, max_bytes)
 
-    journal.read_from = flaky
+    journal.read_bytes_from = flaky
     job.start()
     try:
         journal.append([F.format_als_row(99, "U", [4.2])])
@@ -264,7 +266,9 @@ def test_restart_budget_exhaustion_stops_job(tmp_path):
         host="127.0.0.1", port=0,
         restart_attempts=2, restart_delay_s=0.01, poll_interval_s=0.01,
     )
-    journal.read_from = lambda *a, **k: (_ for _ in ()).throw(OSError("down"))
+    journal.read_bytes_from = (
+        lambda *a, **k: (_ for _ in ()).throw(OSError("down"))
+    )
     job.start()
     assert _wait_until(lambda: job._stop.is_set(), timeout=5)
     job.stop()
