@@ -1388,3 +1388,134 @@ def run_serving_ingest_section(small: bool) -> dict:
         return out
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Serving-HA section: availability under replica failure, R=1 vs R=2
+# ---------------------------------------------------------------------------
+
+def run_serving_ha_section(small: bool) -> dict:
+    """Availability under replica failure: spawn the HA serving plane
+    (serve/ha.py) at replication 1 and 2, SIGKILL one replica a third of
+    the way through a sustained closed-loop query stream, and report error
+    rate / latency percentiles / recovery time per arm.  R=1 reproduces
+    the reference design's single-owner outage (queries fail until the
+    supervisor respawns and replays); R=2 is the zero-client-visible-
+    errors contract pinned by tests/test_ha_serving.py."""
+    import signal
+    import threading
+
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.serve import registry
+    from flink_ms_tpu.serve.client import RetryPolicy
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve.ha import ReplicaSupervisor
+    from flink_ms_tpu.serve.journal import Journal
+
+    n_users = int(os.environ.get("BENCH_HA_USERS", 500 if small else 5_000))
+    duration_s = float(
+        os.environ.get("BENCH_HA_DURATION_S", 6 if small else 20))
+    workers = int(os.environ.get("BENCH_HA_WORKERS", 2))
+
+    tmp = tempfile.mkdtemp(prefix="bench_ha_")
+    # fast liveness cadence so detection/recovery fit the bench window; the
+    # spawned replicas inherit these via the environment
+    saved = {key: os.environ.get(key) for key in
+             ("TPUMS_HEARTBEAT_S", "TPUMS_REPLICA_TTL_S",
+              "TPUMS_REGISTRY_DIR")}
+    os.environ["TPUMS_HEARTBEAT_S"] = os.environ.get(
+        "BENCH_HA_HEARTBEAT_S", "0.2")
+    os.environ["TPUMS_REPLICA_TTL_S"] = os.environ.get(
+        "BENCH_HA_TTL_S", "1.2")
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    out = {}
+    try:
+        journal = Journal(os.path.join(tmp, "bus"), "models")
+        rng = np.random.default_rng(0)
+        dim = 8
+        journal.append(
+            [F.format_als_row(u, "U", rng.normal(size=dim))
+             for u in range(n_users)]
+            + [F.format_als_row(i, "I", rng.normal(size=dim))
+               for i in range(n_users)])
+        keys = [f"{u}-U" for u in range(n_users)]
+
+        for replication in (1, 2):
+            tag = f"r{replication}"
+            sup = ReplicaSupervisor(
+                workers, replication, journal.dir, "models",
+                os.path.join(tmp, f"ports-{tag}"), state_backend="memory",
+                check_interval_s=registry.heartbeat_interval_s(),
+                respawn_delay_s=0.1)
+            ms, counts = [], {"ok": 0, "err": 0}
+            stop = threading.Event()
+
+            # tight retry budget (~30 ms of backoff): enough for R=2 to
+            # fail over to the sibling replica, NOT enough to ride out the
+            # R=1 respawn+replay outage — that contrast is the metric
+            def load():
+                rnd = np.random.default_rng(1)
+                with sup.client(
+                        retry=RetryPolicy(attempts=3, backoff_s=0.01,
+                                          max_backoff_s=0.1),
+                        timeout_s=10) as c:
+                    while not stop.is_set():
+                        key = keys[int(rnd.integers(len(keys)))]
+                        t0 = time.perf_counter()
+                        try:
+                            if c.query_state(ALS_STATE, key) is None:
+                                counts["err"] += 1
+                            else:
+                                counts["ok"] += 1
+                        except Exception:
+                            counts["err"] += 1
+                        ms.append((time.perf_counter() - t0) * 1000.0)
+
+            with sup.start():
+                assert sup.wait_all_ready(120), "HA cluster never ready"
+                t_end = time.time() + duration_s
+                th = threading.Thread(target=load, daemon=True)
+                th.start()
+                time.sleep(duration_s / 3.0)
+                victim = sup.procs[(0, 0)]
+                victim.send_signal(signal.SIGKILL)
+                t_kill = time.time()
+                _log(f"[bench:ha] {tag}: SIGKILL s0r0 pid={victim.pid}")
+                # recovery = kill -> a *new* pid for that replica slot is
+                # registered ready (fully replayed, HEALTH-gated)
+                t_ready = None
+                while time.time() < t_kill + 60:
+                    members = registry.resolve_replicas(sup.group_of(0))
+                    if any(e.get("replica") == 0 and e.get("ready")
+                           and e.get("pid") != victim.pid
+                           for e in members):
+                        t_ready = time.time()
+                        break
+                    time.sleep(0.05)
+                while time.time() < t_end:
+                    time.sleep(0.05)
+                stop.set()
+                th.join(timeout=30)
+
+            total = counts["ok"] + counts["err"]
+            out[f"serving_ha_{tag}_queries"] = total
+            out[f"serving_ha_{tag}_errors"] = counts["err"]
+            out[f"serving_ha_{tag}_availability"] = (
+                round(counts["ok"] / total, 6) if total else None)
+            out.update(
+                {f"serving_ha_{tag}_{q}_ms": v
+                 for q, v in _pcts(ms).items()})
+            out[f"serving_ha_{tag}_recovery_s"] = (
+                None if t_ready is None else round(t_ready - t_kill, 2))
+            _log(f"[bench:ha] {tag}: {total} queries, "
+                 f"{counts['err']} errors, availability "
+                 f"{out[f'serving_ha_{tag}_availability']}, recovery "
+                 f"{out[f'serving_ha_{tag}_recovery_s']}s")
+        return out
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -12,8 +12,52 @@ and continue — SGD.java:221-227).
 
 from __future__ import annotations
 
+import random
 import socket
+import time
+from dataclasses import dataclass
 from typing import Optional
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Connection-retry policy shared by ``QueryClient._roundtrip`` and the
+    HA failover path (``serve/ha.py``).
+
+    ``attempts`` counts TOTAL tries (1 = no retry).  Between failures the
+    delay grows exponentially from ``backoff_s`` (doubling per retry,
+    capped at ``max_backoff_s``) with up to ``jitter`` fractional noise so
+    a thundering herd of clients doesn't re-land in lockstep.  The default
+    — two attempts, zero backoff — is exactly the pre-HA behavior: one
+    immediate reconnect (server restart is expected; the serving job has
+    fixed-delay restart semantics)."""
+
+    attempts: int = 2
+    backoff_s: float = 0.0
+    max_backoff_s: float = 2.0
+    jitter: float = 0.25
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError("attempts must be >= 1")
+        if self.backoff_s < 0 or self.max_backoff_s < 0:
+            raise ValueError("backoff must be >= 0")
+        if not (0 <= self.jitter <= 1):
+            raise ValueError("jitter must be in [0, 1]")
+
+    def delay_s(self, failure_index: int) -> float:
+        """Sleep before retry #``failure_index`` (0-based: the delay after
+        the first failure)."""
+        base = min(self.backoff_s * (2.0 ** failure_index),
+                   self.max_backoff_s)
+        if base <= 0:
+            return 0.0
+        return base * (1.0 + self.jitter * random.random())
+
+    def sleep(self, failure_index: int) -> None:
+        d = self.delay_s(failure_index)
+        if d > 0:
+            time.sleep(d)
 
 
 class QueryClient:
@@ -23,12 +67,14 @@ class QueryClient:
         port: int = 6123,
         timeout_s: float = 5.0,
         job_id: Optional[str] = None,
+        retry: Optional[RetryPolicy] = None,
     ):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
         self.job_id = job_id  # accepted for reference-CLI parity; the local
         # lookup server serves a single job, so the id is informational
+        self.retry = retry or RetryPolicy()
         self._sock: Optional[socket.socket] = None
         self._rfile = None
 
@@ -39,21 +85,29 @@ class QueryClient:
         self._rfile = sock.makefile("rb")
 
     def _roundtrip(self, request: str) -> str:
-        if self._sock is None:
-            self._connect()
-        try:
-            self._sock.sendall(request.encode("utf-8") + b"\n")
-            line = self._rfile.readline()
-        except (BrokenPipeError, ConnectionResetError, OSError):
-            # one reconnect attempt (server restart is expected: the serving
-            # job has fixed-delay restart semantics)
-            self.close()
-            self._connect()
-            self._sock.sendall(request.encode("utf-8") + b"\n")
-            line = self._rfile.readline()
-        if not line:
-            raise ConnectionError("lookup server closed the connection")
-        return line.decode("utf-8").rstrip("\n")
+        """One request/reply exchange, retried per ``self.retry`` on
+        connection-class failures (reconnect + backoff between tries).
+        Safe because every verb is an idempotent read; an empty read
+        (server closed mid-exchange) counts as a retryable failure too."""
+        data = request.encode("utf-8") + b"\n"
+        failures = 0
+        while True:
+            try:
+                if self._sock is None:
+                    self._connect()
+                self._sock.sendall(data)
+                line = self._rfile.readline()
+                if not line:
+                    raise ConnectionError(
+                        "lookup server closed the connection")
+                return line.decode("utf-8").rstrip("\n")
+            except (BrokenPipeError, ConnectionResetError, ConnectionError,
+                    OSError):
+                self.close()
+                failures += 1
+                if failures >= self.retry.attempts:
+                    raise
+                self.retry.sleep(failures - 1)
 
     def query_state(self, name: str, key: str) -> Optional[str]:
         if "\t" in key or "\n" in key:
@@ -226,6 +280,19 @@ class QueryClient:
         if reply.startswith("C\t"):
             return int(reply[2:])
         raise RuntimeError(f"count failed: {reply}")
+
+    def health(self, name: str) -> dict:
+        """Liveness/readiness report of a state (the HEALTH verb): state
+        name, key count, ingest backlog in journal bytes, and whether the
+        serving job is ``ready`` (caught up) or still ``replaying`` its
+        journal after a (re)start.  Supervisors and load balancers gate
+        traffic on ``ready`` instead of inferring liveness from COUNT."""
+        reply = self._roundtrip(f"HEALTH\t{name}")
+        if not reply.startswith("H\t"):
+            raise RuntimeError(f"health failed: {reply}")
+        import json
+
+        return json.loads(reply[2:])
 
     def ping(self) -> str:
         return self._roundtrip("PING")

@@ -165,6 +165,8 @@ class ServingJob:
         start_from: str = "earliest",
         ingest_mode: Optional[str] = None,
         topk_index: bool = True,
+        replica_of: Optional[str] = None,
+        replica_index: Optional[int] = None,
     ):
         if start_from not in ("earliest", "latest"):
             raise ValueError("start_from must be earliest|latest")
@@ -218,9 +220,20 @@ class ServingJob:
         self.ingest_batches = 0
         self.ingest_apply_s = 0.0
         self.checkpoints_deferred = 0
+        # HA plane (serve/ha.py): membership in a replica set, announced
+        # through the registry so clients and supervisors can resolve the
+        # whole set by the logical shard-group id
+        self.replica_of = replica_of
+        self.replica_index = replica_index
+        # readiness gate: False until the consume loop has replayed the
+        # journal backlog that existed when it came up — a rejoining
+        # replica must never be routed traffic over a half-replayed table
+        self._ready = threading.Event()
+        self._hb_lock = threading.Lock()
         self._stopped = False
         self._stop = threading.Event()
         self._consumer_thread: Optional[threading.Thread] = None
+        self._hb_thread: Optional[threading.Thread] = None
         if native_server:
             # C++ epoll data plane reading the persistent store directly —
             # requires the native (rocksdb) backend, which owns the store
@@ -258,6 +271,7 @@ class ServingJob:
                 port=port,
                 job_id=self.job_id,
                 topk_handlers=topk_handlers,
+                health_fn=self.health,
             )
         self.port = self.server.port
 
@@ -275,15 +289,76 @@ class ServingJob:
         self.server.start()
         # announce jobId -> endpoint so clients resolve this job without
         # explicit port wiring (the reference's JobManager lookup,
-        # QueryClientHelper.java:82-92; best-effort by design)
-        from . import registry
-
-        registry.register(self.job_id, self.host, self.port, self.state_name)
+        # QueryClientHelper.java:82-92; best-effort by design), with a
+        # heartbeat contract: the entry promises a refresh within the TTL,
+        # so readers can treat a silent job as dead (serve/ha.py)
+        self._heartbeat_now()
         self._consumer_thread = threading.Thread(
             target=self._supervised_consume, name="journal-consumer", daemon=True
         )
         self._consumer_thread.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="registry-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
         return self
+
+    # -- liveness / readiness (HA plane surface) ---------------------------
+
+    @property
+    def ready(self) -> bool:
+        """True once the consume loop has caught up with the journal end
+        observed at (re)start — the gate a rejoining replica passes before
+        it may serve traffic."""
+        return self._ready.is_set()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        return self._ready.wait(timeout)
+
+    def backlog_bytes(self) -> int:
+        """Unconsumed journal bytes behind the producer's end offset."""
+        try:
+            return max(self.journal.end_offset() - self.offset, 0)
+        except OSError:
+            return 0
+
+    def health(self) -> dict:
+        """The HEALTH verb's payload (key count is added server-side)."""
+        ready = self.ready
+        return {
+            "state": self.state_name,
+            "job_id": self.job_id,
+            "ready": ready,
+            "status": "ready" if ready else "replaying",
+            "backlog_bytes": self.backlog_bytes(),
+            "offset": self.offset,
+            "ingest_path": self.ingest_path,
+            "replica_of": self.replica_of,
+            "replica": self.replica_index,
+        }
+
+    def _heartbeat_now(self) -> None:
+        from . import registry
+
+        # the lock makes read-ready + register atomic: without it the
+        # heartbeat thread can read ready=False, lose the CPU, and write
+        # that stale value AFTER the consume loop registered ready=True —
+        # readiness must be monotone once flipped
+        with self._hb_lock:
+            registry.register(
+                self.job_id, self.host, self.port, self.state_name,
+                replica_of=self.replica_of, replica=self.replica_index,
+                ready=self.ready, ttl_s=registry.replica_ttl_s(),
+            )
+
+    def _heartbeat_loop(self) -> None:
+        from . import registry
+
+        interval = registry.heartbeat_interval_s()
+        while not self._stop.wait(interval):
+            if self._stop.is_set():
+                break
+            self._heartbeat_now()
 
     def stop(self) -> None:
         # idempotent: wait() calls stop() on every exit path (SIGTERM
@@ -292,10 +367,15 @@ class ServingJob:
         if self._stopped:
             return
         self._stopped = True
+        self._stop.set()
+        # join the heartbeat BEFORE unregistering, or an in-flight refresh
+        # could resurrect the entry we just removed (it would linger until
+        # TTL expiry instead of vanishing with the job)
+        if self._hb_thread:
+            self._hb_thread.join(timeout=5)
         from . import registry
 
         registry.unregister(self.job_id)
-        self._stop.set()
         if self._consumer_thread:
             self._consumer_thread.join(timeout=10)
         self.server.stop()
@@ -352,11 +432,14 @@ class ServingJob:
                     )
                     # a dead job must not stay resolvable: drop the
                     # registry entry here too — embedded (non-CLI) jobs
-                    # have no wait() to run the full stop() for them
+                    # have no wait() to run the full stop() for them.
+                    # _stop is set FIRST so the heartbeat loop stands down
+                    # (a refresh racing this unregister would linger only
+                    # until TTL expiry — the registry's backstop)
+                    self._stop.set()
                     from . import registry
 
                     registry.unregister(self.job_id)
-                    self._stop.set()
                     return
                 print(
                     f"[serve:{self.state_name}] consume loop failed ({e}); "
@@ -394,6 +477,12 @@ class ServingJob:
     def _consume_loop(self) -> None:
         last_checkpoint = time.time()
         chunk_cap = self.CHUNK_CAP
+        # readiness target: the journal end when this loop came up.  Until
+        # the offset passes it, the table is mid-replay and the job reports
+        # "replaying" (registry ready=False) so no failover routes here.
+        # A supervised RESTART inside a live process keeps ready set — the
+        # table stayed warm and the server kept answering throughout.
+        ready_target = self.journal.end_offset() if not self.ready else 0
         while not self._stop.is_set():
             # native fast path: rocksdb-parity table + a standard parser +
             # no change listeners -> the whole chunk (parse, key-derive,
@@ -445,6 +534,15 @@ class ServingJob:
                 self.ingest_apply_s += time.perf_counter() - t0
             bytes_advanced = next_offset - self.offset
             self.offset = next_offset
+            if not self._ready.is_set() and (
+                not got_any or self.offset >= ready_target
+            ):
+                # caught up with the backlog that existed at start: flip to
+                # ready and push the flag to the registry immediately (the
+                # heartbeat cadence would otherwise delay failback by up to
+                # one interval)
+                self._ready.set()
+                self._heartbeat_now()
             now = time.time()
             if now - last_checkpoint >= self.checkpoint_interval_s:
                 # a full-chunk poll means we're inside a cold-start replay

@@ -1,5 +1,6 @@
 """Job location registry — the jobId->endpoint resolution the reference
-gets from its JobManager (VERDICT r3 missing #1).
+gets from its JobManager (VERDICT r3 missing #1), grown into the HA
+plane's liveness store.
 
 The reference's clients never name a server port: ``QueryClientHelper``
 connects to the JobManager (``--jobManagerHost``/``--jobManagerPort``) and
@@ -11,6 +12,22 @@ on stop, and clients resolve ``--jobId`` through it when no explicit
 ``--jobManagerPort`` is given.  Multiple serving jobs on one machine (or a
 shared filesystem) are therefore addressable by jobId alone, like the
 reference — no operator port wiring.
+
+Liveness (the HA subsystem, serve/ha.py): an entry may carry a heartbeat
+contract — ``ttl_s`` promises the writer refreshes ``heartbeat`` at least
+that often (``ServingJob`` re-registers on the ``TPUMS_HEARTBEAT_S``
+cadence).  Readers treat an entry whose heartbeat is past its promised TTL
+as dead, exactly like a locally-recorded pid that no longer exists; dead
+entries are garbage-collected on the next ``resolve()`` / ``list_jobs()``
+pass instead of lingering forever.  Entries WITHOUT ``ttl_s`` (manual
+registrations, older writers) are never TTL-checked — liveness there
+remains pid-based only, the pre-HA behavior.
+
+Replica sets: a replicated shard worker registers with ``replica_of`` (the
+logical shard group id, e.g. ``"mysvc/shard-0"``), ``replica`` (its index
+in the set) and ``ready`` (False while it is still replaying the journal —
+the readiness gate clients honor during failover).  ``resolve_replicas``
+returns the live members of a group.
 
 Location: ``TPUMS_REGISTRY_DIR`` (deployment/shared-FS override), else
 ``<tmpdir>/flink_ms_tpu_registry`` — the same host-local convention as the
@@ -24,7 +41,8 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 from ..core.params import Params
 
@@ -33,6 +51,27 @@ def registry_dir() -> str:
     return os.environ.get("TPUMS_REGISTRY_DIR") or os.path.join(
         tempfile.gettempdir(), "flink_ms_tpu_registry"
     )
+
+
+def heartbeat_interval_s() -> float:
+    """Registry heartbeat cadence (``TPUMS_HEARTBEAT_S``, default 1 s)."""
+    try:
+        return max(float(os.environ.get("TPUMS_HEARTBEAT_S", 1.0)), 0.05)
+    except ValueError:
+        return 1.0
+
+
+def replica_ttl_s() -> float:
+    """Staleness TTL for heartbeat-bearing entries (``TPUMS_REPLICA_TTL_S``,
+    default 5x the heartbeat interval).  The TTL must comfortably exceed
+    the heartbeat cadence or a GC'd entry flaps on every scheduler hiccup."""
+    try:
+        v = os.environ.get("TPUMS_REPLICA_TTL_S")
+        if v is not None:
+            return max(float(v), 0.1)
+    except ValueError:
+        pass
+    return 5.0 * heartbeat_interval_s()
 
 
 def _entry_path(job_id: str) -> str:
@@ -47,23 +86,47 @@ def _entry_path(job_id: str) -> str:
     return os.path.join(registry_dir(), f"{safe[:80]}-{digest}.json")
 
 
-def register(job_id: str, host: str, port: int, state_name: str) -> None:
-    """Record a serving job's endpoint (atomic write; best-effort)."""
+def register(
+    job_id: str,
+    host: str,
+    port: int,
+    state_name: str,
+    *,
+    replica_of: Optional[str] = None,
+    replica: Optional[int] = None,
+    ready: Optional[bool] = None,
+    ttl_s: Optional[float] = None,
+) -> None:
+    """Record a serving job's endpoint (atomic write; best-effort).
+
+    Re-registering IS the heartbeat: a writer that passed ``ttl_s`` calls
+    this again on its heartbeat cadence (full-entry atomic rewrite — no
+    read-modify-write race with a concurrent reaper)."""
     try:
         os.makedirs(registry_dir(), exist_ok=True)
         path = _entry_path(job_id)
         tmp = f"{path}.{os.getpid()}.tmp"
         import socket
 
+        entry = {
+            "job_id": job_id, "host": host, "port": int(port),
+            "state": state_name, "pid": os.getpid(),
+            # pid_host scopes the pid-liveness check: on a shared-FS
+            # registry a pid is only meaningful on the machine that
+            # recorded it (a wildcard bind says nothing about where)
+            "pid_host": socket.gethostname(),
+        }
+        if replica_of is not None:
+            entry["replica_of"] = replica_of
+        if replica is not None:
+            entry["replica"] = int(replica)
+        if ready is not None:
+            entry["ready"] = bool(ready)
+        if ttl_s is not None:
+            entry["ttl_s"] = float(ttl_s)
+            entry["heartbeat"] = time.time()
         with open(tmp, "w") as f:
-            json.dump({
-                "job_id": job_id, "host": host, "port": int(port),
-                "state": state_name, "pid": os.getpid(),
-                # pid_host scopes the pid-liveness check: on a shared-FS
-                # registry a pid is only meaningful on the machine that
-                # recorded it (a wildcard bind says nothing about where)
-                "pid_host": socket.gethostname(),
-            }, f)
+            json.dump(entry, f)
         os.replace(tmp, path)
     except OSError:
         pass
@@ -76,15 +139,57 @@ def unregister(job_id: str) -> None:
         pass
 
 
+def entry_is_dead(entry: dict, now: Optional[float] = None) -> bool:
+    """True when this entry's job is provably gone: a locally-recorded pid
+    that no longer exists, or a heartbeat contract (``ttl_s``) the writer
+    has broken.  Entries without either signal are presumed alive."""
+    pid = entry.get("pid")
+    if isinstance(pid, int) and _pid_is_ours_and_dead(entry):
+        return True
+    ttl = entry.get("ttl_s")
+    hb = entry.get("heartbeat")
+    if isinstance(ttl, (int, float)) and isinstance(hb, (int, float)):
+        if (time.time() if now is None else now) - hb > ttl:
+            return True
+    return False
+
+
+def _reap_if_unchanged(path: str, entry: dict) -> Optional[dict]:
+    """GC a dead entry, guarding the reap TOCTOU: a supervisor may have
+    re-registered the job at this path since our read — only unlink if the
+    file still carries the same (pid, heartbeat) we judged dead.  Returns
+    the FRESH entry when one replaced the dead one, else None."""
+    try:
+        with open(path) as f:
+            current = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (
+        isinstance(current, dict)
+        and current.get("pid") == entry.get("pid")
+        and current.get("heartbeat") == entry.get("heartbeat")
+    ):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    if isinstance(current, dict) and "port" in current \
+            and not entry_is_dead(current):
+        return current
+    return None
+
+
 def resolve(job_id: str) -> Optional[dict]:
     """-> the registered entry for job_id, or None.
 
     A SIGKILL'd ServingJob never runs its unregister cleanup, so an entry
-    recorded by THIS machine (pid_host matches) whose pid is dead is
-    treated as no-entry (and reaped) — clients then fall back to the
-    explicit-port defaults instead of getting connection-refused on a
-    stale endpoint.  Entries recorded elsewhere (shared-FS registry) are
-    never pid-checked: the pid is meaningless across machines."""
+    recorded by THIS machine (pid_host matches) whose pid is dead — or any
+    entry whose heartbeat contract has lapsed — is treated as no-entry
+    (and reaped): clients then fall back to the explicit-port defaults
+    instead of getting connection-refused on a stale endpoint.  Entries
+    recorded elsewhere (shared-FS registry) are never pid-checked: the pid
+    is meaningless across machines; their TTL still applies."""
     path = _entry_path(job_id)
     try:
         with open(path) as f:
@@ -93,25 +198,51 @@ def resolve(job_id: str) -> Optional[dict]:
         return None
     if not isinstance(entry, dict) or "port" not in entry:
         return None
-    pid = entry.get("pid")
-    if isinstance(pid, int) and _pid_is_ours_and_dead(entry):
-        # narrow the reap TOCTOU: a supervisor may have re-registered the
-        # job at this path since our read — only unlink if the file still
-        # carries the dead pid we just checked
+    if entry_is_dead(entry):
+        return _reap_if_unchanged(path, entry)
+    return entry
+
+
+def list_jobs(gc: bool = True) -> List[dict]:
+    """Every live entry in the registry (GC'ing dead ones on the way,
+    unless ``gc=False``).  The ops/discovery surface: replica resolution,
+    supervisors, and the chaos harness all build on this scan."""
+    out: List[dict] = []
+    try:
+        names = os.listdir(registry_dir())
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(registry_dir(), name)
         try:
             with open(path) as f:
-                current = json.load(f)
+                entry = json.load(f)
         except (OSError, ValueError):
-            return None
-        if current.get("pid") == pid:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            return None
-        return current if isinstance(current, dict) and "port" in current \
-            else None
-    return entry
+            continue
+        if not isinstance(entry, dict) or "port" not in entry:
+            continue
+        if entry_is_dead(entry):
+            if gc:
+                fresh = _reap_if_unchanged(path, entry)
+                if fresh is not None:
+                    out.append(fresh)
+            continue
+        out.append(entry)
+    return out
+
+
+def resolve_replicas(replica_of: str) -> List[dict]:
+    """Live members of a replica group, sorted by replica index.  Entries
+    whose ``ready`` flag is False are included (callers that must not send
+    traffic to a replaying replica filter on ``ready`` themselves — a
+    supervisor, by contrast, needs to see them to NOT respawn them)."""
+    members = [
+        e for e in list_jobs() if e.get("replica_of") == replica_of
+    ]
+    members.sort(key=lambda e: (e.get("replica", 0), e.get("job_id", "")))
+    return members
 
 
 def _pid_is_ours_and_dead(entry: dict) -> bool:

@@ -13,6 +13,10 @@ Line protocol over TCP (persistent connections, thread per client):
                                   hold a slice of the catalog)
               ``COUNT\\t<state_name>\\n``  (key count — ops/metrics surface
                                   and multi-process ingest barrier)
+              ``HEALTH\\t<state_name>\\n``  (liveness/readiness: state name,
+                                  key count, ingest backlog, replaying-vs-
+                                  ready — the HA plane's supervisor and
+                                  load-balancer surface, serve/ha.py)
               ``DOT\\t<state_name>\\t<range>\\t<fid>:<val>;...\\n``  (server-
                                   side sparse dot against range-partitioned
                                   SVM rows: the whole sparse query in ONE
@@ -31,6 +35,7 @@ Line protocol over TCP (persistent connections, thread per client):
                                   model rows are CSV/semicolon text)
               ``E\\t<msg>\\n``    error (unknown state name, bad request)
               ``C\\t<n>\\n``      COUNT reply
+              ``H\\t<json>\\n``   HEALTH reply (single-line JSON object)
               ``D\\t<dot>\\t<missing_buckets_csv>\\n``  DOT reply: float64
                                   repr of the partial dot over buckets
                                   present in the state; buckets with no
@@ -101,10 +106,15 @@ class LookupServer:
         port: int = 6123,
         job_id: str = "local",
         topk_handlers: Optional[Dict[str, object]] = None,
+        health_fn=None,
     ):
         self.tables = tables
         self.job_id = job_id
         self.topk_handlers = topk_handlers or {}
+        # HEALTH verb provider: a callable -> dict describing the owning
+        # job's liveness (ServingJob.health).  A bare server (tests, ad-hoc
+        # tables) synthesizes a minimal always-ready report instead.
+        self.health_fn = health_fn
         # DOT verb caches: per-payload parse cache (payload-string-keyed =
         # coherent by construction) feeding a per-state merged sorted index
         # keyed on the table's mutation version
@@ -327,6 +337,30 @@ class LookupServer:
             if table is None:
                 return f"E\tunknown state: {state}"
             return f"C\t{len(table)}"
+        if parts[0] == "HEALTH" and len(parts) == 2:
+            # liveness/readiness in ONE verb: key count, ingest backlog and
+            # the replaying-vs-ready flag, so supervisors and load
+            # balancers don't have to infer health from COUNT deltas
+            _, state = parts
+            table = self.tables.get(state)
+            if table is None:
+                return f"E\tunknown state: {state}"
+            import json as _json
+
+            try:
+                if self.health_fn is not None:
+                    report = dict(self.health_fn())
+                    report.setdefault("state", state)
+                else:
+                    report = {
+                        "state": state, "ready": True, "status": "ready",
+                        "backlog_bytes": 0,
+                    }
+                report["keys"] = len(table)
+                report.setdefault("job_id", self.job_id)
+                return "H\t" + _json.dumps(report)
+            except Exception as e:
+                return f"E\thealth failed: {e}"
         if parts[0] == "GET" and len(parts) == 3:
             _, state, key = parts
             table = self.tables.get(state)

@@ -22,11 +22,14 @@ a subtask restarts — while every other worker keeps serving.  A restarted
 worker restores its checkpoint and replays the journal from its committed
 offset, after which its keys resolve again.
 
-Worker CLI (one process per worker):
+Worker CLI (one process per worker; ``--replicaIndex``/``--jobGroup`` mark
+membership in an HA replica set — see ``serve/ha.py`` for the replicated
+launcher, heartbeat supervision and client failover):
 
     python -m flink_ms_tpu.serve.sharded --workerIndex 0 --numWorkers 3 \
         --journalDir DIR --topic T --stateBackend fs \
-        --checkpointDataUri DIR2 [--svm true] [--portFile P]
+        --checkpointDataUri DIR2 [--svm true] [--portFile P] \
+        [--replicaIndex 0 --jobGroup G]
 """
 
 from __future__ import annotations
@@ -333,12 +336,29 @@ def run_worker(params: Params) -> ServingJob:
     journal = Journal(
         params.get_required("journalDir"), params.get_required("topic")
     )
-    # each worker checkpoints its own slice: separate subdir per index so
+    # HA replica-set membership (serve/ha.py): a replicated worker carries
+    # its replica index and the logical shard-group id it serves, so the
+    # registry can resolve the whole set and the supervisor can respawn
+    # exactly the member that died
+    replica_index = params.get_int("replicaIndex", None)
+    job_group = params.get("jobGroup")
+    replica_of = None
+    if job_group or replica_index is not None:
+        group = job_group or "sharded"
+        replica_of = f"{group}/shard-{worker_index}"
+    # each worker checkpoints its own slice: separate subdir per index
+    # (and per replica — set members must never share a checkpoint dir) so
     # restarts restore the right partition
     uri = params.get("checkpointDataUri")
     if uri:
         uri = f"{uri.rstrip('/')}/worker-{worker_index}"
+        if replica_index is not None:
+            uri = f"{uri}-r{replica_index}"
     backend = make_backend(params.get("stateBackend", "memory"), uri)
+    default_job_id = (
+        f"{job_group or 'sharded'}:s{worker_index}r{replica_index}"
+        if replica_index is not None else f"worker-{worker_index}"
+    )
     job = ServingJob(
         journal,
         state_name,
@@ -348,25 +368,32 @@ def run_worker(params: Params) -> ServingJob:
         checkpoint_interval_ms=params.get_int("checkPointInterval", 60_000),
         host=params.get("host", "0.0.0.0"),
         port=params.get_int("port", 0),
-        job_id=params.get("jobId", f"worker-{worker_index}"),
+        job_id=params.get("jobId", default_job_id),
         # the C++ epoll plane per shard (requires --stateBackend rocksdb):
         # point lookups and catalog-scored TOPKV straight from each
         # worker's persistent store slice
         native_server=params.get_bool("nativeServer", False),
         ingest_mode=params.get("ingestMode"),
+        replica_of=replica_of,
+        replica_index=replica_index,
     ).start()
     print(
-        f"[serve:sharded] worker {worker_index}/{num_workers} "
-        f"({state_name}) on port {job.port}",
+        f"[serve:sharded] worker {worker_index}/{num_workers}"
+        + (f" replica {replica_index}" if replica_index is not None else "")
+        + f" ({state_name}) on port {job.port}",
         file=sys.stderr,
     )
     port_file = params.get("portFile")
     if port_file:
-        with open(port_file, "w") as f:
+        # atomic publish: launchers poll on file size, a plain write lets
+        # them read a partial JSON document
+        tmp_pf = port_file + ".tmp"
+        with open(tmp_pf, "w") as f:
             json.dump(
                 {"port": job.port, "workerIndex": worker_index,
-                 "jobId": job.job_id}, f
+                 "replicaIndex": replica_index, "jobId": job.job_id}, f
             )
+        os.replace(tmp_pf, port_file)
     return job
 
 

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Fault-injection harness for the HA serving plane (serve/ha.py): run a
+replicated shard cluster under a sustained query load while SIGKILLing
+random replicas at a configurable rate, and report what the clients saw —
+availability (success rate), latency percentiles, and per-kill recovery
+time (kill -> the respawned replica registers ready again).
+
+    python scripts/chaos_kill.py [env knobs below]
+
+Knobs (env):
+    CHAOS_WORKERS=2        shards
+    CHAOS_REPLICATION=2    replicas per shard (1 reproduces the reference's
+                           single-owner outage behavior)
+    CHAOS_DURATION_S=30    load window
+    CHAOS_KILL_EVERY_S=5   mean seconds between kills (0 disables)
+    CHAOS_THREADS=4        closed-loop client threads
+    CHAOS_USERS=200        model rows per type
+    TPUMS_HEARTBEAT_S / TPUMS_REPLICA_TTL_S: liveness cadence (defaults
+                           here: 0.25 / 1.5 — fast detection for a demo)
+
+Exit code 1 if any client-visible error occurred at replication >= 2
+(the zero-visible-errors contract), 0 otherwise.
+"""
+
+import json
+import os
+import random
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPUMS_HEARTBEAT_S", "0.25")
+os.environ.setdefault("TPUMS_REPLICA_TTL_S", "1.5")
+
+from flink_ms_tpu.core import formats as F  # noqa: E402
+from flink_ms_tpu.serve import registry  # noqa: E402
+from flink_ms_tpu.serve.client import RetryPolicy  # noqa: E402
+from flink_ms_tpu.serve.consumer import ALS_STATE  # noqa: E402
+from flink_ms_tpu.serve.ha import ReplicaSupervisor  # noqa: E402
+from flink_ms_tpu.serve.journal import Journal  # noqa: E402
+
+W = int(os.environ.get("CHAOS_WORKERS", 2))
+R = int(os.environ.get("CHAOS_REPLICATION", 2))
+DURATION_S = float(os.environ.get("CHAOS_DURATION_S", 30))
+KILL_EVERY_S = float(os.environ.get("CHAOS_KILL_EVERY_S", 5))
+THREADS = int(os.environ.get("CHAOS_THREADS", 4))
+N_USERS = int(os.environ.get("CHAOS_USERS", 200))
+
+
+def pcts(xs):
+    xs = sorted(xs)
+    if not xs:
+        return {}
+    return {f"p{q}": round(xs[min(int(len(xs) * q / 100), len(xs) - 1)], 3)
+            for q in (50, 95, 99)}
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="tpums_chaos_")
+    journal = Journal(os.path.join(base, "bus"), "models")
+    rng = np.random.default_rng(0)
+    k = 4
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=k))
+         for u in range(N_USERS)]
+        + [F.format_als_row(i, "I", rng.normal(size=k))
+           for i in range(N_USERS)]
+    )
+    keys = [f"{u}-U" for u in range(N_USERS)]
+
+    sup = ReplicaSupervisor(
+        W, R, journal.dir, "models", os.path.join(base, "ports"),
+        state_backend="memory",
+        check_interval_s=registry.heartbeat_interval_s(),
+        respawn_delay_s=0.1,
+    )
+    print(f"[chaos] spawning {W} shard(s) x {R} replica(s) "
+          f"(group {sup.job_group})", file=sys.stderr)
+    ok = [0] * THREADS
+    errs = [0] * THREADS
+    lat_ms = [[] for _ in range(THREADS)]
+    stop = threading.Event()
+    kills = []   # (t_kill, shard, replica)
+
+    def load(widx):
+        # one HAShardedClient per thread (the client is single-threaded by
+        # contract, like ShardedQueryClient)
+        c = sup.client(retry=RetryPolicy(
+            attempts=6, backoff_s=0.02, max_backoff_s=0.5), timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                t0 = time.perf_counter()
+                try:
+                    if c.query_state(ALS_STATE, key) is None:
+                        errs[widx] += 1
+                    else:
+                        ok[widx] += 1
+                except Exception:
+                    errs[widx] += 1
+                lat_ms[widx].append((time.perf_counter() - t0) * 1000.0)
+
+    with sup.start():
+        if not sup.wait_all_ready(120):
+            print("[chaos] cluster never became ready", file=sys.stderr)
+            return 2
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        for t in threads:
+            t.start()
+        t_end = time.time() + DURATION_S
+        next_kill = time.time() + (KILL_EVERY_S or float("inf"))
+        r = random.Random(42)
+        while time.time() < t_end:
+            time.sleep(0.05)
+            if KILL_EVERY_S and time.time() >= next_kill:
+                shard = r.randrange(W)
+                replica = r.randrange(R)
+                proc = sup.procs.get((shard, replica))
+                if proc is not None and proc.poll() is None:
+                    print(f"[chaos] SIGKILL s{shard}r{replica} "
+                          f"pid={proc.pid}", file=sys.stderr)
+                    proc.send_signal(signal.SIGKILL)
+                    kills.append((time.time(), shard, replica))
+                next_kill = time.time() + KILL_EVERY_S * (
+                    0.5 + r.random())
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        # recovery time per kill: kill -> a ready registry entry for that
+        # (shard, replica) with a spawn event newer than the kill
+        recoveries = []
+        for t_kill, shard, replica in kills:
+            respawned = [e for e in sup.events
+                         if e["action"] == "spawn" and e["t"] > t_kill
+                         and e["shard"] == shard
+                         and e["replica"] == replica]
+            if not respawned:
+                recoveries.append(None)
+                continue
+            deadline = time.time() + 60
+            t_ready = None
+            while time.time() < deadline:
+                members = registry.resolve_replicas(sup.group_of(shard))
+                if any(e.get("replica") == replica and e.get("ready")
+                       for e in members):
+                    t_ready = time.time()
+                    break
+                time.sleep(0.05)
+            recoveries.append(
+                None if t_ready is None else round(t_ready - t_kill, 2))
+
+    flat = [x for lane in lat_ms for x in lane]
+    total_ok, total_err = sum(ok), sum(errs)
+    total = total_ok + total_err
+    summary = {
+        "workers": W, "replication": R, "duration_s": DURATION_S,
+        "queries": total, "ok": total_ok, "errors": total_err,
+        "availability": round(total_ok / total, 6) if total else None,
+        "latency_ms": pcts(flat),
+        "kills": len(kills),
+        "respawns": sup.respawns,
+        "recovery_s": recoveries,
+    }
+    print(json.dumps(summary, indent=1))
+    return 1 if (R >= 2 and total_err) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
