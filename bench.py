@@ -1186,11 +1186,32 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         if fn is None:
             result[f"{name}_error"] = f"bench_sections.{fn_name} missing"
             continue
+        # bracket the section with registry snapshots: the sidecar detail
+        # record carries what the section actually exercised (counters
+        # moved, histogram mass added) next to its latency numbers.
+        # In-process series only — sections that spawn worker SUBPROCESSES
+        # contribute their client-side half here; worker-side series are
+        # scraped live via obs.scrape, not captured post-mortem.
+        snap_before = None
+        try:
+            from flink_ms_tpu.obs.metrics import diff_snapshots, get_registry
+
+            snap_before = get_registry().snapshot()
+        except Exception:
+            pass
         try:
             result.update(call(fn))
         except Exception:
             _log(traceback.format_exc())
             result[f"{name}_error"] = traceback.format_exc(limit=3)
+        if snap_before is not None:
+            try:
+                delta = diff_snapshots(
+                    snap_before, get_registry().snapshot())
+                if any(delta.values()):
+                    result[f"{name}_metrics_delta"] = delta
+            except Exception:
+                pass
     if recovery_enabled:
         try:
             try_recover_accelerator(result, orig_env, deadline, sections)

@@ -24,13 +24,18 @@ def _log(msg: str) -> None:
 
 
 def _pcts(ms: "list[float]") -> dict:
-    xs = sorted(ms)
+    """Latency percentiles (ms in, ms out) computed THROUGH the serving
+    plane's shared histogram ladder (obs.metrics.LATENCY_BUCKETS_S, 16
+    log buckets/decade): a bench p50 and a fleet-scraped serving p50 are
+    now the identical interpolated-bucket statistic instead of an exact
+    rank compared against a bucket estimate.  The ladder is seconds-
+    denominated, so convert at the boundary."""
+    from flink_ms_tpu.obs.metrics import bucketed_quantiles
 
-    def pct(q):
-        idx = max(int(np.ceil(q / 100.0 * len(xs))) - 1, 0)
-        return round(xs[min(idx, len(xs) - 1)], 3)
-
-    return {"p50": pct(50), "p95": pct(95), "p99": pct(99)}
+    p50, p95, p99 = bucketed_quantiles(
+        [m / 1e3 for m in ms], (50, 95, 99))
+    return {"p50": round(p50 * 1e3, 3), "p95": round(p95 * 1e3, 3),
+            "p99": round(p99 * 1e3, 3)}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,48 @@
+"""Serving-plane observability: metrics registry, request tracing, fleet
+scrape.
+
+- ``obs.metrics`` — process-wide counters/gauges/log-bucket histograms,
+  JSON snapshots, Prometheus exposition, snapshot merge/diff algebra.
+- ``obs.tracing`` — wire-propagated trace ids (``tid=`` tab field),
+  thread-local context, structured JSONL event log.
+- ``obs.scrape`` — registry-driven fleet scrape + per-shard aggregation.
+
+Knobs: ``TPUMS_METRICS=0`` disables collection (observations become one
+attribute check); ``TPUMS_TRACE=<path>`` mirrors events to a JSONL file
+(``-`` = stderr) in addition to the in-process ring buffer.
+"""
+
+from .metrics import (  # noqa: F401
+    LATENCY_BUCKETS_S,
+    SIZE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    bucketed_quantiles,
+    diff_snapshots,
+    get_registry,
+    log_buckets,
+    merge_snapshots,
+    metrics_enabled,
+    render_prometheus,
+    set_enabled,
+    snapshot_quantile,
+    snapshot_to_json_line,
+    synthesize_requests,
+)
+from .tracing import (  # noqa: F401
+    call_with_trace,
+    clear_events,
+    current_trace,
+    event,
+    events_counter,
+    load_events,
+    new_trace_id,
+    pop_tid,
+    recent_events,
+    set_trace,
+    stamp,
+    trace_span,
+    unstamp_reply,
+)

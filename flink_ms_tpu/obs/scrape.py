@@ -1,0 +1,128 @@
+"""Fleet scraper — walk the job registry, pull every live replica's
+METRICS snapshot, aggregate per shard and fleet-wide.
+
+This is the pull half of the Prometheus model applied to our file-based
+registry: the registry already knows every live endpoint (heartbeat TTLs
+GC the dead ones), so a scrape is ``list_jobs()`` + one ``METRICS`` verb
+round-trip per entry — no push agents, no sidecar config.  Aggregation is
+``metrics.merge_snapshots`` (sum counters/gauges, add histogram buckets),
+grouped by the ``replica_of`` shard-group id when present, so the output
+answers both "what is shard 1's p99" and "what is the fleet's p99" from
+one pass.
+
+Usable as a library (``scrape_fleet()`` — obs_smoke, tests, bench) and as
+a CLI::
+
+    python -m flink_ms_tpu.obs.scrape            # aggregated JSON
+    python -m flink_ms_tpu.obs.scrape --prom     # Prometheus exposition
+    python -m flink_ms_tpu.obs.scrape --raw      # per-replica snapshots
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import sys
+from typing import Dict, List, Optional
+
+from ..serve import registry
+from .metrics import merge_snapshots, render_prometheus, snapshot_quantile
+
+__all__ = ["scrape_endpoint", "scrape_fleet", "snapshot_quantile", "main"]
+
+
+def scrape_endpoint(host: str, port: int, timeout_s: float = 2.0
+                    ) -> Optional[dict]:
+    """One METRICS round-trip -> parsed snapshot dict, or None when the
+    endpoint is unreachable or doesn't speak the verb (e.g. the C++ native
+    server answers ``E``)."""
+    host = host or "localhost"
+    if host == "0.0.0.0":
+        host = "localhost"
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout_s) as sock:
+            sock.sendall(b"METRICS\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = sock.recv(1 << 16)
+                if not chunk:
+                    break
+                buf += chunk
+    except OSError:
+        return None
+    line = buf.decode("utf-8", "replace").strip()
+    if not line.startswith("J\t"):
+        return None
+    try:
+        snap = json.loads(line[2:])
+    except ValueError:
+        return None
+    return snap if isinstance(snap, dict) else None
+
+
+def scrape_fleet(timeout_s: float = 2.0) -> dict:
+    """Scrape every live registry entry and aggregate.
+
+    Returns::
+
+        {"replicas":  [{"job_id", "shard_group", "replica", "ready",
+                        "host", "port", "snapshot"|None}, ...],
+         "per_shard": {shard_group: merged-snapshot, ...},
+         "fleet":     merged-snapshot,
+         "scraped": N, "unreachable": M}
+
+    ``shard_group`` falls back to the job_id for unsharded jobs, so a
+    single standalone worker still aggregates sanely.
+    """
+    replicas: List[dict] = []
+    per_group: Dict[str, List[dict]] = {}
+    unreachable = 0
+    for entry in registry.list_jobs():
+        snap = scrape_endpoint(entry.get("host", "localhost"),
+                               entry["port"], timeout_s=timeout_s)
+        group = entry.get("replica_of") or entry.get("job_id", "?")
+        replicas.append({
+            "job_id": entry.get("job_id"),
+            "shard_group": group,
+            "replica": entry.get("replica"),
+            "ready": entry.get("ready"),
+            "host": entry.get("host"),
+            "port": entry.get("port"),
+            "snapshot": snap,
+        })
+        if snap is None:
+            unreachable += 1
+        else:
+            per_group.setdefault(group, []).append(snap)
+    all_snaps = [s for snaps in per_group.values() for s in snaps]
+    return {
+        "replicas": replicas,
+        "per_shard": {g: merge_snapshots(s) for g, s in per_group.items()},
+        "fleet": merge_snapshots(all_snaps),
+        "scraped": len(all_snaps),
+        "unreachable": unreachable,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    prom = "--prom" in argv
+    raw = "--raw" in argv
+    result = scrape_fleet()
+    if prom:
+        sys.stdout.write(render_prometheus(result["fleet"]))
+    elif raw:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(json.dumps({
+            "scraped": result["scraped"],
+            "unreachable": result["unreachable"],
+            "per_shard": result["per_shard"],
+            "fleet": result["fleet"],
+        }, indent=2, default=str))
+    return 0 if result["scraped"] or not result["unreachable"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,227 @@
+"""Dapper-style request tracing over the tab-separated wire protocol.
+
+A trace is a 16-hex-char id that a client stamps onto a request as a
+trailing ``tid=<id>`` tab field, the server echoes back, and every hop in
+between (shard fan-out threads, HA failover retries, the microbatch
+dispatcher) records against as structured **events**: one JSON object per
+event with ``ts``/``tid``/``kind`` plus free-form span fields (queue wait,
+batch size, device seconds).  Reconstructing one slow request end to end
+is then a filter of the event log by tid.
+
+Wire compatibility is the hard constraint: the seed protocol's servers
+validate field counts strictly (``len(parts) == 3`` etc.), so the tid
+field is ONLY appended while a trace context is active — untraced traffic
+stays byte-identical in both directions, and old servers never see the
+extra field unless an operator opts a client in.
+
+Context is thread-local because the serving stack is thread-per-connection
+and the sharded clients fan out on pool threads; ``call_with_trace``
+captures the submitting thread's tid so pool workers inherit it
+explicitly (thread-locals do not cross ``ThreadPoolExecutor.submit``).
+
+Event sinks, controlled by ``TPUMS_TRACE``:
+
+- unset/``0`` — events still go to a small in-process ring buffer (cheap:
+  one dict + deque append), which is what the in-process tests read;
+- a path — additionally appended as JSONL to that file (``-`` = stderr),
+  which is what ``scripts/chaos_kill.py`` and multi-process smoke runs
+  use to correlate across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+from . import metrics as _metrics
+
+TID_FIELD = "tid="
+_RING_CAP = 4096
+
+_local = threading.local()
+_ring_lock = threading.Lock()
+_ring: Deque[dict] = deque(maxlen=_RING_CAP)
+_file_lock = threading.Lock()
+_file_handle = None
+_file_path_cached: Optional[str] = None
+
+
+def new_trace_id() -> str:
+    """16 hex chars — wide enough to never collide within a bench run,
+    short enough to cost one small tab field on the wire."""
+    return secrets.token_hex(8)
+
+
+# ---------------------------------------------------------------------------
+# thread-local context
+# ---------------------------------------------------------------------------
+
+def current_trace() -> Optional[str]:
+    return getattr(_local, "tid", None)
+
+
+def set_trace(tid: Optional[str]) -> Optional[str]:
+    """Install ``tid`` as this thread's trace context -> previous value."""
+    prev = getattr(_local, "tid", None)
+    _local.tid = tid
+    return prev
+
+
+class trace_span:
+    """``with trace_span() as tid:`` — installs a (fresh or given) trace id
+    for the block and restores the previous context on exit."""
+
+    __slots__ = ("tid", "_prev")
+
+    def __init__(self, tid: Optional[str] = None):
+        self.tid = tid or new_trace_id()
+        self._prev = None
+
+    def __enter__(self) -> str:
+        self._prev = set_trace(self.tid)
+        return self.tid
+
+    def __exit__(self, *exc) -> None:
+        set_trace(self._prev)
+
+
+def call_with_trace(tid: Optional[str], fn: Callable, *args, **kwargs):
+    """Run ``fn`` with ``tid`` installed — the pool-submit adapter used by
+    the sharded/HA fan-out (``pool.submit(call_with_trace, tid, fn, ...)``)
+    so worker threads inherit the submitting request's context."""
+    if tid is None:
+        return fn(*args, **kwargs)
+    prev = set_trace(tid)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        set_trace(prev)
+
+
+# ---------------------------------------------------------------------------
+# wire helpers
+# ---------------------------------------------------------------------------
+
+def stamp(request: str, tid: Optional[str] = None) -> str:
+    """Append ``\\ttid=<id>`` when a trace is active; otherwise return the
+    request untouched (the byte-compatibility guarantee lives here)."""
+    tid = tid if tid is not None else current_trace()
+    if tid is None:
+        return request
+    return f"{request}\t{TID_FIELD}{tid}"
+
+
+def unstamp_reply(reply: str, tid: str) -> str:
+    """Strip the server's tid echo off a reply.  Only the exact suffix for
+    the id we sent is removed, so payloads that legitimately contain tabs
+    (MGET) cannot be corrupted."""
+    suffix = f"\t{TID_FIELD}{tid}"
+    if reply.endswith(suffix):
+        return reply[: -len(suffix)]
+    return reply
+
+
+def pop_tid(parts: List[str]) -> Optional[str]:
+    """Server side: remove and return a trailing ``tid=`` field from a
+    split request line (mutates ``parts``); None when untraced."""
+    if len(parts) >= 2 and parts[-1].startswith(TID_FIELD):
+        return parts.pop()[len(TID_FIELD):]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def _trace_file() -> Optional[str]:
+    v = os.environ.get("TPUMS_TRACE", "").strip()
+    if v in ("", "0", "1"):
+        return None
+    return v
+
+
+def event(kind: str, tid: Optional[str] = None, **fields) -> dict:
+    """Record one structured event.  Always lands in the in-process ring;
+    additionally appended as one JSON line to ``TPUMS_TRACE`` when that is
+    a path.  Returns the event dict (chaos_kill prints it)."""
+    ev: Dict = {"ts": time.time(),
+                "tid": tid if tid is not None else current_trace(),
+                "kind": kind}
+    ev.update(fields)
+    with _ring_lock:
+        _ring.append(ev)
+    path = _trace_file()
+    if path is not None:
+        line = json.dumps(ev, separators=(",", ":"), default=str)
+        if path == "-":
+            print(line, file=sys.stderr)
+        else:
+            _append_line(path, line)
+    return ev
+
+
+def _append_line(path: str, line: str) -> None:
+    global _file_handle, _file_path_cached
+    with _file_lock:
+        if _file_handle is None or _file_path_cached != path:
+            if _file_handle is not None:
+                try:
+                    _file_handle.close()
+                except OSError:
+                    pass
+            _file_handle = open(path, "a", buffering=1)
+            _file_path_cached = path
+        _file_handle.write(line + "\n")
+
+
+def recent_events(tid: Optional[str] = None,
+                  kind: Optional[str] = None) -> List[dict]:
+    """Snapshot the ring buffer, optionally filtered by tid and/or kind —
+    the in-process way to reconstruct a request chain."""
+    with _ring_lock:
+        evs = list(_ring)
+    if tid is not None:
+        evs = [e for e in evs if e.get("tid") == tid]
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs
+
+
+def clear_events() -> None:
+    with _ring_lock:
+        _ring.clear()
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse a JSONL event file (cross-process correlation: chaos runs,
+    obs_smoke).  Malformed lines are skipped, not fatal — the file is
+    append-shared across processes."""
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def events_counter(kind: str, **labels) -> None:
+    """Event + matching counter in one call — supervisor transitions use
+    this so 'respawn happened' is both a countable series and a
+    reconstructable timeline entry."""
+    event(kind, **labels)
+    _metrics.get_registry().counter(
+        "tpums_events_total", kind=kind).inc()

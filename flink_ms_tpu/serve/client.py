@@ -18,6 +18,8 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from ..obs import tracing as obs_tracing
+
 
 @dataclass(frozen=True)
 class RetryPolicy:
@@ -88,7 +90,19 @@ class QueryClient:
         """One request/reply exchange, retried per ``self.retry`` on
         connection-class failures (reconnect + backoff between tries).
         Safe because every verb is an idempotent read; an empty read
-        (server closed mid-exchange) counts as a retryable failure too."""
+        (server closed mid-exchange) counts as a retryable failure too.
+
+        When a trace context is active (``obs.tracing``), the request is
+        stamped with a trailing ``tid=`` field, the server's echo is
+        stripped off the reply before any parsing (so tab-bearing payloads
+        like MGET stay intact), and a ``client_rpc`` span event records
+        the round-trip — including retries, which is how a failover shows
+        up in a request's event chain.  With no context active the wire
+        bytes are identical to the seed protocol."""
+        tid = obs_tracing.current_trace()
+        if tid is not None:
+            request = f"{request}\t{obs_tracing.TID_FIELD}{tid}"
+            t0 = time.perf_counter()
         data = request.encode("utf-8") + b"\n"
         failures = 0
         while True:
@@ -100,11 +114,23 @@ class QueryClient:
                 if not line:
                     raise ConnectionError(
                         "lookup server closed the connection")
-                return line.decode("utf-8").rstrip("\n")
+                reply = line.decode("utf-8").rstrip("\n")
+                if tid is not None:
+                    reply = obs_tracing.unstamp_reply(reply, tid)
+                    obs_tracing.event(
+                        "client_rpc", tid=tid,
+                        verb=request.split("\t", 1)[0],
+                        host=self.host, port=self.port, retries=failures,
+                        lat_s=round(time.perf_counter() - t0, 6))
+                return reply
             except (BrokenPipeError, ConnectionResetError, ConnectionError,
-                    OSError):
+                    OSError) as e:
                 self.close()
                 failures += 1
+                if tid is not None:
+                    obs_tracing.event(
+                        "client_retry", tid=tid, host=self.host,
+                        port=self.port, attempt=failures, error=str(e))
                 if failures >= self.retry.attempts:
                     raise
                 self.retry.sleep(failures - 1)
@@ -184,6 +210,14 @@ class QueryClient:
                 raise ValueError("requests must be single lines")
         if window < 1:
             raise ValueError("window must be >= 1")
+        tid = obs_tracing.current_trace()
+        if tid is not None:
+            # one tid for the whole window: the server's per-request span
+            # events all carry it, so a pipelined fan-out leg is still one
+            # reconstructable chain
+            suffix = f"\t{obs_tracing.TID_FIELD}{tid}"
+            requests = [req + suffix for req in requests]
+            t0 = time.perf_counter()
         if self._sock is None:
             self._connect()
         replies, sent = [], 0
@@ -209,6 +243,12 @@ class QueryClient:
                     "lookup server closed the connection mid-pipeline"
                 )
             replies.append(line.decode("utf-8").rstrip("\n"))
+        if tid is not None:
+            replies = [obs_tracing.unstamp_reply(r, tid) for r in replies]
+            obs_tracing.event(
+                "client_pipeline", tid=tid, host=self.host, port=self.port,
+                n=len(requests), window=window,
+                lat_s=round(time.perf_counter() - t0, 6))
         return replies
 
     def topk_pipelined(self, name: str, user_ids, k: int,
@@ -290,6 +330,17 @@ class QueryClient:
         reply = self._roundtrip(f"HEALTH\t{name}")
         if not reply.startswith("H\t"):
             raise RuntimeError(f"health failed: {reply}")
+        import json
+
+        return json.loads(reply[2:])
+
+    def metrics(self) -> dict:
+        """The server process's full metrics snapshot (the METRICS verb):
+        counters/gauges/histograms as the ``obs.metrics`` snapshot schema.
+        The C++ native plane doesn't speak the verb (answers ``E``)."""
+        reply = self._roundtrip("METRICS")
+        if not reply.startswith("J\t"):
+            raise RuntimeError(f"metrics failed: {reply}")
         import json
 
         return json.loads(reply[2:])

@@ -37,6 +37,8 @@ from typing import Callable, List, Optional, Tuple
 
 from ..core.formats import CHUNK_ALS, CHUNK_SVM, split_journal_chunk
 from ..core.params import Params
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
 from .journal import Journal
 from .server import LookupServer
 from .table import ModelTable, _fnv1a_batch
@@ -220,6 +222,26 @@ class ServingJob:
         self.ingest_batches = 0
         self.ingest_apply_s = 0.0
         self.checkpoints_deferred = 0
+        # registry instruments (obs/): the ingest plane as scrapeable
+        # series — labeled by state name only (a replica fleet is one job
+        # per process; in-process test jobs share series and assert deltas)
+        reg = obs_metrics.get_registry()
+        st = state_name
+        self._obs_rows = reg.counter("tpums_ingest_rows_total", state=st)
+        self._obs_batches = reg.counter(
+            "tpums_ingest_batches_total", state=st)
+        self._obs_parse_errors = reg.counter(
+            "tpums_ingest_parse_errors_total", state=st)
+        self._obs_apply = reg.histogram(
+            "tpums_ingest_apply_seconds", state=st)
+        self._obs_backlog = reg.gauge(
+            "tpums_journal_backlog_bytes", state=st)
+        self._obs_rows_per_s = reg.gauge("tpums_ingest_rows_per_s", state=st)
+        self._obs_ckpt = reg.counter("tpums_checkpoints_total", state=st)
+        self._obs_ckpt_deferred = reg.gauge(
+            "tpums_checkpoints_deferred", state=st)
+        self._obs_ready_flips = reg.counter(
+            "tpums_ready_transitions_total", state=st)
         # HA plane (serve/ha.py): membership in a replica set, announced
         # through the registry so clients and supervisors can resolve the
         # whole set by the logical shard-group id
@@ -424,6 +446,11 @@ class ServingJob:
                 return  # clean stop
             except Exception as e:
                 attempts += 1
+                obs_tracing.events_counter(
+                    "consume_restart" if attempts <= self.restart_attempts
+                    else "consume_giveup",
+                    state=self.state_name, job_id=self.job_id,
+                    attempt=attempts, error=str(e))
                 if attempts > self.restart_attempts:
                     print(
                         f"[serve:{self.state_name}] giving up after "
@@ -493,6 +520,8 @@ class ServingJob:
             # same starvation bound as the Python path's row-sliced chunks.
             native_mode = getattr(self.parse_fn, "native_mode", None)
             columnar_mode = getattr(self.parse_fn, "columnar_mode", None)
+            rows_before = self.ingest_rows
+            errs_before = self.parse_errors
             t0 = time.perf_counter()
             if (
                 native_mode is not None
@@ -531,9 +560,28 @@ class ServingJob:
                     self._apply_lines(lines)
                     self.ingest_batches += 1
             if got_any:
-                self.ingest_apply_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                self.ingest_apply_s += dt
+                if obs_metrics.metrics_enabled():
+                    rows = self.ingest_rows - rows_before
+                    self._obs_rows.inc(rows)
+                    self._obs_batches.inc(1)
+                    self._obs_parse_errors.inc(
+                        self.parse_errors - errs_before)
+                    self._obs_apply.observe(dt)
+                    if dt > 0:
+                        self._obs_rows_per_s.set(rows / dt)
             bytes_advanced = next_offset - self.offset
             self.offset = next_offset
+            if got_any and obs_metrics.metrics_enabled():
+                # journal lag behind the producer's end offset — the gauge
+                # a scrape reads to see a replica falling behind.  Only
+                # polls that ingested re-stat the journal: backlog can
+                # only change when the producer appends, and the very
+                # next poll reads that — an idle caught-up loop pays no
+                # per-poll stat (it would steal GIL slices from the
+                # serving threads for a gauge that cannot have moved)
+                self._obs_backlog.set(self.backlog_bytes())
             if not self._ready.is_set() and (
                 not got_any or self.offset >= ready_target
             ):
@@ -543,6 +591,11 @@ class ServingJob:
                 # one interval)
                 self._ready.set()
                 self._heartbeat_now()
+                self._obs_ready_flips.inc()
+                obs_tracing.event(
+                    "ready", state=self.state_name, job_id=self.job_id,
+                    offset=self.offset, replica_of=self.replica_of,
+                    replica=self.replica_index)
             now = time.time()
             if now - last_checkpoint >= self.checkpoint_interval_s:
                 # a full-chunk poll means we're inside a cold-start replay
@@ -557,9 +610,11 @@ class ServingJob:
                 )
                 if backlog and not overdue:
                     self.checkpoints_deferred += 1
+                    self._obs_ckpt_deferred.set(self.checkpoints_deferred)
                 else:
                     self.backend.snapshot(self.table, self.offset)
                     last_checkpoint = now
+                    self._obs_ckpt.inc()
             if not got_any:
                 self._stop.wait(self.poll_interval_s)
 

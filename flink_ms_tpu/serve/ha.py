@@ -58,6 +58,8 @@ import uuid
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.params import Params
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
 from . import registry
 from .client import QueryClient, RetryPolicy
 from .sharded import owner_of
@@ -172,6 +174,9 @@ class HAShardedClient:
         self.seq_fanout_keys = seq_fanout_keys
         self.failovers = 0      # observability: replica-switch count
         self.refreshes = 0
+        reg = obs_metrics.get_registry()
+        self._obs_failovers = reg.counter("tpums_client_failovers_total")
+        self._obs_refreshes = reg.counter("tpums_client_refreshes_total")
         self._shards = [_ShardSet() for _ in range(num_workers)]
         from concurrent.futures import ThreadPoolExecutor
 
@@ -189,6 +194,7 @@ class HAShardedClient:
         eps = list(self._resolver(shard))
         ss.last_refresh = now
         self.refreshes += 1
+        self._obs_refreshes.inc()
         if eps == ss.endpoints:
             return
         # close clients of endpoints that left the set (a respawned
@@ -258,6 +264,14 @@ class HAShardedClient:
                     if ss.prefer == ep:
                         ss.prefer = None
                     self.failovers += 1
+                    self._obs_failovers.inc()
+                    # a failover under an active trace joins the request's
+                    # event chain — the retry that follows carries the
+                    # SAME tid to the next replica, so the chain shows
+                    # both the dead endpoint and the one that answered
+                    obs_tracing.event(
+                        "failover", shard=shard, op=op,
+                        host=ep[0], port=ep[1], error=str(e))
                     failures += 1
                     if failures >= self.retry.attempts:
                         raise
@@ -301,8 +315,13 @@ class HAShardedClient:
             return out
         from concurrent.futures import wait as _futures_wait
 
+        # pool threads don't inherit thread-local trace context: capture
+        # the submitting request's tid NOW and re-install it per task, so
+        # every shard leg of a traced fan-out carries the same id
+        tid = obs_tracing.current_trace()
         futures = {
             w: self._pool.submit(
+                obs_tracing.call_with_trace, tid,
                 self._call, w, "query_states", name,
                 [keys[p] for p in positions],
             )
@@ -331,8 +350,14 @@ class HAShardedClient:
         vecs = [payloads[i] for i in known]
         from concurrent.futures import wait as _futures_wait
 
+        tid = obs_tracing.current_trace()
+        if tid is not None:
+            obs_tracing.event(
+                "fanout", tid=tid, op="topk_many",
+                shards=self.num_workers, queries=len(known), k=k)
         futs = [
             self._pool.submit(
+                obs_tracing.call_with_trace, tid,
                 self._call, w, "topk_by_vector_pipelined", name, vecs, k)
             for w in range(self.num_workers)
         ]
@@ -532,6 +557,9 @@ class ReplicaSupervisor:
             "t": time.time(), "shard": shard, "replica": replica,
             "action": "spawn", "port": port,
         })
+        obs_tracing.events_counter(
+            "replica_spawn", group=self.job_group, shard=shard,
+            replica=replica, port=port)
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.check_interval_s):
@@ -564,6 +592,9 @@ class ReplicaSupervisor:
                         "t": now, "shard": shard, "replica": replica,
                         "action": "heartbeat_expired",
                     })
+                    obs_tracing.events_counter(
+                        "replica_heartbeat_expired", group=self.job_group,
+                        shard=shard, replica=replica)
             if not dead:
                 self._due.pop(key, None)
                 continue
@@ -575,6 +606,9 @@ class ReplicaSupervisor:
                 "t": now, "shard": shard, "replica": replica,
                 "action": "respawn",
             })
+            obs_tracing.events_counter(
+                "replica_respawn", group=self.job_group, shard=shard,
+                replica=replica)
             try:
                 self._spawn(shard, replica)
                 self.respawns += 1

@@ -37,6 +37,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+
 
 def batching_enabled() -> bool:
     return os.environ.get("TPUMS_TOPK_BATCH", "1") != "0"
@@ -45,9 +47,16 @@ def batching_enabled() -> bool:
 class PendingTopK:
     """One enqueued query: the submitting handler thread parks on
     ``wait()`` while the dispatcher scores the coalesced batch and
-    scatters results (or the per-group error) back."""
+    scatters results (or the per-group error) back.
 
-    __slots__ = ("vec", "k", "result", "error", "_event")
+    Span fields (filled in by the dispatcher, read by the server's trace
+    epilogue when the request carried a tid): ``queue_wait_s`` — enqueue
+    to dispatch pick-up; ``batch_size`` — queries sharing the dispatch;
+    ``device_s`` — the group's scoring time.  Together they decompose a
+    slow top-k into waiting vs computing vs everything else."""
+
+    __slots__ = ("vec", "k", "result", "error", "_event",
+                 "t_enqueue", "queue_wait_s", "batch_size", "device_s")
 
     def __init__(self, vec: np.ndarray, k: int):
         self.vec = vec
@@ -55,6 +64,10 @@ class PendingTopK:
         self.result: Optional[List[Tuple[str, float]]] = None
         self.error: Optional[BaseException] = None
         self._event = threading.Event()
+        self.t_enqueue = time.perf_counter()
+        self.queue_wait_s: Optional[float] = None
+        self.batch_size: Optional[int] = None
+        self.device_s: Optional[float] = None
 
     def _finish(self, result=None, error=None) -> None:
         self.result = result
@@ -117,6 +130,13 @@ class TopKBatcher:
         self.batched_queries = 0
         self.max_batch_seen = 0
         self.inline_singles = 0
+        # registry instruments (shared process-wide series; the ad-hoc
+        # ints above remain the zero-cost test hooks)
+        reg = obs_metrics.get_registry()
+        self._obs_queue_wait = reg.histogram("tpums_topk_queue_wait_seconds")
+        self._obs_batch_size = reg.histogram(
+            "tpums_topk_batch_size", bounds=obs_metrics.SIZE_BUCKETS)
+        self._obs_device = reg.histogram("tpums_topk_device_seconds")
 
     # -- submit side --------------------------------------------------------
 
@@ -149,8 +169,20 @@ class TopKBatcher:
         if inline:
             try:
                 self.inline_singles += 1
-                pending._finish(result=self.index.topk(pending.vec,
-                                                       pending.k))
+                t0 = time.perf_counter()
+                result = self.index.topk(pending.vec, pending.k)
+                pending.queue_wait_s = 0.0
+                pending.batch_size = 1
+                pending.device_s = time.perf_counter() - t0
+                # no registry observation here: an inline single's queue
+                # wait is 0 and its device time is within a constant of
+                # the verb latency the server already histograms, while
+                # even one extra locked observation is measurable on a
+                # ~0.1 ms round trip (README overhead A/B).  The span
+                # fields above still feed traced requests; batched
+                # dispatches — where these series carry information —
+                # record all three in _dispatch.
+                pending._finish(result=result)
             except BaseException as e:
                 pending._finish(error=e)
             finally:
@@ -224,6 +256,7 @@ class TopKBatcher:
         for p in batch:
             groups.setdefault((p.k, p.vec.shape), []).append(p)
         for (k, _shape), group in groups.items():
+            t_disp = time.perf_counter()
             try:
                 if len(group) == 1:
                     # a lone query runs the exact single-query program, so
@@ -241,9 +274,19 @@ class TopKBatcher:
                 for p in group:
                     p._finish(error=e)
                 continue
+            device_s = time.perf_counter() - t_disp
             self.dispatches += 1
             self.batched_queries += len(group)
             if len(group) > self.max_batch_seen:
                 self.max_batch_seen = len(group)
+            metrics_on = obs_metrics.metrics_enabled()
+            if metrics_on:
+                self._obs_batch_size.observe(len(group))
+                self._obs_device.observe(device_s)
             for p, result in zip(group, results):
+                p.queue_wait_s = t_disp - p.t_enqueue
+                p.batch_size = len(group)
+                p.device_s = device_s
+                if metrics_on:
+                    self._obs_queue_wait.observe(p.queue_wait_s)
                 p._finish(result=result)

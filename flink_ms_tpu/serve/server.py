@@ -25,6 +25,11 @@ Line protocol over TCP (persistent connections, thread per client):
                                   of the reference's range partitioning,
                                   RangePartitionSVMPredict.java:63,80-101,
                                   which still pays one RPC per bucket)
+              ``METRICS\\n``  (process-wide observability snapshot — every
+                                  counter/gauge/histogram the obs/ registry
+                                  holds, as one JSON line; the Prometheus
+                                  text rendering of the same snapshot is a
+                                  client-side transform, obs/scrape.py)
               ``PING\\n``
     response: ``V\\t<value>\\n``   key found / top-k payload ``item:score;...``
               ``N\\n``            unknown key (client maps to Optional.empty,
@@ -41,7 +46,18 @@ Line protocol over TCP (persistent connections, thread per client):
                                   present in the state; buckets with no
                                   row listed so clients can keep the
                                   reference's missing-range console output
+              ``J\\t<json>\\n``   METRICS reply (single-line JSON snapshot)
               ``PONG\\t<job_id>\\t<state_name>\\n``
+
+Tracing (obs/tracing.py): any request MAY carry a trailing ``tid=<id>``
+tab field; the server strips it before verb dispatch (handlers see the
+seed protocol's exact field counts), records a ``server_reply`` span
+event (verb, latency, and — for microbatched top-k — queue wait, batch
+size, device seconds) and echoes ``tid=<id>`` back on the reply line.
+Untraced traffic is byte-identical to the seed protocol in both
+directions; the C++ native plane answers ``E`` to traced requests and
+METRICS (documented, not parity-tested — tracing targets the Python
+plane).
 
 The batched verb exists to beat the reference's serving hot spot: its online
 SGD pays two Netty round trips per rating (SGD.java:172-173) and its MSE job
@@ -74,28 +90,42 @@ from __future__ import annotations
 
 import socketserver
 import threading
+import time
 from typing import Dict, Optional
 
 from ..core.formats import RangePayloadCache, gather_sorted, sort_dedup_last
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
 from .table import ModelTable
 
 
 class _DeferredReply:
     """A reply whose value is still in flight in the top-k microbatcher.
     ``resolve()`` parks until the dispatcher scatters the result back and
-    renders the same wire reply the synchronous path would have."""
+    renders the same wire reply the synchronous path would have.
 
-    __slots__ = ("_resolver",)
+    ``post`` (set by ``_dispatch_async``) runs at resolve time — that is
+    the only moment a deferred verb's true latency is known, so metric
+    observation, span events and the tid echo all live there; it receives
+    the rendered reply plus the resolver (whose ``pending`` attribute,
+    when present, carries the microbatcher's span fields)."""
+
+    __slots__ = ("_resolver", "post")
 
     def __init__(self, resolver):
         self._resolver = resolver
+        self.post = None
 
     def resolve(self) -> str:
         try:
             payload = self._resolver()
         except Exception as e:
-            return f"E\ttopk failed: {e}"
-        return "N" if payload is None else f"V\t{payload}"
+            reply = f"E\ttopk failed: {e}"
+        else:
+            reply = "N" if payload is None else f"V\t{payload}"
+        if self.post is not None:
+            reply = self.post(reply, self._resolver)
+        return reply
 
 
 class LookupServer:
@@ -122,6 +152,12 @@ class LookupServer:
         self._dot_merged: Dict[str, tuple] = {}
         self._dot_build_lock = threading.Lock()
         self.requests = 0  # observability; also lets tests assert round trips
+        # per-verb instrument cache: (requests counter, latency histogram,
+        # error counter), created lazily so only verbs actually served
+        # appear in the exposition
+        self._obs_verbs: Dict[str, tuple] = {}
+        self._obs_burst = obs_metrics.get_registry().histogram(
+            "tpums_server_burst_size", bounds=obs_metrics.SIZE_BUCKETS)
         # live persistent connections + their handler threads: clients hold
         # sockets open across many requests, so TCPServer.shutdown() alone
         # leaves handlers serving AFTER stop() returns — the round-3 long
@@ -196,6 +232,13 @@ class LookupServer:
                             buf.clear()
                         if not lines:
                             return
+                        if len(lines) > 1:
+                            # only multi-line bursts are recorded: a
+                            # single-line burst is the complement
+                            # (requests_total minus the histogram count)
+                            # and observing the constant 1 per request is
+                            # measurable on a ~0.1 ms round trip
+                            outer._obs_burst.observe(len(lines))
                         # submit ALL, then resolve in order
                         replies = [
                             outer._dispatch_async(ln, burst=len(lines))
@@ -229,6 +272,7 @@ class LookupServer:
             daemon_threads = True
 
         self._server = Server((host, port), Handler)
+        self.host = host
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -323,9 +367,87 @@ class LookupServer:
         ``burst`` is the number of lines in the read burst this line
         belongs to — burst members must enqueue rather than take the
         batcher's idle inline path, or the burst serializes back into
-        singles."""
+        singles.
+
+        Also the observability choke point: pops an optional trailing
+        ``tid=`` trace field FIRST (so every verb handler below sees the
+        seed protocol's exact field counts — untraced traffic is
+        byte-identical in both directions), times the dispatch, feeds the
+        per-verb counter/latency instruments, and echoes the tid on the
+        reply.  Deferred top-k replies do all of that at resolve time via
+        the post hook, when their true latency is known."""
         self.requests += 1
         parts = line.split("\t")
+        tid = obs_tracing.pop_tid(parts)
+        verb = parts[0] if parts and parts[0] else "?"
+        t0 = time.perf_counter()
+        if verb == "METRICS" and len(parts) == 1:
+            return self._finish(verb, tid, t0, self._metrics_reply())
+        reply = self._handle(parts, burst)
+        if isinstance(reply, _DeferredReply):
+            reply.post = lambda rendered, resolver: self._finish(
+                verb, tid, t0, rendered, resolver)
+            return reply
+        return self._finish(verb, tid, t0, reply)
+
+    def _verb_obs(self, verb: str) -> tuple:
+        inst = self._obs_verbs.get(verb)
+        if inst is None:
+            reg = obs_metrics.get_registry()
+            inst = (
+                reg.histogram("tpums_server_latency_seconds", verb=verb),
+                reg.counter("tpums_server_errors_total", verb=verb),
+            )
+            self._obs_verbs[verb] = inst
+        return inst
+
+    def _finish(self, verb: str, tid: Optional[str], t0: float,
+                reply: str, resolver=None) -> str:
+        """Request epilogue: per-verb metrics, span event + tid echo for
+        traced requests.  ``resolver`` (deferred top-k only) may expose a
+        ``pending`` with the microbatcher's span fields — queue wait,
+        batch size, device seconds — which join the event so one slow
+        traced query shows WHERE its time went."""
+        dt = time.perf_counter() - t0
+        if obs_metrics.metrics_enabled():
+            # ONE locked observation per request: the per-verb request
+            # count is the latency histogram's count, and the
+            # ``tpums_server_requests_total`` counter series is
+            # synthesized from it at snapshot time (synthesize_requests)
+            # instead of paying a second lock on every request
+            latency, errors = self._verb_obs(verb)
+            latency.observe(dt)
+            if reply.startswith("E"):
+                errors.inc()
+        if tid is not None:
+            fields = {"verb": verb, "job_id": self.job_id,
+                      "port": self.port, "lat_s": round(dt, 6),
+                      "ok": not reply.startswith("E")}
+            pending = getattr(resolver, "pending", None)
+            if pending is not None:
+                for name in ("queue_wait_s", "batch_size", "device_s"):
+                    v = getattr(pending, name, None)
+                    if v is not None:
+                        fields[name] = round(v, 6) if isinstance(v, float) \
+                            else v
+            obs_tracing.event("server_reply", tid=tid, **fields)
+            reply = f"{reply}\t{obs_tracing.TID_FIELD}{tid}"
+        return reply
+
+    def _metrics_reply(self) -> str:
+        """The METRICS verb: the whole process-wide registry as ONE
+        JSON line (the protocol is line-framed; the Prometheus rendering
+        of the same snapshot is a client-side transform — obs/scrape.py)."""
+        try:
+            snap = obs_metrics.synthesize_requests(
+                obs_metrics.get_registry().snapshot(
+                    meta={"job_id": self.job_id, "port": self.port}))
+            return "J\t" + obs_metrics.snapshot_to_json_line(snap)
+        except Exception as e:
+            return f"E\tmetrics failed: {e}"
+
+    def _handle(self, parts, burst: int = 1):
+        """Verb dispatch over already-split fields (tid removed)."""
         if parts[0] == "PING":
             return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
         if parts[0] == "COUNT" and len(parts) == 2:
@@ -358,6 +480,12 @@ class LookupServer:
                     }
                 report["keys"] = len(table)
                 report.setdefault("job_id", self.job_id)
+                # pointer to this replica's metrics snapshot: same
+                # endpoint, METRICS verb (scrape clients need no extra
+                # port discovery)
+                report.setdefault(
+                    "metrics_uri",
+                    f"tpums://{self.host}:{self.port}/METRICS")
                 return "H\t" + _json.dumps(report)
             except Exception as e:
                 return f"E\thealth failed: {e}"

@@ -40,6 +40,7 @@ import sys
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..core.params import Params
+from ..obs import tracing as obs_tracing
 from .client import QueryClient
 from .consumer import (
     ALS_STATE,
@@ -148,8 +149,13 @@ class ShardedQueryClient:
             return out
         from concurrent.futures import wait as _futures_wait
 
+        # capture the submitting request's trace context: pool threads
+        # don't inherit thread-locals, and a traced fan-out must stamp
+        # every shard leg with the same tid (obs/tracing.py)
+        tid = obs_tracing.current_trace()
         futures = {
             w: self._pool.submit(
+                obs_tracing.call_with_trace, tid,
                 self._clients[w].query_states,
                 name, [keys[p] for p in positions],
             )
@@ -193,8 +199,15 @@ class ShardedQueryClient:
         vecs = [payloads[i] for i in known]
         from concurrent.futures import wait as _futures_wait
 
+        tid = obs_tracing.current_trace()
+        if tid is not None:
+            obs_tracing.event(
+                "fanout", tid=tid, op="topk_many",
+                shards=self.num_workers, queries=len(known), k=k)
         futs = [
-            self._pool.submit(c.topk_by_vector_pipelined, name, vecs, k)
+            self._pool.submit(
+                obs_tracing.call_with_trace, tid,
+                c.topk_by_vector_pipelined, name, vecs, k)
             for c in self._clients
         ]
         _futures_wait(futs)  # join all before any result() can raise

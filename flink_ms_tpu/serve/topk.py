@@ -654,7 +654,12 @@ class ALSTopkHandler:
         )
         if self.batching and self.batcher is not None:
             pending = self.batcher.submit(vec, k, allow_inline=(burst <= 1))
-            return lambda: _format_topk(pending.wait())
+            resolver = lambda: _format_topk(pending.wait())  # noqa: E731
+            # the server's trace epilogue reads the microbatcher's span
+            # fields (queue wait / batch size / device time) off the
+            # resolver when the request carried a tid
+            resolver.pending = pending
+            return resolver
         return lambda: _format_topk(self.index.topk(vec, k))
 
     def close(self) -> None:

@@ -85,6 +85,23 @@ class StepTimer:
         idx = max(math.ceil(q / 100.0 * len(xs)) - 1, 0)
         return xs[min(idx, len(xs) - 1)]
 
+    def merge(self, other: "StepTimer") -> "StepTimer":
+        """Absorb another timer's observations (combining per-worker
+        timers into one distribution — percentiles over the merged sample
+        are exact, unlike averaging per-worker percentiles).  Returns
+        self; ``other`` is untouched."""
+        self.durations_s.extend(other.durations_s)
+        return self
+
+    def to_histogram(self):
+        """This timer's observations bucketed into the serving plane's
+        shared latency ladder (``obs.metrics.LATENCY_BUCKETS_S``) — the
+        bridge that makes a bench percentile and a scraped serving
+        percentile estimates over the IDENTICAL bucketization."""
+        from ..obs.metrics import Histogram
+
+        return Histogram(self.name).fill(self.durations_s)
+
     def stats(self) -> Dict[str, float]:
         n = len(self.durations_s)
         total = sum(self.durations_s)
@@ -94,7 +111,9 @@ class StepTimer:
             "total_s": total,
             "mean_s": total / n if n else float("nan"),
             "p50_s": self.percentile(50),
+            "p90_s": self.percentile(90),
             "p99_s": self.percentile(99),
+            "p999_s": self.percentile(99.9),
         }
 
     def summary(self) -> str:

@@ -18,6 +18,13 @@ Knobs (env):
     TPUMS_HEARTBEAT_S / TPUMS_REPLICA_TTL_S: liveness cadence (defaults
                            here: 0.25 / 1.5 — fast detection for a demo)
 
+Kill/recovery timeline is logged as structured events through the
+observability event log (``flink_ms_tpu.obs.tracing``) — set
+``TPUMS_TRACE=<path>`` to persist the JSONL timeline, or ``-`` for
+stderr.  Latency percentiles go through the serving plane's shared
+bucketed-quantile helper, so they are the same statistic a fleet
+scrape would report.
+
 Exit code 1 if any client-visible error occurred at replication >= 2
 (the zero-visible-errors contract), 0 otherwise.
 """
@@ -39,6 +46,7 @@ os.environ.setdefault("TPUMS_HEARTBEAT_S", "0.25")
 os.environ.setdefault("TPUMS_REPLICA_TTL_S", "1.5")
 
 from flink_ms_tpu.core import formats as F  # noqa: E402
+from flink_ms_tpu.obs import bucketed_quantiles, event, recent_events  # noqa: E402
 from flink_ms_tpu.serve import registry  # noqa: E402
 from flink_ms_tpu.serve.client import RetryPolicy  # noqa: E402
 from flink_ms_tpu.serve.consumer import ALS_STATE  # noqa: E402
@@ -53,12 +61,11 @@ THREADS = int(os.environ.get("CHAOS_THREADS", 4))
 N_USERS = int(os.environ.get("CHAOS_USERS", 200))
 
 
-def pcts(xs):
-    xs = sorted(xs)
-    if not xs:
+def pcts(ms):
+    if not ms:
         return {}
-    return {f"p{q}": round(xs[min(int(len(xs) * q / 100), len(xs) - 1)], 3)
-            for q in (50, 95, 99)}
+    qs = bucketed_quantiles([m / 1e3 for m in ms], (50, 95, 99))
+    return {f"p{q}": round(v * 1e3, 3) for q, v in zip((50, 95, 99), qs)}
 
 
 def main() -> int:
@@ -80,8 +87,8 @@ def main() -> int:
         check_interval_s=registry.heartbeat_interval_s(),
         respawn_delay_s=0.1,
     )
-    print(f"[chaos] spawning {W} shard(s) x {R} replica(s) "
-          f"(group {sup.job_group})", file=sys.stderr)
+    event("chaos_start", workers=W, replication=R, group=sup.job_group,
+          duration_s=DURATION_S, kill_every_s=KILL_EVERY_S)
     ok = [0] * THREADS
     errs = [0] * THREADS
     lat_ms = [[] for _ in range(THREADS)]
@@ -109,7 +116,7 @@ def main() -> int:
 
     with sup.start():
         if not sup.wait_all_ready(120):
-            print("[chaos] cluster never became ready", file=sys.stderr)
+            event("chaos_abort", reason="cluster never became ready")
             return 2
         threads = [threading.Thread(target=load, args=(i,), daemon=True)
                    for i in range(THREADS)]
@@ -125,8 +132,8 @@ def main() -> int:
                 replica = r.randrange(R)
                 proc = sup.procs.get((shard, replica))
                 if proc is not None and proc.poll() is None:
-                    print(f"[chaos] SIGKILL s{shard}r{replica} "
-                          f"pid={proc.pid}", file=sys.stderr)
+                    event("chaos_kill", shard=shard, replica=replica,
+                          pid=proc.pid, group=sup.group_of(shard))
                     proc.send_signal(signal.SIGKILL)
                     kills.append((time.time(), shard, replica))
                 next_kill = time.time() + KILL_EVERY_S * (
@@ -155,8 +162,10 @@ def main() -> int:
                     t_ready = time.time()
                     break
                 time.sleep(0.05)
-            recoveries.append(
-                None if t_ready is None else round(t_ready - t_kill, 2))
+            rec = None if t_ready is None else round(t_ready - t_kill, 2)
+            event("chaos_recovery", shard=shard, replica=replica,
+                  recovery_s=rec, recovered=rec is not None)
+            recoveries.append(rec)
 
     flat = [x for lane in lat_ms for x in lane]
     total_ok, total_err = sum(ok), sum(errs)
@@ -169,6 +178,10 @@ def main() -> int:
         "kills": len(kills),
         "respawns": sup.respawns,
         "recovery_s": recoveries,
+        # full structured timeline (kills, recoveries, supervisor
+        # respawn/heartbeat events) from the in-process event ring
+        "timeline": [e for e in recent_events()
+                     if e["kind"].startswith(("chaos_", "replica_"))],
     }
     print(json.dumps(summary, indent=1))
     return 1 if (R >= 2 and total_err) else 0

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Measure the metrics-collection overhead on the serving top-k path:
+p50/p95 of TOPK round trips with TPUMS_METRICS on vs off, same process,
+same warm index, interleaved arms (ABAB) so drift hits both equally.
+
+    python scripts/obs_overhead_ab.py  [N_USERS=2000 N_Q=400 ROUNDS=4]
+
+The acceptance bar (README "Observability"): p50 overhead <= 3%.
+Percentiles route through the shared bucket ladder
+(``obs.metrics.bucketed_quantiles``), which works in BOTH arms — the
+off-arm only disables collection, not offline math.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_USERS = int(os.environ.get("N_USERS", 2000))
+N_ITEMS = int(os.environ.get("N_ITEMS", 2000))
+K = 16
+TOPK = 10
+N_Q = int(os.environ.get("N_Q", 400))
+ROUNDS = int(os.environ.get("ROUNDS", 4))
+
+
+def main() -> int:
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.obs.metrics import bucketed_quantiles, set_enabled
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import (
+        ALS_STATE,
+        ServingJob,
+        make_backend,
+        parse_als_record,
+    )
+    from flink_ms_tpu.serve.journal import Journal
+
+    tmp = tempfile.mkdtemp(prefix="tpums_obs_ab_")
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    journal = Journal(os.path.join(tmp, "bus"), "models")
+    rng = np.random.default_rng(0)
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=K)) for u in range(N_USERS)]
+        + [F.format_als_row(i, "I", rng.normal(size=K))
+           for i in range(N_ITEMS)]
+    )
+    job = ServingJob(
+        journal, ALS_STATE, parse_als_record, make_backend("memory", None),
+        host="127.0.0.1", port=0, poll_interval_s=0.01,
+    ).start()
+    try:
+        assert job.wait_ready(120)
+        lat = {"on": [], "off": []}
+        with QueryClient("127.0.0.1", job.port, timeout_s=600) as c:
+            c.topk(ALS_STATE, "1", TOPK)  # index build + jit, uncounted
+            for _ in range(50):           # warm the steady-state path
+                c.topk(ALS_STATE, "2", TOPK)
+            qrng = np.random.default_rng(1)
+            for r in range(ROUNDS):
+                # alternate arm order per round so drift (thermal, page
+                # cache, scheduler) debits both arms equally
+                order = ("on", "off") if r % 2 == 0 else ("off", "on")
+                for arm in order:
+                    set_enabled(arm == "on")
+                    for _ in range(N_Q):
+                        uid = str(int(qrng.integers(0, N_USERS)))
+                        t0 = time.perf_counter()
+                        c.topk(ALS_STATE, uid, TOPK)
+                        lat[arm].append(time.perf_counter() - t0)
+        set_enabled(True)
+        out = {}
+        for arm in ("on", "off"):
+            p50, p95 = bucketed_quantiles(lat[arm], (50, 95))
+            out[arm] = {"n": len(lat[arm]),
+                        "p50_ms": round(p50 * 1e3, 4),
+                        "p95_ms": round(p95 * 1e3, 4),
+                        # exact-rank percentiles for the overhead ratio —
+                        # the shared ladder's ~7%-wide buckets quantize
+                        # too coarsely to resolve a few-percent delta
+                        "exact_p50_ms": round(
+                            float(np.percentile(lat[arm], 50)) * 1e3, 4)}
+        out["p50_overhead_pct"] = round(
+            100.0 * (out["on"]["exact_p50_ms"]
+                     / out["off"]["exact_p50_ms"] - 1.0), 2)
+
+        # the socket-level ratio above rides ~±5% machine noise; the
+        # reproducible signal is the in-process dispatch delta — same
+        # verb path minus the kernel round trip — measured ABAB
+        srv = job.server
+        line = f"TOPK\t{ALS_STATE}\t7\t{TOPK}"
+        for _ in range(300):
+            srv._dispatch(line)
+        disp = {"on": [], "off": []}
+        for r in range(6):
+            order = ("on", "off") if r % 2 == 0 else ("off", "on")
+            for arm in order:
+                set_enabled(arm == "on")
+                xs = []
+                for _ in range(2000):
+                    t0 = time.perf_counter()
+                    srv._dispatch(line)
+                    xs.append(time.perf_counter() - t0)
+                disp[arm].append(float(np.percentile(xs, 50)) * 1e6)
+        set_enabled(True)
+        d_on = float(np.median(disp["on"]))
+        d_off = float(np.median(disp["off"]))
+        out["dispatch"] = {
+            "p50_on_us": round(d_on, 2), "p50_off_us": round(d_off, 2),
+            "delta_us": round(d_on - d_off, 2),
+            "overhead_pct": round(100.0 * (d_on / d_off - 1.0), 2),
+        }
+        print(json.dumps(out, indent=1))
+        return 0
+    finally:
+        job.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

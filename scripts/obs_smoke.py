@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""End-to-end observability smoke: start one real worker process, issue
+traced queries, scrape its metrics over the wire, and assert the core
+series moved.
+
+    python scripts/obs_smoke.py
+
+What it checks (exit 0 only if ALL hold):
+  1. a traced GET / MGET / TOPK round-trip succeeds and the trace id
+     comes back in the event ring (client_rpc + server-echoed tid);
+  2. ``METRICS`` scrape of the worker returns per-verb request counters
+     > 0 and a latency histogram with count > 0;
+  3. the registry-driven fleet scrape (``obs.scrape.scrape_fleet``)
+     reaches the worker and the merged fleet snapshot carries the same
+     non-zero series;
+  4. the Prometheus rendering of the scraped snapshot contains the
+     ``tpums_server_requests_total`` and ``_bucket`` series.
+
+Knobs: CHAOS-style env not needed — this is a fixed 1-worker smoke.
+Set ``TPUMS_TRACE=-`` to watch the structured event log on stderr.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N = 64
+K = 4
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="tpums_obs_smoke_")
+    # private registry so the fleet scrape sees exactly this worker
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.obs import (
+        recent_events,
+        render_prometheus,
+        trace_span,
+    )
+    from flink_ms_tpu.obs.scrape import scrape_endpoint, scrape_fleet
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve.journal import Journal
+    from flink_ms_tpu.serve.sharded import spawn_worker_procs
+
+    journal = Journal(os.path.join(tmp, "bus"), "models")
+    rng = np.random.default_rng(0)
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=K)) for u in range(N)]
+        + [F.format_als_row(i, "I", rng.normal(size=K)) for i in range(N)]
+    )
+
+    failures = []
+
+    def check(cond, what):
+        tag = "ok" if cond else "FAIL"
+        print(f"[smoke] {tag}: {what}", file=sys.stderr)
+        if not cond:
+            failures.append(what)
+
+    procs, ports = spawn_worker_procs(
+        1, journal.dir, "models", port_dir=tmp, state_backend="memory"
+    )
+    port = ports[0]
+    try:
+        with QueryClient("127.0.0.1", port, timeout_s=60) as c:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if c.health(ALS_STATE).get("ready"):
+                    break
+                time.sleep(0.1)
+            check(c.health(ALS_STATE).get("ready"), "worker became ready")
+            check(
+                "metrics_uri" in c.health(ALS_STATE),
+                "HEALTH advertises metrics_uri",
+            )
+
+            # --- traced queries -------------------------------------
+            with trace_span() as tid:
+                got = c.query_state(ALS_STATE, "1-U")
+                many = c.query_states(ALS_STATE, ["2-U", "3-I"])
+                top = c.topk(ALS_STATE, "1", 5)
+            check(got is not None, "traced GET answered")
+            check(len(many) == 2, "traced MGET answered")
+            check(len(top) == 5, "traced TOPK answered")
+            chain = recent_events(tid=tid)
+            kinds = [e["kind"] for e in chain]
+            check(
+                kinds.count("client_rpc") >= 3,
+                f"event chain has >=3 client_rpc spans under one tid "
+                f"(got {kinds})",
+            )
+
+            # --- wire scrape ----------------------------------------
+            snap = scrape_endpoint("127.0.0.1", port)
+            check(snap is not None, "METRICS scrape reachable")
+            series = {}
+            hists = {}
+            if snap:
+                for ctr in snap["counters"]:
+                    series[(ctr["name"], ctr["labels"].get("verb"))] = (
+                        ctr["value"]
+                    )
+                for h in snap["histograms"]:
+                    hists[(h["name"], h["labels"].get("verb"))] = h["count"]
+            check(
+                series.get(("tpums_server_requests_total", "GET"), 0) > 0,
+                "scraped GET request counter > 0",
+            )
+            check(
+                series.get(("tpums_server_requests_total", "TOPK"), 0) > 0,
+                "scraped TOPK request counter > 0",
+            )
+            check(
+                hists.get(("tpums_server_latency_seconds", "GET"), 0) > 0,
+                "scraped GET latency histogram count > 0",
+            )
+
+            # --- fleet scrape + prometheus rendering ----------------
+            fleet = scrape_fleet()
+            check(
+                len(fleet["replicas"]) == 1 and not fleet["unreachable"],
+                "fleet scrape found the worker via the registry",
+            )
+            merged = fleet["fleet"]
+            merged_reqs = sum(
+                ctr["value"]
+                for ctr in merged.get("counters", [])
+                if ctr["name"] == "tpums_server_requests_total"
+            )
+            check(merged_reqs > 0, "merged fleet request total > 0")
+            prom = render_prometheus(merged) if merged else ""
+            check(
+                "tpums_server_requests_total{" in prom
+                and "tpums_server_latency_seconds_bucket{" in prom,
+                "prometheus rendering has counter + bucket series",
+            )
+            if snap:
+                print(
+                    json.dumps(
+                        {
+                            "port": port,
+                            "series": len(snap["counters"])
+                            + len(snap["gauges"])
+                            + len(snap["histograms"]),
+                            "failures": failures,
+                        }
+                    )
+                )
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+    if failures:
+        print(f"[smoke] {len(failures)} check(s) FAILED", file=sys.stderr)
+        return 1
+    print("[smoke] all checks passed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

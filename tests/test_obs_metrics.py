@@ -1,0 +1,240 @@
+"""Metrics registry (obs/metrics.py): bucket-boundary semantics, merge
+algebra (associativity — the fleet-scrape identity), exact totals under
+concurrent observation, the enable switch, Prometheus rendering, and the
+METRICS wire verb round-trip."""
+
+import json
+import socket
+import threading
+
+import pytest
+
+from flink_ms_tpu.obs import metrics as M
+from flink_ms_tpu.serve.client import QueryClient
+from flink_ms_tpu.serve.consumer import ALS_STATE
+from flink_ms_tpu.serve.server import LookupServer
+from flink_ms_tpu.serve.table import ModelTable
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder + histogram semantics
+# ---------------------------------------------------------------------------
+
+def test_log_buckets_boundaries():
+    b = M.log_buckets(1e-6, 100.0, 16)
+    assert b[0] == pytest.approx(1e-6)
+    assert b[-1] >= 100.0
+    # strictly increasing at the fixed per-decade ratio
+    ratio = 10.0 ** (1.0 / 16)
+    for lo, hi in zip(b, b[1:]):
+        assert hi == pytest.approx(lo * ratio, rel=1e-9)
+    # the shared ladder IS this call — bench and serving use one ladder
+    assert M.LATENCY_BUCKETS_S == b
+    with pytest.raises(ValueError):
+        M.log_buckets(0.0, 1.0)
+    with pytest.raises(ValueError):
+        M.log_buckets(1.0, 0.5)
+
+
+def test_histogram_le_bucket_placement():
+    h = M.Histogram("h", bounds=(1.0, 2.0, 4.0))
+    # Prometheus le semantics: v counts into the FIRST bucket with
+    # bound >= v; a value exactly on a bound belongs to that bound
+    h.observe(0.5)   # -> le=1.0
+    h.observe(1.0)   # -> le=1.0 (exact bound)
+    h.observe(1.5)   # -> le=2.0
+    h.observe(4.0)   # -> le=4.0
+    h.observe(100.0)  # -> +Inf overflow slot
+    assert h.counts() == [2, 1, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(0.5 + 1.0 + 1.5 + 4.0 + 100.0)
+    # +Inf quantile clamps to the last finite bound
+    assert h.quantile(100) == 4.0
+    with pytest.raises(ValueError):
+        M.Histogram("bad", bounds=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        h.quantile(101)
+
+
+def test_histogram_quantile_interpolates_within_bucket_width():
+    vals = [i / 1000.0 for i in range(1, 1001)]  # 1ms..1s uniform
+    h = M.Histogram("h").fill(vals)
+    ratio = 10.0 ** (1.0 / 16)
+    for q, exact in ((50, 0.5), (95, 0.95), (99, 0.99)):
+        est = h.quantile(q)
+        # the estimate can be off by at most one bucket width
+        assert exact / ratio <= est <= exact * ratio
+    assert M.Histogram("e").quantile(50) != M.Histogram("e").quantile(50)  # nan
+
+
+def test_histogram_merge_and_bounds_mismatch():
+    a = M.Histogram("h").fill([0.001, 0.01])
+    b = M.Histogram("h").fill([0.1, 1.0, 10.0])
+    a.merge(b)
+    assert a.count == 5
+    assert a.sum == pytest.approx(0.001 + 0.01 + 0.1 + 1.0 + 10.0)
+    with pytest.raises(ValueError):
+        a.merge(M.Histogram("h", bounds=(1.0, 2.0)))
+
+
+def test_merge_snapshots_is_associative_and_commutative():
+    def make(seed):
+        r = M.MetricsRegistry()
+        r.counter("c", verb="GET").inc(seed)
+        r.counter("c", verb="PUT").inc(2 * seed)
+        r.gauge("g").set(seed)
+        r.histogram("h").fill([seed * 0.001, seed * 0.01])
+        return r.snapshot()
+
+    s1, s2, s3 = make(1), make(5), make(9)
+
+    def canon(s):
+        # drop order/timestamps; compare the series algebra only
+        return (
+            [(e["name"], tuple(sorted(e["labels"].items())), e["value"])
+             for e in s["counters"]],
+            [(e["name"], e["value"]) for e in s["gauges"]],
+            [(e["name"], tuple(e["counts"]), e["count"],
+              pytest.approx(e["sum"])) for e in s["histograms"]],
+        )
+
+    left = M.merge_snapshots([M.merge_snapshots([s1, s2]), s3])
+    right = M.merge_snapshots([s1, M.merge_snapshots([s2, s3])])
+    flat = M.merge_snapshots([s1, s2, s3])
+    rev = M.merge_snapshots([s3, s2, s1])
+    assert canon(left) == canon(right) == canon(flat) == canon(rev)
+    # the merged totals are the sums
+    assert flat["counters"][0]["value"] == 15  # c{verb=GET}
+    assert flat["histograms"][0]["count"] == 6
+
+    # a replica on a different ladder is skipped loudly, not corrupted
+    r = M.MetricsRegistry()
+    r.histogram("h", bounds=(1.0, 2.0)).fill([1.5])
+    merged = M.merge_snapshots([s1, r.snapshot()])
+    assert merged["skipped"] == ["h"]
+    assert merged["histograms"][0]["count"] == 2  # s1's untouched
+
+
+def test_counter_and_histogram_exact_under_threads():
+    c = M.Counter("c")
+    h = M.Histogram("h", bounds=(0.5, 1.0))
+    n_threads, per_thread = 8, 5000
+
+    def work():
+        for i in range(per_thread):
+            c.inc()
+            h.observe(0.25 if i % 2 else 0.75)
+
+    ts = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # += on plain attributes loses updates across threads; the per-
+    # instrument lock must make the totals EXACT, not approximate
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    assert h.counts() == [n_threads * per_thread // 2] * 2 + [0]
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_enable_switch_gates_observation_but_not_math():
+    prev = M.set_enabled(False)
+    try:
+        c, g = M.Counter("c"), M.Gauge("g")
+        h = M.Histogram("h")
+        c.inc(5)
+        g.set(3.0)
+        h.observe(0.5)
+        assert c.value == 0 and g.value == 0.0 and h.count == 0
+        # fill() and bucketed_quantiles are offline math — they must keep
+        # working under TPUMS_METRICS=0 (the bench A/B depends on it)
+        assert M.Histogram("h").fill([0.5]).count == 1
+        p50, = M.bucketed_quantiles([0.5] * 10, (50,))
+        assert 0.4 < p50 < 0.6
+    finally:
+        M.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# snapshot algebra + exposition
+# ---------------------------------------------------------------------------
+
+def test_snapshot_quantile_and_diff():
+    r = M.MetricsRegistry()
+    r.counter("reqs", verb="GET").inc(3)
+    before = r.snapshot()
+    r.counter("reqs", verb="GET").inc(4)
+    r.gauge("backlog").set(17)
+    r.histogram("lat").fill([0.001] * 10)
+    after = r.snapshot()
+    d = M.diff_snapshots(before, after)
+    assert d["counters"] == {'reqs{verb="GET"}': 4}
+    assert d["gauges"] == {"backlog": 17.0}
+    assert d["histograms"]["lat"]["count"] == 10
+    he = [e for e in after["histograms"] if e["name"] == "lat"][0]
+    assert M.snapshot_quantile(he, 50) == pytest.approx(0.001, rel=0.2)
+
+
+def test_render_prometheus_cumulative_buckets():
+    r = M.MetricsRegistry()
+    r.counter("tpums_reqs", verb="GET").inc(7)
+    r.gauge("tpums_backlog").set(2.5)
+    r.histogram("tpums_lat", bounds=(1.0, 2.0)).fill([0.5, 1.5, 99.0])
+    text = M.render_prometheus(r.snapshot())
+    lines = text.splitlines()
+    assert "# TYPE tpums_reqs counter" in lines
+    assert 'tpums_reqs{verb="GET"} 7' in lines
+    assert "tpums_backlog 2.5" in lines
+    # _bucket series are CUMULATIVE and end with the +Inf total
+    assert 'tpums_lat_bucket{le="1.0"} 1' in lines
+    assert 'tpums_lat_bucket{le="2.0"} 2' in lines
+    assert 'tpums_lat_bucket{le="+Inf"} 3' in lines
+    assert "tpums_lat_count 3" in lines
+
+
+# ---------------------------------------------------------------------------
+# METRICS wire verb
+# ---------------------------------------------------------------------------
+
+def test_metrics_verb_roundtrip():
+    table = ModelTable(2)
+    table.put("k", "v")
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0).start()
+    try:
+        with QueryClient("127.0.0.1", srv.port, timeout_s=5) as c:
+            before = c.metrics()
+            assert c.query_state(ALS_STATE, "k") == "v"
+            assert c.query_state(ALS_STATE, "k") == "v"
+            snap = c.metrics()
+
+        def verb_count(s, verb):
+            return sum(
+                e["value"] for e in s["counters"]
+                if e["name"] == "tpums_server_requests_total"
+                and e["labels"].get("verb") == verb
+            )
+
+        # the registry is process-global: assert DELTAS, not absolutes
+        assert verb_count(snap, "GET") - verb_count(before, "GET") == 2
+        assert verb_count(snap, "METRICS") >= 1
+        lat = [
+            e for e in snap["histograms"]
+            if e["name"] == "tpums_server_latency_seconds"
+            and e["labels"].get("verb") == "GET"
+        ]
+        assert lat and lat[0]["count"] >= 2
+        assert lat[0]["le"] == list(M.LATENCY_BUCKETS_S)
+        assert snap["meta"]["port"] == srv.port
+
+        # wire framing: the reply is ONE line of JSON after the J tag
+        with socket.create_connection(("127.0.0.1", srv.port), 5) as s:
+            s.sendall(b"METRICS\n")
+            raw = s.makefile("rb").readline().decode()
+        assert raw.startswith("J\t")
+        parsed = json.loads(raw[2:])
+        assert "\n" not in raw[2:].rstrip("\n")
+        assert parsed["enabled"] is True
+    finally:
+        srv.stop()

@@ -1,0 +1,293 @@
+"""Request tracing (obs/tracing.py): wire-field helpers, byte-for-byte
+compatibility with the untraced seed protocol in BOTH directions
+(old-client/new-server and new-client/old-server), event-chain
+correlation through a traced round trip, sharded fan-out, and an HA
+failover retry; the JSONL file sink."""
+
+import socket
+import socketserver
+import threading
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.obs import tracing as T
+from flink_ms_tpu.serve.client import QueryClient, RetryPolicy
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    ServingJob,
+    make_backend,
+    parse_als_record,
+)
+from flink_ms_tpu.serve.ha import HAShardedClient, shard_group
+from flink_ms_tpu.serve.journal import Journal
+from flink_ms_tpu.serve.server import LookupServer
+from flink_ms_tpu.serve.sharded import ShardedQueryClient, owner_of
+from flink_ms_tpu.serve.table import ModelTable
+
+
+# ---------------------------------------------------------------------------
+# wire-field helpers
+# ---------------------------------------------------------------------------
+
+def test_stamp_pop_unstamp_helpers():
+    # no active context: stamp is the identity (the compat guarantee)
+    assert T.current_trace() is None
+    assert T.stamp("GET\tm\tk") == "GET\tm\tk"
+    with T.trace_span("aabbccdd00112233") as tid:
+        assert tid == "aabbccdd00112233"
+        assert T.current_trace() == tid
+        assert T.stamp("GET\tm\tk") == f"GET\tm\tk\ttid={tid}"
+        # nested spans restore the outer context
+        with T.trace_span() as inner:
+            assert inner != tid and T.current_trace() == inner
+        assert T.current_trace() == tid
+    assert T.current_trace() is None
+
+    parts = ["GET", "m", "k", "tid=deadbeefdeadbeef"]
+    assert T.pop_tid(parts) == "deadbeefdeadbeef"
+    assert parts == ["GET", "m", "k"]
+    assert T.pop_tid(parts) is None  # untraced: untouched
+    assert parts == ["GET", "m", "k"]
+    # a bare "tid=..." line is a (malformed) verb, not a trace field
+    assert T.pop_tid(["tid=deadbeefdeadbeef"]) is None
+
+    # unstamp strips ONLY the exact echoed suffix — an MGET payload that
+    # happens to end with a tid-shaped token for a DIFFERENT id survives
+    assert T.unstamp_reply("V\tv\ttid=aa", "aa") == "V\tv"
+    assert T.unstamp_reply("M\tVx\ttid=other", "aa") == "M\tVx\ttid=other"
+
+
+def test_call_with_trace_crosses_pool_threads():
+    from concurrent.futures import ThreadPoolExecutor
+
+    with T.trace_span() as tid, ThreadPoolExecutor(2) as pool:
+        # bare submit loses the context; call_with_trace carries it
+        assert pool.submit(T.current_trace).result() is None
+        assert pool.submit(
+            T.call_with_trace, tid, T.current_trace).result() == tid
+    # and the worker thread's context is restored afterwards
+    with ThreadPoolExecutor(1) as pool:
+        assert pool.submit(T.current_trace).result() is None
+
+
+# ---------------------------------------------------------------------------
+# wire compatibility, both directions
+# ---------------------------------------------------------------------------
+
+def test_old_client_new_server_bytes_identical():
+    """A seed-protocol client (raw socket, no tid) must get byte-identical
+    replies from the instrumented server — no echoed trace field."""
+    table = ModelTable(2)
+    table.put("k", "v")
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0).start()
+    try:
+        with socket.create_connection(("127.0.0.1", srv.port), 5) as s:
+            f = s.makefile("rb")
+            s.sendall(b"GET\tALS_MODEL\tk\n")
+            assert f.readline() == b"V\tv\n"
+            s.sendall(b"COUNT\tALS_MODEL\n")
+            assert f.readline() == b"C\t1\n"
+            s.sendall(b"GET\tALS_MODEL\tmissing\n")
+            assert f.readline() == b"N\n"
+    finally:
+        srv.stop()
+
+
+class _OldServer(socketserver.ThreadingTCPServer):
+    """A seed-protocol server: validates field counts STRICTLY (an extra
+    tab field is an error) and never echoes anything it didn't produce.
+    Captures the raw request lines it saw."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        self.seen = []
+
+        class H(socketserver.StreamRequestHandler):
+            def handle(h):
+                for raw in h.rfile:
+                    line = raw.decode().rstrip("\n")
+                    self.seen.append(line)
+                    parts = line.split("\t")
+                    if parts[0] == "GET" and len(parts) == 3:
+                        h.wfile.write(b"V\tv\n")
+                    else:
+                        h.wfile.write(b"E\tbad request\n")
+
+        super().__init__(("127.0.0.1", 0), H)
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+
+def test_new_client_old_server_untraced_is_compatible():
+    """With no trace context the new client's wire bytes are identical to
+    the seed client's, so a strict old server accepts them; opting into
+    tracing against an old server is a visible E, not corruption."""
+    old = _OldServer()
+    try:
+        with QueryClient("127.0.0.1", old.server_address[1],
+                         timeout_s=5) as c:
+            assert c.query_state(ALS_STATE, "k") == "v"
+            assert old.seen == [f"GET\t{ALS_STATE}\tk"]  # no tid field
+            with T.trace_span():
+                with pytest.raises(RuntimeError):
+                    c.query_state(ALS_STATE, "k")
+    finally:
+        old.shutdown()
+        old.server_close()
+
+
+# ---------------------------------------------------------------------------
+# event chains
+# ---------------------------------------------------------------------------
+
+def test_traced_roundtrip_event_chain():
+    table = ModelTable(2)
+    table.put("k", "v")
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0).start()
+    try:
+        with QueryClient("127.0.0.1", srv.port, timeout_s=5) as c:
+            with T.trace_span() as tid:
+                assert c.query_state(ALS_STATE, "k") == "v"
+                assert c.query_states(ALS_STATE, ["k", "nope"]) == ["v", None]
+        chain = T.recent_events(tid=tid)
+        kinds = [e["kind"] for e in chain]
+        # server span + client span per RPC, in causal order
+        assert kinds == ["server_reply", "client_rpc"] * 2
+        assert {e["verb"] for e in chain} == {"GET", "MGET"}
+        for e in T.recent_events(tid=tid, kind="server_reply"):
+            assert e["ok"] and e["lat_s"] >= 0
+        # traced traffic leaves no residue on the next untraced call
+        with QueryClient("127.0.0.1", srv.port, timeout_s=5) as c:
+            assert c.query_state(ALS_STATE, "k") == "v"
+    finally:
+        srv.stop()
+
+
+def test_trace_propagates_through_sharded_fanout():
+    """One traced MGET fanning out to 2 shards on pool threads: every
+    shard leg (client span AND server span) carries the SAME tid."""
+    tables = [ModelTable(2), ModelTable(2)]
+    keys = [f"key{i}" for i in range(16)]
+    for key in keys:
+        tables[owner_of(key, 2)].put(key, f"v:{key}")
+    assert all(len(t) for t in tables), "keys must span both shards"
+    srvs = [
+        LookupServer({ALS_STATE: t}, host="127.0.0.1", port=0).start()
+        for t in tables
+    ]
+    try:
+        eps = [("127.0.0.1", s.port) for s in srvs]
+        with ShardedQueryClient(eps, timeout_s=5) as c:
+            with T.trace_span() as tid:
+                got = c.query_states(ALS_STATE, keys)
+        assert got == [f"v:{key}" for key in keys]
+        legs = T.recent_events(tid=tid, kind="client_rpc")
+        replies = T.recent_events(tid=tid, kind="server_reply")
+        assert len(legs) == 2 and len(replies) == 2  # one MGET per shard
+        assert {e["port"] for e in legs} == {s.port for s in srvs}
+        assert {e["port"] for e in replies} == {s.port for s in srvs}
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def _seed_journal(tmp_path, n_users=8, n_items=8, k=3):
+    journal = Journal(str(tmp_path / "bus"), "models")
+    rng = np.random.default_rng(0)
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=k)) for u in range(n_users)]
+        + [F.format_als_row(i, "I", rng.normal(size=k))
+           for i in range(n_items)]
+    )
+    return journal
+
+
+def test_trace_survives_ha_failover_retry(tmp_path):
+    """Kill the preferred replica mid-trace: the SAME tid must link the
+    failover event (dead endpoint) and the retry that answered — one
+    correlated chain across the failover boundary."""
+    journal = _seed_journal(tmp_path)
+    jobs = [
+        ServingJob(
+            journal, ALS_STATE, parse_als_record, make_backend("memory", None),
+            host="127.0.0.1", port=0, poll_interval_s=0.01,
+            job_id=f"obs-ha:s0r{r}", replica_of=shard_group("obs-ha", 0),
+            replica_index=r, topk_index=False,
+        ).start()
+        for r in range(2)
+    ]
+    try:
+        for job in jobs:
+            assert job.wait_ready(30)
+        client = HAShardedClient(
+            1, job_group="obs-ha",
+            retry=RetryPolicy(attempts=5, backoff_s=0.01, max_backoff_s=0.1),
+            timeout_s=5,
+        )
+        with client:
+            assert client.query_state(ALS_STATE, "0-U") is not None  # warm
+            # crash the sticky replica's data plane (registry entry stays)
+            preferred_port = client._shards[0].prefer[1]
+            victim = next(j for j in jobs if j.server.port == preferred_port)
+            victim.server.stop()
+            with T.trace_span() as tid:
+                assert client.query_state(ALS_STATE, "1-U") is not None
+        chain = T.recent_events(tid=tid)
+        kinds = [e["kind"] for e in chain]
+        assert "failover" in kinds and "client_rpc" in kinds
+        fo = next(e for e in chain if e["kind"] == "failover")
+        ok = next(e for e in chain if e["kind"] == "client_rpc")
+        assert fo["port"] == preferred_port   # the dead endpoint...
+        assert ok["port"] != preferred_port   # ...and the survivor,
+        assert client.failovers > 0           # one chain, one tid
+    finally:
+        for job in jobs:
+            job.stop()
+
+
+# ---------------------------------------------------------------------------
+# event sinks
+# ---------------------------------------------------------------------------
+
+def test_event_ring_and_jsonl_file_sink(tmp_path, monkeypatch):
+    path = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("TPUMS_TRACE", path)
+    T.event("alpha", tid="t1", n=1)
+    T.event("beta", tid="t2", n=2)
+    monkeypatch.setenv("TPUMS_TRACE", "0")  # sink off, ring still on
+    T.event("gamma", tid="t1", n=3)
+
+    assert [e["kind"] for e in T.recent_events(tid="t1")][-2:] == \
+        ["alpha", "gamma"]
+    got = T.load_events(path)
+    assert [(e["kind"], e["n"]) for e in got] == [("alpha", 1), ("beta", 2)]
+    # malformed lines are skipped, not fatal (append-shared file)
+    with open(path, "a") as f:
+        f.write("{not json\n")
+    T.event("delta", tid="t3")
+    monkeypatch.setenv("TPUMS_TRACE", path)
+    T.event("epsilon", tid="t3")
+    got = T.load_events(path)
+    assert [e["kind"] for e in got] == ["alpha", "beta", "epsilon"]
+    assert T.load_events(str(tmp_path / "missing.jsonl")) == []
+
+    # events_counter: timeline entry + countable series in one call
+    from flink_ms_tpu.obs import metrics as M
+
+    before = sum(
+        e["value"] for e in M.get_registry().snapshot()["counters"]
+        if e["name"] == "tpums_events_total"
+        and e["labels"].get("kind") == "zeta"
+    )
+    T.events_counter("zeta", shard=1)
+    snap = M.get_registry().snapshot()
+    after = sum(
+        e["value"] for e in snap["counters"]
+        if e["name"] == "tpums_events_total"
+        and e["labels"].get("kind") == "zeta"
+    )
+    assert after == before + 1
+    assert T.recent_events(kind="zeta")[-1]["shard"] == 1
