@@ -38,10 +38,12 @@ BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 (per-device peak for MFU; default inferred from device_kind),
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
-serving_ha; default all), BENCH_INGEST_ROWS / BENCH_INGEST_K /
-BENCH_INGEST_PROP_PROBES (serving-ingest replay scale),
+serving_ha,serving_elastic; default all), BENCH_INGEST_ROWS /
+BENCH_INGEST_K / BENCH_INGEST_PROP_PROBES (serving-ingest replay scale),
 BENCH_HA_USERS / BENCH_HA_DURATION_S / BENCH_HA_WORKERS /
 BENCH_HA_HEARTBEAT_S / BENCH_HA_TTL_S (serving-HA kill-a-replica arms),
+BENCH_ELASTIC_USERS / BENCH_ELASTIC_WINDOW_S (serving-elastic live
+2->4 rescale: p50/p99 before/during/after + cutover duration),
 BENCH_ALS_PRECISION / BENCH_ALS_EXCHANGE (kernel-config A/B),
 BENCH_SKIP_QUALITY=1 / BENCH_RMSE_REF_NNZ / BENCH_RMSE_REF_ITERS (ALS
 quality anchor), BENCH_SVM_TARGET / BENCH_SVM_REF_ROUNDS / BENCH_SVM_FLIP
@@ -846,6 +848,8 @@ _COMPACT_KEYS = (
     "serving_ingest_columnar_rows_per_sec", "serving_ingest_speedup",
     "serving_ingest_columnar_prop_p99_ms",
     "serving_ha_r2_availability", "serving_ha_r2_recovery_s",
+    "serving_elastic_cutover_s", "serving_elastic_during_p99_ms",
+    "serving_elastic_errors",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1096,7 +1100,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
     _RECOVERY_CTX = None
     small = os.environ.get("BENCH_SMALL") == "1"
     sections = os.environ.get(
-        "BENCH_SECTIONS", "als,svm,serving,svmserve,serving_ingest,serving_ha"
+        "BENCH_SECTIONS",
+        "als,svm,serving,svmserve,serving_ingest,serving_ha,serving_elastic"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1166,6 +1171,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("svmserve", "run_svm_serving_section", lambda f: f(small)),
         ("serving_ingest", "run_serving_ingest_section", lambda f: f(small)),
         ("serving_ha", "run_serving_ha_section", lambda f: f(small)),
+        ("serving_elastic", "run_serving_elastic_section",
+         lambda f: f(small)),
     )
     for name, fn_name, call in extra:
         if recovery_enabled:

@@ -1524,3 +1524,118 @@ def run_serving_ha_section(small: bool) -> dict:
             else:
                 os.environ[key] = val
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_serving_elastic_section(small: bool) -> dict:
+    """Latency envelope of a live rescale: run the elastic serving plane
+    (serve/elastic.py) at 2 shards under a sustained closed-loop query
+    stream, scale out to 4 mid-run, and report p50/p99 for the before /
+    during / after windows plus the cutover duration and client-visible
+    error count.  The contract pinned by tests/test_elastic_serving.py —
+    zero failed queries across the generation swap — is what "during"
+    quantifies the latency cost of."""
+    import threading
+
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.serve.client import RetryPolicy
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve.elastic import ElasticClient, ScaleController
+    from flink_ms_tpu.serve.journal import Journal
+
+    n_users = int(
+        os.environ.get("BENCH_ELASTIC_USERS", 400 if small else 4_000))
+    window_s = float(
+        os.environ.get("BENCH_ELASTIC_WINDOW_S", 3 if small else 10))
+
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    saved = {key: os.environ.get(key) for key in
+             ("TPUMS_HEARTBEAT_S", "TPUMS_REPLICA_TTL_S",
+              "TPUMS_REGISTRY_DIR")}
+    os.environ["TPUMS_HEARTBEAT_S"] = os.environ.get(
+        "BENCH_ELASTIC_HEARTBEAT_S", "0.2")
+    os.environ["TPUMS_REPLICA_TTL_S"] = os.environ.get(
+        "BENCH_ELASTIC_TTL_S", "1.2")
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    out = {}
+    try:
+        journal = Journal(os.path.join(tmp, "bus"), "models")
+        rng = np.random.default_rng(0)
+        dim = 8
+        journal.append(
+            [F.format_als_row(u, "U", rng.normal(size=dim))
+             for u in range(n_users)]
+            + [F.format_als_row(i, "I", rng.normal(size=dim))
+               for i in range(n_users)])
+        keys = [f"{u}-U" for u in range(n_users)]
+
+        ctl = ScaleController("bench-elastic", journal.dir, "models",
+                              port_dir=os.path.join(tmp, "ports"),
+                              ready_timeout_s=180)
+        phases = {"before": [], "during": [], "after": []}
+        phase = ["before"]
+        counts = {"ok": 0, "err": 0}
+        stop = threading.Event()
+
+        def load():
+            rnd = np.random.default_rng(1)
+            with ElasticClient(
+                    "bench-elastic",
+                    retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                      max_backoff_s=0.5),
+                    timeout_s=10) as c:
+                while not stop.is_set():
+                    key = keys[int(rnd.integers(len(keys)))]
+                    t0 = time.perf_counter()
+                    try:
+                        if c.query_state(ALS_STATE, key) is None:
+                            counts["err"] += 1
+                        else:
+                            counts["ok"] += 1
+                    except Exception:
+                        counts["err"] += 1
+                    phases[phase[0]].append(
+                        (time.perf_counter() - t0) * 1000.0)
+
+        try:
+            rec = ctl.scale_to(2)
+            assert rec["shards"] == 2, "bootstrap failed"
+            th = threading.Thread(target=load, daemon=True)
+            th.start()
+            time.sleep(window_s)
+
+            phase[0] = "during"
+            t0 = time.time()
+            rec = ctl.scale_to(4)
+            cutover_s = time.time() - t0
+            assert rec["shards"] == 4 and rec["gen"] == 2, "cutover failed"
+            phase[0] = "after"
+            time.sleep(window_s)
+            stop.set()
+            th.join(timeout=30)
+        finally:
+            stop.set()
+            ctl.stop(drop_topology=True)
+
+        total = counts["ok"] + counts["err"]
+        out["serving_elastic_queries"] = total
+        out["serving_elastic_errors"] = counts["err"]
+        out["serving_elastic_availability"] = (
+            round(counts["ok"] / total, 6) if total else None)
+        out["serving_elastic_cutover_s"] = round(cutover_s, 2)
+        for name, ms in phases.items():
+            out.update({f"serving_elastic_{name}_{q}_ms": v
+                        for q, v in _pcts(ms).items()})
+        _log(f"[bench:elastic] {total} queries, {counts['err']} errors, "
+             f"cutover {out['serving_elastic_cutover_s']}s, p99 "
+             f"before/during/after "
+             f"{out.get('serving_elastic_before_p99_ms')}/"
+             f"{out.get('serving_elastic_during_p99_ms')}/"
+             f"{out.get('serving_elastic_after_p99_ms')} ms")
+        return out
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        shutil.rmtree(tmp, ignore_errors=True)

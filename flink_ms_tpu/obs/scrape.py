@@ -28,7 +28,8 @@ from typing import Dict, List, Optional
 from ..serve import registry
 from .metrics import merge_snapshots, render_prometheus, snapshot_quantile
 
-__all__ = ["scrape_endpoint", "scrape_fleet", "snapshot_quantile", "main"]
+__all__ = ["scrape_endpoint", "scrape_fleet", "fleet_signals",
+           "snapshot_quantile", "main"]
 
 
 def scrape_endpoint(host: str, port: int, timeout_s: float = 2.0
@@ -102,6 +103,68 @@ def scrape_fleet(timeout_s: float = 2.0) -> dict:
         "fleet": merge_snapshots(all_snaps),
         "scraped": len(all_snaps),
         "unreachable": unreachable,
+    }
+
+
+# verbs that are plumbing, not user traffic — excluded from the qps signal
+# so a scrape/health poller can't talk an autoscaler into scaling out
+_NON_QUERY_VERBS = frozenset({"HEALTH", "METRICS", "PING"})
+
+
+def _query_hists(snapshot: dict) -> List[dict]:
+    return [h for h in snapshot.get("histograms", [])
+            if h["name"] == "tpums_server_latency_seconds"
+            and h.get("labels", {}).get("verb") not in _NON_QUERY_VERBS]
+
+
+def fleet_signals(before: dict, after: dict,
+                  dt_s: Optional[float] = None) -> dict:
+    """Autoscaler inputs from two fleet snapshots (``scrape_fleet()``'s
+    ``fleet`` merges) taken ``dt_s`` apart (defaults to the snapshots' own
+    timestamp delta)::
+
+        {"qps":            query verbs/s over the window (HEALTH/METRICS/
+                           PING excluded — pollers must not look like load),
+         "p99_s":          interpolated p99 of the window's query-verb
+                           latency observations (None with no traffic),
+         "backlog_bytes":  fleet ingest backlog at AFTER (gauge level),
+         "dt_s", "requests": the window itself}
+    """
+    if dt_s is None:
+        dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
+                   1e-9)
+    b_h = {(h["name"], tuple(sorted(h.get("labels", {}).items()))): h
+           for h in _query_hists(before)}
+    requests = 0
+    window = None  # delta histogram across all query verbs
+    for h in _query_hists(after):
+        k = (h["name"], tuple(sorted(h.get("labels", {}).items())))
+        prev = b_h.get(k, {"counts": [0] * len(h["counts"]),
+                           "count": 0, "sum": 0.0})
+        dc = h["count"] - prev["count"]
+        if dc <= 0:
+            continue
+        requests += dc
+        dcounts = [a - b for a, b in zip(h["counts"], prev["counts"])]
+        if window is None:
+            window = {"name": "window", "le": list(h["le"]),
+                      "counts": dcounts, "count": dc,
+                      "sum": h["sum"] - prev["sum"]}
+        elif window["le"] == list(h["le"]):
+            window["counts"] = [a + b for a, b in
+                                zip(window["counts"], dcounts)]
+            window["count"] += dc
+            window["sum"] += h["sum"] - prev["sum"]
+    backlog = sum(
+        g["value"] for g in after.get("gauges", [])
+        if g["name"] == "tpums_journal_backlog_bytes"
+    )
+    return {
+        "qps": requests / dt_s,
+        "p99_s": snapshot_quantile(window, 99) if window else None,
+        "backlog_bytes": backlog,
+        "dt_s": dt_s,
+        "requests": requests,
     }
 
 

@@ -23,7 +23,19 @@ Update rule (k factors, learning rate γ, per-side regularization λu/λi):
     u'   = u + γ (err · v − λu · u)        [v1: v is old; v0: same]
     v'   = v + γ (err · u − λi · v)        [v1: u is old; v0: u' (updated)]
     bias updates are computed but not persisted (reference TODOs at
-    SGD.java:209,232 — preserved as-is for parity).
+    SGD.java:209,232 — preserved as-is for parity by DEFAULT).
+
+Bias mode (``--updateBias`` / ``TPUMS_SGD_BIAS=1``): finishes the
+reference's TODO.  The LAST element of each factor row is its bias term;
+prediction and updates become
+
+    err  = r − (u[:-1]·v[:-1] + bu + bi)
+    u'   = factor rule above on u[:-1]/v[:-1]
+    bu'  = bu + γ (err − λu · bu)          [bi' symmetric with λi]
+
+and the updated biases persist in the emitted rows.  Flag OFF (the
+default) is byte-identical to the historical unbiased behavior —
+regression-pinned in tests/test_online_sgd.py.
 
 Quirk fix (SURVEY.md Appendix C #8): a query-transport error in the
 reference leaves an Optional null and NPEs the task; here it falls back to
@@ -58,6 +70,7 @@ class SGDStep:
         item_reg: float = 0.0,
         version: str = "v1",
         lookup_many: Optional[Callable[[List[str]], List[Optional[str]]]] = None,
+        update_bias: bool = False,
     ):
         if version not in ("v1", "v0"):
             raise ValueError("version must be v1 or v0")
@@ -71,6 +84,7 @@ class SGDStep:
         self.user_reg = user_reg
         self.item_reg = item_reg
         self.version = version
+        self.update_bias = update_bias
         self.nan_records = 0
         self.vectorized_chunks = 0  # observability / test hook
 
@@ -93,13 +107,27 @@ class SGDStep:
         return self._vec(id_, suffix, payload, mean)
 
     def _update(self, u: np.ndarray, v: np.ndarray, rating: float):
-        err = rating - float(u @ v)
-        u_new = u + self.lr * (err * v - self.user_reg * u)
+        if not self.update_bias:
+            err = rating - float(u @ v)
+            u_new = u + self.lr * (err * v - self.user_reg * u)
+            if self.version == "v1":
+                v_new = v + self.lr * (err * u - self.item_reg * v)
+            else:  # v0: item step sees the already-updated user vector
+                v_new = v + self.lr * (err * u_new - self.item_reg * v)
+            return u_new, v_new
+        # biased step: the last element of each row is its bias term
+        uf, bu = u[:-1], float(u[-1])
+        vf, bi = v[:-1], float(v[-1])
+        err = rating - (float(uf @ vf) + bu + bi)
+        uf_new = uf + self.lr * (err * vf - self.user_reg * uf)
+        bu_new = bu + self.lr * (err - self.user_reg * bu)
         if self.version == "v1":
-            v_new = v + self.lr * (err * u - self.item_reg * v)
-        else:  # v0: item step sees the already-updated user vector
-            v_new = v + self.lr * (err * u_new - self.item_reg * v)
-        return u_new, v_new
+            vf_new = vf + self.lr * (err * uf - self.item_reg * vf)
+        else:
+            vf_new = vf + self.lr * (err * uf_new - self.item_reg * vf)
+        bi_new = bi + self.lr * (err - self.item_reg * bi)
+        return (np.concatenate([uf_new, [bu_new]]),
+                np.concatenate([vf_new, [bi_new]]))
 
     def _emit(self, user: int, item: int, u_new, v_new):
         """-> (rows to emit, [(key, vec)] that became visible).
@@ -205,13 +233,32 @@ class SGDStep:
                 # --batchSize N and --batchSize 1 emit byte-identical
                 # rows (the broadcast update arithmetic below is
                 # elementwise and therefore already bitwise-identical)
-                err = r - np.fromiter(
-                    (float(u @ v) for u, v in zip(U, V)),
-                    np.float64, len(ratings),
-                )
-                U_new = U + self.lr * (err[:, None] * V - self.user_reg * U)
-                base = U if self.version == "v1" else U_new
-                V_new = V + self.lr * (err[:, None] * base - self.item_reg * V)
+                if self.update_bias:
+                    Uf, bu = U[:, :-1], U[:, -1]
+                    Vf, bi = V[:, :-1], V[:, -1]
+                    err = r - (np.fromiter(
+                        (float(u @ v) for u, v in zip(Uf, Vf)),
+                        np.float64, len(ratings),
+                    ) + bu + bi)
+                    Uf_new = Uf + self.lr * (
+                        err[:, None] * Vf - self.user_reg * Uf)
+                    bu_new = bu + self.lr * (err - self.user_reg * bu)
+                    base = Uf if self.version == "v1" else Uf_new
+                    Vf_new = Vf + self.lr * (
+                        err[:, None] * base - self.item_reg * Vf)
+                    bi_new = bi + self.lr * (err - self.item_reg * bi)
+                    U_new = np.concatenate([Uf_new, bu_new[:, None]], axis=1)
+                    V_new = np.concatenate([Vf_new, bi_new[:, None]], axis=1)
+                else:
+                    err = r - np.fromiter(
+                        (float(u @ v) for u, v in zip(U, V)),
+                        np.float64, len(ratings),
+                    )
+                    U_new = U + self.lr * (
+                        err[:, None] * V - self.user_reg * U)
+                    base = U if self.version == "v1" else U_new
+                    V_new = V + self.lr * (
+                        err[:, None] * base - self.item_reg * V)
                 self.vectorized_chunks += 1
                 out = []
                 for (user, item, _), un, vn in zip(ratings, U_new, V_new):
@@ -347,6 +394,13 @@ def run(params: Params, stop: Optional[Callable[[], bool]] = None) -> int:
             # (--batchedLookups false restores strict per-key parity mode)
             lookup_many=(
                 lookup_many if params.get_bool("batchedLookups", True) else None
+            ),
+            # --updateBias / TPUMS_SGD_BIAS=1: persist the bias updates the
+            # reference computes and drops (last vector element = bias)
+            update_bias=params.get_bool(
+                "updateBias",
+                os.environ.get("TPUMS_SGD_BIAS", "").lower()
+                in ("1", "true", "yes"),
             ),
         )
 

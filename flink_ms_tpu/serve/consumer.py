@@ -169,6 +169,8 @@ class ServingJob:
         topk_index: bool = True,
         replica_of: Optional[str] = None,
         replica_index: Optional[int] = None,
+        topology_group: Optional[str] = None,
+        generation: Optional[int] = None,
     ):
         if start_from not in ("earliest", "latest"):
             raise ValueError("start_from must be earliest|latest")
@@ -247,6 +249,14 @@ class ServingJob:
         # whole set by the logical shard-group id
         self.replica_of = replica_of
         self.replica_index = replica_index
+        # elastic plane (serve/elastic.py): a worker belonging to topology
+        # generation `generation` of group `topology_group` advertises both
+        # through HEALTH, plus the group's ACTIVE generation as observed at
+        # heartbeat time — clients use active != ours as the re-resolve
+        # hint without any new wire verb (the HEALTH JSON is the channel)
+        self.topology_group = topology_group
+        self.generation = generation
+        self._observed_topology_gen: Optional[int] = generation
         # readiness gate: False until the consume loop has replayed the
         # journal backlog that existed when it came up — a rejoining
         # replica must never be routed traffic over a half-replayed table
@@ -357,6 +367,9 @@ class ServingJob:
             "ingest_path": self.ingest_path,
             "replica_of": self.replica_of,
             "replica": self.replica_index,
+            "topology_group": self.topology_group,
+            "generation": self.generation,
+            "topology_gen": self._observed_topology_gen,
         }
 
     def _heartbeat_now(self) -> None:
@@ -365,13 +378,28 @@ class ServingJob:
         # the lock makes read-ready + register atomic: without it the
         # heartbeat thread can read ready=False, lose the CPU, and write
         # that stale value AFTER the consume loop registered ready=True —
-        # readiness must be monotone once flipped
+        # readiness must be monotone once flipped.  The stop check under
+        # the same lock pairs with the locked unregister in stop(): the
+        # consume loop's ready-flip refresh must not resurrect an entry a
+        # concurrent shutdown just removed
         with self._hb_lock:
+            if self._stop.is_set():
+                return
             registry.register(
                 self.job_id, self.host, self.port, self.state_name,
                 replica_of=self.replica_of, replica=self.replica_index,
                 ready=self.ready, ttl_s=registry.replica_ttl_s(),
             )
+        if self.topology_group:
+            # piggyback on the heartbeat cadence: one small registry read
+            # keeps the generation-changed hint served by HEALTH fresh
+            # within a heartbeat interval of a cutover
+            try:
+                topo = registry.resolve_topology(self.topology_group)
+                if topo is not None:
+                    self._observed_topology_gen = int(topo["gen"])
+            except Exception:
+                pass
 
     def _heartbeat_loop(self) -> None:
         from . import registry
@@ -397,7 +425,10 @@ class ServingJob:
             self._hb_thread.join(timeout=5)
         from . import registry
 
-        registry.unregister(self.job_id)
+        # under _hb_lock: the consumer thread is NOT joined yet, and its
+        # ready-flip heartbeat would otherwise race this removal
+        with self._hb_lock:
+            registry.unregister(self.job_id)
         if self._consumer_thread:
             self._consumer_thread.join(timeout=10)
         self.server.stop()
@@ -466,7 +497,8 @@ class ServingJob:
                     self._stop.set()
                     from . import registry
 
-                    registry.unregister(self.job_id)
+                    with self._hb_lock:
+                        registry.unregister(self.job_id)
                     return
                 print(
                     f"[serve:{self.state_name}] consume loop failed ({e}); "

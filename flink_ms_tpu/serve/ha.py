@@ -40,6 +40,12 @@ read a value the dead replica had applied but the failover target hasn't
 yet (the journal replay closes the gap; last-writer-wins makes it
 convergent, never corrupt).
 
+Shard count is fixed for the lifetime of a supervisor — ``hash%N``
+ownership is baked in at launch.  Live RESHAPING (changing N under
+traffic) is the elastic plane's job (``serve/elastic.py``): it runs a
+whole new ReplicaSupervisor per topology generation and cuts clients over
+atomically; this module stays the fixed-shape building block.
+
 Replicated launcher CLI (the HA analog of ``serve.sharded``):
 
     python -m flink_ms_tpu.serve.ha --numWorkers 2 --replication 2 \
@@ -661,7 +667,8 @@ def run_supervisor(params: Params) -> ReplicaSupervisor:
     port_dir = params.get("portDir") or tempfile.mkdtemp(prefix="tpums_ha_")
     extra: List[str] = []
     for passthrough in ("svm", "shards", "checkPointInterval",
-                        "checkpointDataUri", "nativeServer", "ingestMode"):
+                        "checkpointDataUri", "nativeServer", "ingestMode",
+                        "topologyGroup", "topologyGen"):
         if params.has(passthrough):
             extra += [f"--{passthrough}", params.get(passthrough)]
     sup = ReplicaSupervisor(

@@ -29,6 +29,22 @@ in the set) and ``ready`` (False while it is still replaying the journal —
 the readiness gate clients honor during failover).  ``resolve_replicas``
 returns the live members of a group.
 
+Topology records (the elastic plane, serve/elastic.py): a job GROUP's
+active shape lives in one ``kind="topology"`` record — ``(gen, shards,
+replicas)`` plus a bounded history of superseded generations.  Publishes
+are atomic (tmp + rename under a short-lived lock file) and CAS-guarded:
+a publisher naming ``expect_gen`` that no longer matches loses with
+``TopologyConflict`` instead of silently rolling the fleet back.  Unlike
+endpoint registration, topology publish is NOT best-effort — a controller
+that cannot record a cutover must know.  ``gc_generation_entries`` reaps
+DEAD worker entries of superseded generations immediately (the TTL would
+get them eventually; a cutover shouldn't leave corpses for readers to
+re-judge until then).  A controller LEASE (``acquire_controller_lease``)
+makes rescaling single-writer per group: the second controller refuses —
+or defers, its choice — unless the holder's pid/heartbeat shows it dead,
+in which case the lease is stolen with the same TOCTOU guard as entry
+reaping.
+
 Location: ``TPUMS_REGISTRY_DIR`` (deployment/shared-FS override), else
 ``<tmpdir>/flink_ms_tpu_registry`` — the same host-local convention as the
 journal's default bus directory.  Registration is best-effort: registry
@@ -258,6 +274,286 @@ def _pid_is_ours_and_dead(entry: dict) -> bool:
     except OSError:
         pass  # EPERM etc.: the process exists, just not ours
     return False
+
+
+# ---------------------------------------------------------------------------
+# topology records + controller lease (the elastic plane, serve/elastic.py)
+# ---------------------------------------------------------------------------
+
+TOPOLOGY_HISTORY = 8  # superseded generations kept in the record
+
+
+class TopologyConflict(RuntimeError):
+    """A CAS publish lost: the group's generation moved under the caller."""
+
+
+def _group_path(group: str, suffix: str) -> str:
+    import hashlib
+
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in group)
+    digest = hashlib.sha1(group.encode("utf-8")).hexdigest()[:8]
+    return os.path.join(registry_dir(), f"{safe[:80]}-{digest}.{suffix}")
+
+
+def _topology_path(group: str) -> str:
+    # distinct suffix so a JOB registered under the group's name can never
+    # collide with the group's topology record (both end in .json; readers
+    # of either kind validate the payload, not the filename)
+    return _group_path(group, "topo.json")
+
+
+def _read_record(path: str, kind: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(record, dict) or record.get("kind") != kind:
+        return None
+    return record
+
+
+def resolve_topology(group: str) -> Optional[dict]:
+    """The group's active topology record ``{gen, shards, replicas, ...}``,
+    or None when no generation was ever published."""
+    return _read_record(_topology_path(group), "topology")
+
+
+class _GroupLock:
+    """Short-lived O_EXCL lock file serializing read-modify-write of one
+    group's records.  A lock older than ``stale_s`` is presumed abandoned
+    (its holder crashed between create and unlink) and broken."""
+
+    def __init__(self, path: str, timeout_s: float = 2.0,
+                 stale_s: float = 5.0):
+        self.path = path + ".lock"
+        self.timeout_s = timeout_s
+        self.stale_s = stale_s
+
+    def __enter__(self):
+        deadline = time.time() + self.timeout_s
+        while True:
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                return self
+            except FileExistsError:
+                try:
+                    if time.time() - os.path.getmtime(self.path) > self.stale_s:
+                        os.unlink(self.path)
+                        continue
+                except OSError:
+                    continue  # holder released between stat and unlink
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"group lock busy: {self.path}") from None
+                time.sleep(0.01)
+
+    def __exit__(self, *exc):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def publish_topology(
+    group: str,
+    shards: int,
+    replicas: int = 1,
+    *,
+    expect_gen: Optional[int] = None,
+    controller: Optional[str] = None,
+) -> dict:
+    """Atomically publish the group's next topology generation -> record.
+
+    The new generation is always ``current + 1`` (1 for a fresh group).
+    ``expect_gen`` is the CAS guard: a controller that planned the cutover
+    against generation G passes ``expect_gen=G``, and if some other writer
+    advanced the record meanwhile this raises ``TopologyConflict`` instead
+    of overwriting the newer topology.  The superseded generation joins a
+    bounded ``history`` (stale-generation GC: the record never grows past
+    ``TOPOLOGY_HISTORY`` entries).  NOT best-effort: I/O failures raise."""
+    if shards < 1 or replicas < 1:
+        raise ValueError("need shards >= 1 and replicas >= 1")
+    os.makedirs(registry_dir(), exist_ok=True)
+    path = _topology_path(group)
+    import socket
+
+    with _GroupLock(path):
+        current = _read_record(path, "topology")
+        cur_gen = int(current["gen"]) if current else 0
+        if expect_gen is not None and cur_gen != int(expect_gen):
+            raise TopologyConflict(
+                f"group {group!r} is at generation {cur_gen}, "
+                f"publisher expected {expect_gen}"
+            )
+        history = list(current.get("history", ())) if current else []
+        if current:
+            history.append({
+                "gen": current["gen"], "shards": current["shards"],
+                "replicas": current["replicas"],
+                "published_at": current.get("published_at"),
+            })
+            history = history[-TOPOLOGY_HISTORY:]
+        record = {
+            "kind": "topology", "group": group, "gen": cur_gen + 1,
+            "shards": int(shards), "replicas": int(replicas),
+            "published_at": time.time(),
+            "controller": controller
+            or f"{socket.gethostname()}:{os.getpid()}",
+            "history": history,
+        }
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+    return record
+
+
+def drop_topology(group: str) -> None:
+    """Remove the group's topology record (teardown; best-effort)."""
+    try:
+        os.unlink(_topology_path(group))
+    except OSError:
+        pass
+
+
+def generation_of(entry: dict, group: str, gen_sep: str = "@g"
+                  ) -> Optional[int]:
+    """Parse the topology generation out of a worker entry's shard-group id
+    (``<group>@g<gen>/shard-<i>``); None for entries outside ``group``."""
+    replica_of = entry.get("replica_of") or ""
+    prefix = f"{group}{gen_sep}"
+    if not replica_of.startswith(prefix):
+        return None
+    gen_s = replica_of[len(prefix):].split("/", 1)[0]
+    try:
+        return int(gen_s)
+    except ValueError:
+        return None
+
+
+def gc_generation_entries(group: str, active_gen: int) -> int:
+    """Reap DEAD worker entries of generations < ``active_gen`` -> count.
+
+    Live old-generation workers are left alone — a cutover drains them
+    deliberately (serve/elastic.py), and a worker that outlives its drain
+    window still answers in-flight clients.  Dead ones would be TTL-GC'd
+    eventually; after a cutover they are provably garbage NOW.
+
+    Scans the raw registry dir (NOT ``list_jobs``, which filters dead
+    entries out of its result whether or not it GCs them)."""
+    reaped = 0
+    try:
+        names = os.listdir(registry_dir())
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(registry_dir(), name)
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(entry, dict) or "port" not in entry:
+            continue
+        gen = generation_of(entry, group)
+        if gen is None or gen >= active_gen:
+            continue
+        if entry_is_dead(entry) and _reap_if_unchanged(path, entry) is None:
+            reaped += 1
+    return reaped
+
+
+def _controller_path(group: str) -> str:
+    return _group_path(group, "ctl.json")
+
+
+def acquire_controller_lease(group: str, ttl_s: Optional[float] = None
+                             ) -> Optional[str]:
+    """Try to become the group's single scaling controller -> lease token,
+    or None while another live controller holds the lease.
+
+    The lease is a registry-style heartbeat contract: the holder refreshes
+    within ``ttl_s`` (default: the replica TTL) or is presumed dead, and a
+    dead holder's lease (pid gone, or heartbeat lapsed) is STOLEN — with
+    the same read-back guard as entry reaping, so two stealers cannot both
+    win one corpse."""
+    import socket
+    import uuid
+
+    os.makedirs(registry_dir(), exist_ok=True)
+    path = _controller_path(group)
+    token = uuid.uuid4().hex
+    entry = {
+        "kind": "controller", "group": group, "token": token,
+        "pid": os.getpid(), "pid_host": socket.gethostname(),
+        "heartbeat": time.time(),
+        "ttl_s": replica_ttl_s() if ttl_s is None else float(ttl_s),
+    }
+    data = json.dumps(entry)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+        return token
+    except FileExistsError:
+        pass
+    current = _read_record(path, "controller")
+    if current is None:
+        # unreadable/foreign record: replace it (a torn write is a corpse)
+        current = {}
+    elif not entry_is_dead(current):
+        return None
+    # steal guarded against the live holder racing us: write the claim
+    # aside, re-read, and only replace while the record still shows the
+    # same dead (pid, heartbeat) we judged
+    tmp = f"{path}.{os.getpid()}.{token[:8]}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(data)
+        check = _read_record(path, "controller")
+        if (check or {}).get("pid") == current.get("pid") and \
+                (check or {}).get("heartbeat") == current.get("heartbeat"):
+            os.replace(tmp, path)
+            return token
+        os.unlink(tmp)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return None
+
+
+def refresh_controller_lease(group: str, token: str) -> bool:
+    """Heartbeat the lease -> True while this token still holds it."""
+    path = _controller_path(group)
+    current = _read_record(path, "controller")
+    if current is None or current.get("token") != token:
+        return False
+    current["heartbeat"] = time.time()
+    tmp = f"{path}.{os.getpid()}.hb.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(current, f)
+        os.replace(tmp, path)
+    except OSError:
+        return False
+    return True
+
+
+def release_controller_lease(group: str, token: str) -> None:
+    """Drop the lease iff this token still holds it (best-effort)."""
+    path = _controller_path(group)
+    current = _read_record(path, "controller")
+    if current is not None and current.get("token") == token:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
 
 def merge_endpoint(entry: Optional[dict], explicit_host: Optional[str],

@@ -480,6 +480,13 @@ class LookupServer:
                     }
                 report["keys"] = len(table)
                 report.setdefault("job_id", self.job_id)
+                # elastic plane: keep the HEALTH payload schema uniform —
+                # a non-elastic worker answers the topology fields with
+                # null rather than omitting them (client.topology relies
+                # on the keys existing)
+                report.setdefault("topology_group", None)
+                report.setdefault("generation", None)
+                report.setdefault("topology_gen", None)
                 # pointer to this replica's metrics snapshot: same
                 # endpoint, METRICS verb (scrape clients need no extra
                 # port discovery)

@@ -359,6 +359,12 @@ def run_worker(params: Params) -> ServingJob:
     if job_group or replica_index is not None:
         group = job_group or "sharded"
         replica_of = f"{group}/shard-{worker_index}"
+    # elastic plane (serve/elastic.py): workers of topology generation g of
+    # group G run under the generation-suffixed jobGroup "G@g<g>" (so all
+    # the per-generation registry machinery above applies unchanged) and
+    # additionally carry the BASE group + generation for the HEALTH hint
+    topology_group = params.get("topologyGroup")
+    topology_gen = params.get_int("topologyGen", None)
     # each worker checkpoints its own slice: separate subdir per index
     # (and per replica — set members must never share a checkpoint dir) so
     # restarts restore the right partition
@@ -389,6 +395,8 @@ def run_worker(params: Params) -> ServingJob:
         ingest_mode=params.get("ingestMode"),
         replica_of=replica_of,
         replica_index=replica_index,
+        topology_group=topology_group,
+        generation=topology_gen,
     ).start()
     print(
         f"[serve:sharded] worker {worker_index}/{num_workers}"

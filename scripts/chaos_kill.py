@@ -8,11 +8,17 @@ time (kill -> the respawned replica registers ready again).
     python scripts/chaos_kill.py [env knobs below]
 
 Knobs (env):
+    CHAOS_MODE=ha          "ha" (kill serving replicas, below) or "elastic"
+                           (kill a WARMING replica mid-bootstrap during a
+                           live scale-out — the elastic plane's cutover
+                           failure model: the supervisor respawns it,
+                           replay resumes, the cutover still completes,
+                           and no client ever saw the warming generation)
     CHAOS_WORKERS=2        shards
     CHAOS_REPLICATION=2    replicas per shard (1 reproduces the reference's
                            single-owner outage behavior)
-    CHAOS_DURATION_S=30    load window
-    CHAOS_KILL_EVERY_S=5   mean seconds between kills (0 disables)
+    CHAOS_DURATION_S=30    load window (ha mode)
+    CHAOS_KILL_EVERY_S=5   mean seconds between kills (0 disables; ha mode)
     CHAOS_THREADS=4        closed-loop client threads
     CHAOS_USERS=200        model rows per type
     TPUMS_HEARTBEAT_S / TPUMS_REPLICA_TTL_S: liveness cadence (defaults
@@ -53,12 +59,26 @@ from flink_ms_tpu.serve.consumer import ALS_STATE  # noqa: E402
 from flink_ms_tpu.serve.ha import ReplicaSupervisor  # noqa: E402
 from flink_ms_tpu.serve.journal import Journal  # noqa: E402
 
+MODE = os.environ.get("CHAOS_MODE", "ha")
 W = int(os.environ.get("CHAOS_WORKERS", 2))
 R = int(os.environ.get("CHAOS_REPLICATION", 2))
 DURATION_S = float(os.environ.get("CHAOS_DURATION_S", 30))
 KILL_EVERY_S = float(os.environ.get("CHAOS_KILL_EVERY_S", 5))
 THREADS = int(os.environ.get("CHAOS_THREADS", 4))
 N_USERS = int(os.environ.get("CHAOS_USERS", 200))
+
+
+def seed_journal(base):
+    journal = Journal(os.path.join(base, "bus"), "models")
+    rng = np.random.default_rng(0)
+    k = 4
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=k))
+         for u in range(N_USERS)]
+        + [F.format_als_row(i, "I", rng.normal(size=k))
+           for i in range(N_USERS)]
+    )
+    return journal, [f"{u}-U" for u in range(N_USERS)]
 
 
 def pcts(ms):
@@ -70,16 +90,7 @@ def pcts(ms):
 
 def main() -> int:
     base = tempfile.mkdtemp(prefix="tpums_chaos_")
-    journal = Journal(os.path.join(base, "bus"), "models")
-    rng = np.random.default_rng(0)
-    k = 4
-    journal.append(
-        [F.format_als_row(u, "U", rng.normal(size=k))
-         for u in range(N_USERS)]
-        + [F.format_als_row(i, "I", rng.normal(size=k))
-           for i in range(N_USERS)]
-    )
-    keys = [f"{u}-U" for u in range(N_USERS)]
+    journal, keys = seed_journal(base)
 
     sup = ReplicaSupervisor(
         W, R, journal.dir, "models", os.path.join(base, "ports"),
@@ -187,5 +198,111 @@ def main() -> int:
     return 1 if (R >= 2 and total_err) else 0
 
 
+def elastic_main() -> int:
+    """SIGKILL a WARMING replica mid-bootstrap during a live W -> 2W
+    scale-out.  The contract under test (serve/elastic.py failure model):
+    generation g serves the whole time, the warming generation's
+    supervisor respawns the victim and replay resumes, the cutover still
+    completes, and no client sees an error."""
+    from flink_ms_tpu.serve.elastic import ElasticClient, ScaleController
+
+    base = tempfile.mkdtemp(prefix="tpums_chaos_elastic_")
+    journal, keys = seed_journal(base)
+    os.environ.setdefault(
+        "TPUMS_REGISTRY_DIR", tempfile.mkdtemp(prefix="tpums_chaos_reg_"))
+
+    ctl = ScaleController("chaos-elastic", journal.dir, "models",
+                          port_dir=os.path.join(base, "ports"),
+                          ready_timeout_s=180)
+    event("chaos_elastic_start", shards=W, target=W * 2)
+    ok = [0] * THREADS
+    errs = [0] * THREADS
+    stop = threading.Event()
+
+    def load(widx):
+        c = ElasticClient(
+            "chaos-elastic", retry=RetryPolicy(
+                attempts=6, backoff_s=0.02, max_backoff_s=0.5),
+            timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    if c.query_state(ALS_STATE, key) is None:
+                        errs[widx] += 1
+                    else:
+                        ok[widx] += 1
+                except Exception:
+                    errs[widx] += 1
+
+    result = {}
+    try:
+        ctl.scale_to(W)
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        for t in threads:
+            t.start()
+
+        t0 = time.time()
+
+        def do_scale():
+            try:
+                result["record"] = ctl.scale_to(W * 2)
+            except Exception as e:  # the arm FAILED: cutover aborted
+                result["error"] = repr(e)
+
+        st = threading.Thread(target=do_scale)
+        st.start()
+        # the window: ctl.warming is the bootstrapping generation's
+        # supervisor from launch until cutover (or abort).  Only members
+        # whose port is already known are fair game — a member killed
+        # inside its own launch wait fails the spawn instead of
+        # exercising the respawn-and-resume path under test.
+        victim = None
+        while st.is_alive() and victim is None:
+            warm = ctl.warming
+            if warm is not None:
+                launched = sorted(sr for sr in warm.procs
+                                  if sr in warm.ports)
+                if launched:
+                    sr = launched[0]
+                    proc = warm.procs.get(sr)
+                    if proc is not None and proc.poll() is None:
+                        event("chaos_kill_warming", shard=sr[0],
+                              replica=sr[1], pid=proc.pid)
+                        proc.send_signal(signal.SIGKILL)
+                        victim = sr
+            time.sleep(0.01)
+        st.join()
+        cutover_s = round(time.time() - t0, 2)
+        time.sleep(1.0)  # let the load loop exercise the new generation
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        active = ctl.active_supervisor
+        summary = {
+            "mode": "elastic", "shards": W, "target": W * 2,
+            "victim": list(victim) if victim else None,
+            "cutover_ok": "record" in result,
+            "cutover_error": result.get("error"),
+            "cutover_s": cutover_s,
+            "new_gen": result.get("record", {}).get("gen"),
+            "respawns": active.respawns if active else None,
+            "ok": sum(ok), "errors": sum(errs),
+            "controller_events": ctl.events,
+            "timeline": [e for e in recent_events()
+                         if e["kind"].startswith(("chaos_", "elastic_",
+                                                  "replica_"))],
+        }
+        print(json.dumps(summary, indent=1, default=str))
+        failed = (sum(errs) > 0 or "record" not in result
+                  or victim is None or not (active and active.respawns))
+        return 1 if failed else 0
+    finally:
+        stop.set()
+        ctl.stop(drop_topology=True)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(elastic_main() if MODE == "elastic" else main())

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""End-to-end smoke for the elastic serving plane (serve/elastic.py):
+bring up 2 shards, scale OUT to 4 under a sustained query loop, scale
+back IN to 2, and assert the two contracts the subsystem exists for —
+
+- zero failed queries: no client thread sees an error across either
+  cutover (queries ride the generation swap transparently);
+- key-coverage parity: every seeded key resolves to the same payload
+  before the first cutover, after the scale-out, and after the scale-in
+  (``hash%N`` changed twice; the data must not care).
+
+    python scripts/elastic_smoke.py [env knobs below]
+
+Knobs (env):
+    SMOKE_USERS=150        model rows per side
+    SMOKE_THREADS=3        closed-loop client threads
+    SMOKE_SETTLE_S=2       query-loop time at each topology before moving on
+    TPUMS_HEARTBEAT_S / TPUMS_REPLICA_TTL_S: liveness cadence (defaults
+                           here: 0.25 / 1.5 — fast cutovers for a demo)
+
+Exit code 0 on success, 1 on any error or coverage mismatch.
+"""
+
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPUMS_HEARTBEAT_S", "0.25")
+os.environ.setdefault("TPUMS_REPLICA_TTL_S", "1.5")
+os.environ.setdefault("TPUMS_REGISTRY_DIR",
+                      tempfile.mkdtemp(prefix="tpums_smoke_reg_"))
+
+from flink_ms_tpu.core import formats as F  # noqa: E402
+from flink_ms_tpu.serve.client import RetryPolicy  # noqa: E402
+from flink_ms_tpu.serve.consumer import ALS_STATE  # noqa: E402
+from flink_ms_tpu.serve.elastic import ElasticClient, ScaleController  # noqa: E402
+from flink_ms_tpu.serve.journal import Journal  # noqa: E402
+
+N_USERS = int(os.environ.get("SMOKE_USERS", 150))
+THREADS = int(os.environ.get("SMOKE_THREADS", 3))
+SETTLE_S = float(os.environ.get("SMOKE_SETTLE_S", 2))
+
+
+def coverage(client: ElasticClient, keys) -> dict:
+    """key -> payload for every seeded key, via the topology-following
+    client (one MGET fan-out)."""
+    vals = client.query_states(ALS_STATE, keys)
+    return dict(zip(keys, vals))
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="tpums_smoke_")
+    journal = Journal(os.path.join(base, "bus"), "models")
+    rng = np.random.default_rng(7)
+    k = 4
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=k))
+         for u in range(N_USERS)]
+        + [F.format_als_row(i, "I", rng.normal(size=k))
+           for i in range(N_USERS)]
+    )
+    keys = [f"{u}-U" for u in range(N_USERS)] \
+        + [f"{i}-I" for i in range(N_USERS)]
+
+    checks = []
+
+    def check(name, ok, detail=""):
+        checks.append((name, bool(ok)))
+        print(f"  [{'ok' if ok else 'FAIL'}] {name}"
+              + (f" — {detail}" if detail and not ok else ""))
+
+    ok_counts = [0] * THREADS
+    errors = []
+    stop = threading.Event()
+
+    def load(widx):
+        c = ElasticClient(
+            "smoke", retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                       max_backoff_s=0.5),
+            timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    if c.query_state(ALS_STATE, key) is None:
+                        errors.append((widx, key, "miss"))
+                    else:
+                        ok_counts[widx] += 1
+                except Exception as e:
+                    errors.append((widx, key, repr(e)))
+
+    ctl = ScaleController("smoke", journal.dir, "models",
+                          port_dir=os.path.join(base, "ports"),
+                          ready_timeout_s=120)
+    try:
+        t0 = time.time()
+        rec = ctl.scale_to(2)
+        check("bootstrap gen1 2 shards", rec["gen"] == 1
+              and rec["shards"] == 2)
+        probe = ElasticClient("smoke", timeout_s=10)
+        cov1 = coverage(probe, keys)
+        check("coverage@2 complete",
+              all(v is not None for v in cov1.values()),
+              f"{sum(v is None for v in cov1.values())} missing")
+
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        for t in threads:
+            t.start()
+        time.sleep(SETTLE_S)
+
+        t_out = time.time()
+        rec = ctl.scale_to(4)
+        out_s = time.time() - t_out
+        check("scale-out to gen2 4 shards", rec["gen"] == 2
+              and rec["shards"] == 4)
+        time.sleep(SETTLE_S)
+        cov2 = coverage(probe, keys)
+        check("coverage parity after scale-out", cov2 == cov1,
+              f"{sum(1 for k_ in keys if cov2[k_] != cov1[k_])} diffs")
+
+        t_in = time.time()
+        rec = ctl.scale_to(2)
+        in_s = time.time() - t_in
+        check("scale-in to gen3 2 shards", rec["gen"] == 3
+              and rec["shards"] == 2)
+        time.sleep(SETTLE_S)
+        cov3 = coverage(probe, keys)
+        check("coverage parity after scale-in", cov3 == cov1,
+              f"{sum(1 for k_ in keys if cov3[k_] != cov1[k_])} diffs")
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        probe.close()
+        total_ok = sum(ok_counts)
+        check("zero failed queries", not errors,
+              f"{len(errors)} errors, first: {errors[:3]}")
+        check("query loop exercised both cutovers", total_ok > 0)
+        summary = {
+            "queries_ok": total_ok,
+            "errors": len(errors),
+            "scale_out_s": round(out_s, 2),
+            "scale_in_s": round(in_s, 2),
+            "total_s": round(time.time() - t0, 2),
+            "generation_swaps": "per-thread (see events)",
+            "controller_events": ctl.events,
+        }
+        print(json.dumps(summary, indent=1, default=str))
+    finally:
+        stop.set()
+        ctl.stop(drop_topology=True)
+
+    failed = [n for n, ok_ in checks if not ok_]
+    print(("SMOKE PASS" if not failed else f"SMOKE FAIL: {failed}"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,466 @@
+"""Elastic serving plane (serve/elastic.py + registry topology records):
+atomic CAS-guarded topology publish, controller lease single-writer guard
+(refuse + steal-from-dead), stale-generation entry GC, the ElasticClient
+generation swap under in-flight traffic, a live subprocess rescale with
+zero failed queries, and the autoscaler policy's hysteresis/cooldown."""
+
+import json
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.serve import registry
+from flink_ms_tpu.serve.client import QueryClient, RetryPolicy
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    ServingJob,
+    make_backend,
+    parse_als_record,
+)
+from flink_ms_tpu.serve.elastic import (
+    Autoscaler,
+    AutoscalerPolicy,
+    ControllerBusy,
+    ElasticClient,
+    ScaleController,
+    generation_group,
+)
+from flink_ms_tpu.serve.ha import shard_group
+from flink_ms_tpu.serve.journal import Journal
+from flink_ms_tpu.serve.sharded import sharded_parse
+
+# registry isolation comes from conftest.py's autouse fixture (every test
+# gets a private TPUMS_REGISTRY_DIR)
+
+
+# ---------------------------------------------------------------------------
+# topology record: atomic publish + CAS (satellite)
+# ---------------------------------------------------------------------------
+
+def test_topology_publish_resolve_roundtrip():
+    assert registry.resolve_topology("tg") is None
+    rec = registry.publish_topology("tg", 2, 1)
+    assert (rec["gen"], rec["shards"], rec["replicas"]) == (1, 2, 1)
+    got = registry.resolve_topology("tg")
+    assert got["gen"] == 1 and got["kind"] == "topology"
+    # a topology record is NOT a job entry: endpoint listing skips it
+    assert registry.list_jobs() == []
+    registry.drop_topology("tg")
+    assert registry.resolve_topology("tg") is None
+
+
+def test_topology_cas_guard_raises_on_stale_generation():
+    registry.publish_topology("cas", 2)
+    registry.publish_topology("cas", 4, expect_gen=1)
+    with pytest.raises(registry.TopologyConflict):
+        registry.publish_topology("cas", 8, expect_gen=1)
+    # the losing publish changed nothing
+    got = registry.resolve_topology("cas")
+    assert got["gen"] == 2 and got["shards"] == 4
+
+
+def test_topology_history_records_and_bounds_superseded_gens():
+    for i in range(registry.TOPOLOGY_HISTORY + 3):
+        registry.publish_topology("hist", i + 1)
+    rec = registry.resolve_topology("hist")
+    assert rec["gen"] == registry.TOPOLOGY_HISTORY + 3
+    assert len(rec["history"]) == registry.TOPOLOGY_HISTORY
+    # newest superseded generation last, contiguous
+    gens = [h["gen"] for h in rec["history"]]
+    assert gens == list(range(rec["gen"] - registry.TOPOLOGY_HISTORY,
+                              rec["gen"]))
+
+
+def test_topology_concurrent_publish_is_atomic():
+    """N racing publishers (no CAS) serialize through the group lock: the
+    final generation is exactly N and the record is never torn."""
+    n = 8
+    errs = []
+
+    def publish(i):
+        try:
+            registry.publish_topology("race", i + 1)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=publish, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    rec = registry.resolve_topology("race")
+    assert rec["gen"] == n
+    # readable as plain JSON (atomic tmp+rename, never a partial write)
+    raw = json.loads(pathlib.Path(
+        registry._topology_path("race")).read_text())
+    assert raw["kind"] == "topology"
+
+
+# ---------------------------------------------------------------------------
+# controller lease: single-writer guard (satellite)
+# ---------------------------------------------------------------------------
+
+def test_controller_lease_second_acquirer_refuses():
+    t1 = registry.acquire_controller_lease("lg")
+    assert t1 is not None
+    assert registry.acquire_controller_lease("lg") is None
+    assert registry.refresh_controller_lease("lg", t1)
+    registry.release_controller_lease("lg", t1)
+    t2 = registry.acquire_controller_lease("lg")
+    assert t2 is not None and t2 != t1
+    registry.release_controller_lease("lg", t2)
+
+
+def test_controller_lease_concurrent_acquirers_exactly_one_wins():
+    tokens = []
+
+    def acquire():
+        tokens.append(registry.acquire_controller_lease("cl"))
+
+    threads = [threading.Thread(target=acquire) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(tok is not None for tok in tokens) == 1
+
+
+def test_controller_lease_stolen_from_dead_holder():
+    t1 = registry.acquire_controller_lease("dead-ctl", ttl_s=5.0)
+    assert t1 is not None
+    # the holder "dies": backdate its heartbeat past TTL
+    path = pathlib.Path(registry._controller_path("dead-ctl"))
+    entry = json.loads(path.read_text())
+    entry["heartbeat"] -= 60.0
+    path.write_text(json.dumps(entry))
+    t2 = registry.acquire_controller_lease("dead-ctl")
+    assert t2 is not None and t2 != t1
+    # the corpse's token no longer refreshes
+    assert not registry.refresh_controller_lease("dead-ctl", t1)
+    assert registry.refresh_controller_lease("dead-ctl", t2)
+    registry.release_controller_lease("dead-ctl", t2)
+
+
+def test_scale_controller_refuses_when_lease_held(tmp_path):
+    token = registry.acquire_controller_lease("busy")
+    assert token is not None
+    ctl = ScaleController("busy", str(tmp_path / "bus"), "models",
+                          port_dir=str(tmp_path / "ports"))
+    with pytest.raises(ControllerBusy):
+        ctl.scale_to(1)
+    registry.release_controller_lease("busy", token)
+
+
+# ---------------------------------------------------------------------------
+# stale-generation GC (satellite)
+# ---------------------------------------------------------------------------
+
+def _backdate(job_id, seconds):
+    path = pathlib.Path(registry._entry_path(job_id))
+    entry = json.loads(path.read_text())
+    entry["heartbeat"] -= seconds
+    path.write_text(json.dumps(entry))
+
+
+def test_gc_generation_entries_reaps_dead_old_gens_only():
+    g1 = generation_group("eg", 1)  # superseded
+    g2 = generation_group("eg", 2)  # active
+    registry.register("old-dead", "127.0.0.1", 7400, ALS_STATE,
+                      replica_of=f"{g1}/shard-0", replica=0, ttl_s=5.0)
+    _backdate("old-dead", 60.0)
+    registry.register("old-live", "127.0.0.1", 7401, ALS_STATE,
+                      replica_of=f"{g1}/shard-1", replica=0, ttl_s=5.0)
+    registry.register("new-stale", "127.0.0.1", 7402, ALS_STATE,
+                      replica_of=f"{g2}/shard-0", replica=0, ttl_s=5.0)
+    _backdate("new-stale", 60.0)
+    registry.register("other-group", "127.0.0.1", 7403, ALS_STATE,
+                      replica_of="unrelated/shard-0", replica=0)
+
+    assert registry.gc_generation_entries("eg", active_gen=2) == 1
+    # dead old-generation entry reaped; a LIVE old-generation worker is
+    # left for the drain to retire; active-generation and foreign entries
+    # untouched (the active one still falls to normal TTL GC elsewhere)
+    assert registry.resolve("old-dead") is None
+    paths = {p.name for p in pathlib.Path(registry.registry_dir()).iterdir()}
+    assert not any("old-dead" in n for n in paths)
+    assert any("old-live" in n for n in paths)
+    assert any("new-stale" in n for n in paths)
+    assert any("other-group" in n for n in paths)
+
+
+def test_generation_of_parses_only_matching_group():
+    e = {"replica_of": f"{generation_group('g', 7)}/shard-3"}
+    assert registry.generation_of(e, "g") == 7
+    assert registry.generation_of(e, "other") is None
+    assert registry.generation_of({"replica_of": "g/shard-0"}, "g") is None
+    assert registry.generation_of({}, "g") is None
+
+
+# ---------------------------------------------------------------------------
+# ElasticClient: generation swap under traffic (in-process, deterministic)
+# ---------------------------------------------------------------------------
+
+def _seed_journal(tmp_path, n=24, k=3, seed=0):
+    journal = Journal(str(tmp_path / "bus"), "models")
+    rng = np.random.default_rng(seed)
+    rows = [F.format_als_row(u, "U", rng.normal(size=k)) for u in range(n)]
+    journal.append(rows)
+    return journal, [f"{u}-U" for u in range(n)]
+
+
+def _gen_worker(journal, group, gen, shard, shards):
+    gg = generation_group(group, gen)
+    return ServingJob(
+        journal, ALS_STATE,
+        sharded_parse(parse_als_record, shard, shards),
+        make_backend("memory", None),
+        host="127.0.0.1", port=0, poll_interval_s=0.01,
+        job_id=f"{gg}:s{shard}r0", replica_of=shard_group(gg, shard),
+        replica_index=0, topk_index=False,
+        topology_group=group, generation=gen,
+    ).start()
+
+
+def test_elastic_client_follows_generation_swap(tmp_path):
+    """gen1 = 2 shards, gen2 = 3 shards over the same journal.  A client
+    built at gen1 must keep answering through the cutover — via the
+    refresh cadence AND via the forced re-resolve after gen1 stops."""
+    journal, keys = _seed_journal(tmp_path)
+    gen1 = [_gen_worker(journal, "ec", 1, s, 2) for s in range(2)]
+    try:
+        for job in gen1:
+            assert job.wait_ready(30)
+        registry.publish_topology("ec", 2)
+        # refresh_s huge: the cadence path must NOT be what saves us later
+        c = ElasticClient("ec", refresh_s=999.0,
+                          retry=RetryPolicy(attempts=4, backoff_s=0.01,
+                                            max_backoff_s=0.1),
+                          timeout_s=5)
+        with c:
+            assert c.generation == 1 and c.num_workers == 2
+            before = {k_: c.query_state(ALS_STATE, k_) for k_ in keys}
+            assert all(v is not None for v in before.values())
+
+            # HEALTH carries the topology hint fields
+            h = c.shard_health(ALS_STATE, 0)
+            assert h["topology_group"] == "ec" and h["generation"] == 1
+
+            gen2 = [_gen_worker(journal, "ec", 2, s, 3) for s in range(3)]
+            try:
+                for job in gen2:
+                    assert job.wait_ready(30)
+                registry.publish_topology("ec", 3, expect_gen=1)
+                for job in gen1:  # drain the old generation completely
+                    job.stop()
+                # resolution miss on the drained set -> forced topology
+                # re-read -> transparent retry on gen2, zero errors
+                after = {k_: c.query_state(ALS_STATE, k_) for k_ in keys}
+                assert after == before
+                assert c.generation == 2 and c.num_workers == 3
+                assert c.generation_swaps == 1
+                # batched path follows too
+                assert c.query_states(ALS_STATE, keys) == \
+                    [before[k_] for k_ in keys]
+                assert c.total_count(ALS_STATE) == len(keys)
+            finally:
+                for job in gen2:
+                    job.stop()
+    finally:
+        for job in gen1:
+            job.stop()
+
+
+def test_elastic_client_hint_triggers_refresh(tmp_path):
+    """note_topology_gen (the HEALTH topology_gen hint) forces the next
+    call to re-resolve even inside the refresh cadence."""
+    journal, keys = _seed_journal(tmp_path, n=8)
+    gen1 = [_gen_worker(journal, "hint", 1, 0, 1)]
+    gen2 = []
+    try:
+        assert gen1[0].wait_ready(30)
+        registry.publish_topology("hint", 1)
+        c = ElasticClient("hint", refresh_s=999.0, timeout_s=5)
+        with c:
+            assert c.query_state(ALS_STATE, keys[0]) is not None
+            gen2 = [_gen_worker(journal, "hint", 2, s, 2) for s in range(2)]
+            for job in gen2:
+                assert job.wait_ready(30)
+            registry.publish_topology("hint", 2, expect_gen=1)
+            # gen1 still alive: no resolution miss — only the hint can
+            # trigger the swap before the (disabled) cadence
+            assert c.generation == 1
+            c.note_topology_gen(2)
+            assert c.query_state(ALS_STATE, keys[0]) is not None
+            assert c.generation == 2
+    finally:
+        for job in gen1 + gen2:
+            job.stop()
+
+
+def test_elastic_client_no_topology_times_out():
+    with pytest.raises(ConnectionError):
+        ElasticClient("nope", resolve_timeout_s=0.2)
+
+
+# ---------------------------------------------------------------------------
+# ScaleController e2e: live rescale, zero failed queries (subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_scale_controller_live_rescale_zero_errors(tmp_path, monkeypatch):
+    """The acceptance scenario, sized for CI: bootstrap 1 shard, scale out
+    to 2 under a sustained query stream.  Zero client-visible errors,
+    served-key parity across the cutover, and the old generation's workers
+    actually drained."""
+    monkeypatch.setenv("TPUMS_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("TPUMS_REPLICA_TTL_S", "30")
+    journal, keys = _seed_journal(tmp_path, n=30, seed=5)
+    ctl = ScaleController(
+        "live", str(tmp_path / "bus"), "models",
+        port_dir=str(tmp_path / "ports"), ready_timeout_s=90,
+    )
+    try:
+        rec = ctl.scale_to(1)
+        assert rec["gen"] == 1 and rec["shards"] == 1
+        errors = []
+        served = [0]
+        stop = threading.Event()
+
+        def stream():
+            c = ElasticClient(
+                "live", retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                          max_backoff_s=0.5), timeout_s=10)
+            with c:
+                while not stop.is_set():
+                    for key in keys:
+                        try:
+                            if c.query_state(ALS_STATE, key) is None:
+                                errors.append((key, "missing"))
+                        except Exception as e:
+                            errors.append((key, repr(e)))
+                        served[0] += 1
+
+        probe = ElasticClient("live", timeout_s=10)
+        before = probe.query_states(ALS_STATE, keys)
+        assert all(v is not None for v in before)
+
+        t = threading.Thread(target=stream, daemon=True)
+        t.start()
+        deadline = time.time() + 10
+        while served[0] < 30 and time.time() < deadline:
+            time.sleep(0.02)
+
+        rec = ctl.scale_to(2)
+        assert rec["gen"] == 2 and rec["shards"] == 2
+
+        mark = served[0]
+        deadline = time.time() + 10
+        while served[0] < mark + 60 and time.time() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=30)
+        assert errors == [], f"client-visible errors: {errors[:5]}"
+
+        # served-key parity across the cutover
+        assert probe.query_states(ALS_STATE, keys) == before
+        assert probe.generation == 2
+        probe.close()
+
+        # generation 1 drained: its supervisor is gone from the controller
+        # and no gen-1 entry remains live in the registry
+        assert 1 not in ctl.supervisors and 2 in ctl.supervisors
+        gen1_left = [e for e in registry.list_jobs()
+                     if registry.generation_of(e, "live") == 1]
+        assert gen1_left == []
+        kinds = [e["kind"] for e in ctl.events]
+        assert kinds.count("cutover") == 2 and "drained" in kinds
+    finally:
+        ctl.stop(drop_topology=True)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler policy (pure decide) + dry-run loop
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_decide_hysteresis_and_cooldown():
+    p = AutoscalerPolicy(qps_high_per_shard=500, qps_low_per_shard=100,
+                         p99_high_s=0.05, backlog_high_bytes=1 << 20,
+                         min_shards=1, max_shards=8, cooldown_s=30)
+    calm = {"qps": 50.0, "p99_s": 0.001, "backlog_bytes": 0}
+    hot = {"qps": 5000.0, "p99_s": 0.001, "backlog_bytes": 0}
+
+    # cooldown wins over any pressure
+    assert p.decide(hot, 2, now=100.0, last_scale_t=90.0)["target"] is None
+    # scale-out doubles, clamped at max
+    assert p.decide(hot, 2, 100.0, 0.0)["target"] == 4
+    assert p.decide(hot, 8, 100.0, 0.0)["target"] is None  # at max
+    # p99 and backlog each independently trigger scale-out
+    assert p.decide({"qps": 10, "p99_s": 0.2, "backlog_bytes": 0},
+                    2, 100.0, 0.0)["target"] == 4
+    assert p.decide({"qps": 10, "p99_s": 0.001, "backlog_bytes": 2 << 20},
+                    2, 100.0, 0.0)["target"] == 4
+    # scale-in halves, clamped at min
+    assert p.decide(calm, 4, 100.0, 0.0)["target"] == 2
+    assert p.decide(calm, 1, 100.0, 0.0)["target"] is None  # at min
+    # hysteresis band: between low and high nothing moves
+    steady = {"qps": 600.0, "p99_s": 0.001, "backlog_bytes": 0}  # 300/shard
+    assert p.decide(steady, 2, 100.0, 0.0)["target"] is None
+    # missing p99 (no traffic in the window) blocks neither direction
+    assert p.decide({"qps": 0.0, "p99_s": None, "backlog_bytes": 0},
+                    4, 100.0, 0.0)["target"] == 2
+
+
+def test_autoscaler_dry_run_records_but_never_scales(tmp_path):
+    ctl = ScaleController("dry", str(tmp_path / "bus"), "models",
+                          port_dir=str(tmp_path / "ports"))
+    scaler = Autoscaler(ctl, AutoscalerPolicy(cooldown_s=0),
+                        interval_s=60, dry_run=True)
+    # no fleet at all: first cycle establishes the window, the second
+    # decides on an empty one — and must not touch the controller
+    d1 = scaler.run_once()
+    assert d1["target"] is None and "first scrape" in d1["reason"]
+    d2 = scaler.run_once()
+    assert d2["target"] is None
+    assert ctl.scales == 0 and ctl.current() is None
+    assert len(scaler.decisions) == 1  # only windowed cycles are recorded
+
+
+def test_fleet_signals_derives_qps_p99_backlog():
+    from flink_ms_tpu.obs.metrics import LATENCY_BUCKETS_S
+    from flink_ms_tpu.obs.scrape import fleet_signals
+
+    n_b = len(LATENCY_BUCKETS_S) + 1
+
+    def hist(verb, count, total_s, counts):
+        return {"name": "tpums_server_latency_seconds",
+                "labels": {"verb": verb}, "le": list(LATENCY_BUCKETS_S),
+                "counts": counts, "count": count, "sum": total_s}
+
+    zero = [0] * n_b
+    # 100 GETs land in one mid-ladder bucket; HEALTH polling must not count
+    bucket = 40
+    after_counts = list(zero)
+    after_counts[bucket] = 100
+    before = {"ts": 1000.0,
+              "histograms": [hist("GET", 0, 0.0, zero),
+                             hist("HEALTH", 0, 0.0, zero)],
+              "gauges": []}
+    after = {"ts": 1010.0,
+             "histograms": [hist("GET", 100, 0.5, after_counts),
+                            hist("HEALTH", 500, 1.0,
+                                 [500] + zero[1:])],
+             "gauges": [{"name": "tpums_journal_backlog_bytes",
+                         "labels": {"state": ALS_STATE}, "value": 4096}]}
+    sig = fleet_signals(before, after)
+    assert sig["qps"] == pytest.approx(10.0)
+    assert sig["requests"] == 100
+    assert sig["backlog_bytes"] == 4096
+    assert sig["dt_s"] == pytest.approx(10.0)
+    # p99 falls inside the bucket the observations landed in
+    lo = LATENCY_BUCKETS_S[bucket - 1]
+    hi = LATENCY_BUCKETS_S[bucket]
+    assert lo <= sig["p99_s"] <= hi

@@ -448,3 +448,134 @@ def test_vectorized_batch_matches_sequential_no_dups(rng):
         # byte-identical rows: batchSize N and batchSize 1 must emit the
         # same journal text (per-row BLAS dot + elementwise broadcast)
         assert got == want
+
+
+# ---------------------------------------------------------------------------
+# bias updates (--updateBias / TPUMS_SGD_BIAS): the reference computes bias
+# deltas and drops them (SGD.java:209,232 TODO); the flag persists them.
+# Both modes are regression-pinned here.
+# ---------------------------------------------------------------------------
+
+def test_bias_flag_off_is_byte_identical_to_unbiased():
+    """Default mode must keep emitting exactly the historical rows — the
+    flag's OFF state is the reference-parity contract."""
+    table = {"1-U": "1.0;2.0;0.25", "5-I": "0.5;-1.0;0.125"}
+    plain = SGDStep(table.get, "0;0;0", "0;0;0", learning_rate=0.1,
+                    user_reg=0.01, item_reg=0.02)
+    flagged = SGDStep(table.get, "0;0;0", "0;0;0", learning_rate=0.1,
+                      user_reg=0.01, item_reg=0.02, update_bias=False)
+    assert plain.process(1, 5, 3.0) == flagged.process(1, 5, 3.0)
+    # and the unbiased rule treats ALL elements as factors (dot over 3)
+    u = np.array([1.0, 2.0, 0.25]); v = np.array([0.5, -1.0, 0.125])
+    err = 3.0 - float(u @ v)
+    want_u = u + 0.1 * (err * v - 0.01 * u)
+    _, _, got_u = F.parse_als_row(plain.process(1, 5, 3.0)[0])
+    np.testing.assert_allclose(got_u, want_u, rtol=1e-12)
+
+
+def test_bias_update_math_v1():
+    """Last element is the bias: prediction adds bu + bi, the factor rule
+    applies to the leading elements, and b' = b + lr*(err - reg*b)."""
+    table = {"1-U": "1.0;2.0;0.25", "5-I": "0.5;-1.0;0.125"}
+    step = SGDStep(table.get, "0;0;0", "0;0;0", learning_rate=0.1,
+                   user_reg=0.01, item_reg=0.02, update_bias=True)
+    rows = step.process(1, 5, 3.0)
+    uf = np.array([1.0, 2.0]); vf = np.array([0.5, -1.0])
+    bu, bi = 0.25, 0.125
+    err = 3.0 - (float(uf @ vf) + bu + bi)
+    want_uf = uf + 0.1 * (err * vf - 0.01 * uf)
+    want_vf = vf + 0.1 * (err * uf - 0.02 * vf)  # v1: old uf
+    want_bu = bu + 0.1 * (err - 0.01 * bu)
+    want_bi = bi + 0.1 * (err - 0.02 * bi)
+    _, _, got_u = F.parse_als_row(rows[0])
+    _, _, got_v = F.parse_als_row(rows[1])
+    np.testing.assert_allclose(got_u, np.append(want_uf, want_bu), rtol=1e-12)
+    np.testing.assert_allclose(got_v, np.append(want_vf, want_bi), rtol=1e-12)
+
+
+def test_bias_update_math_v0_sequential():
+    table = {"1-U": "1.0;2.0;0.25", "5-I": "0.5;-1.0;0.125"}
+    step = SGDStep(table.get, "0;0;0", "0;0;0", learning_rate=0.1,
+                   version="v0", update_bias=True)
+    rows = step.process(1, 5, 3.0)
+    uf = np.array([1.0, 2.0]); vf = np.array([0.5, -1.0])
+    err = 3.0 - (float(uf @ vf) + 0.25 + 0.125)
+    uf_new = uf + 0.1 * err * vf
+    want_vf = vf + 0.1 * err * uf_new  # v0: item step sees updated user
+    _, _, got_v = F.parse_als_row(rows[1])
+    np.testing.assert_allclose(got_v[:-1], want_vf, rtol=1e-12)
+
+
+def test_bias_batch_vectorized_parity():
+    """The (B, k) fast path must emit byte-identical rows to per-rating
+    processing with the bias flag on, for both versions."""
+    rng = np.random.default_rng(11)
+    k = 4
+    snap = {f"{u}-U": ";".join(repr(float(x)) for x in rng.normal(size=k))
+            for u in range(6)}
+    snap.update({f"{i}-I": ";".join(repr(float(x)) for x in rng.normal(size=k))
+                 for i in range(6)})
+    ratings = [(u, u, 2.0 + u) for u in range(6)]
+    for version in ("v1", "v0"):
+        seq = SGDStep(snap.get, "0;0;0;0", "0;0;0;0", learning_rate=0.1,
+                      user_reg=0.01, item_reg=0.02, version=version,
+                      update_bias=True)
+        want = []
+        for u, i, r in ratings:
+            want.extend(seq.process(u, i, r))
+        batch = SGDStep(snap.get, "0;0;0;0", "0;0;0;0", learning_rate=0.1,
+                        user_reg=0.01, item_reg=0.02, version=version,
+                        update_bias=True,
+                        lookup_many=lambda keys: [snap.get(k2) for k2 in keys])
+        got = batch.process_batch(ratings)
+        assert batch.vectorized_chunks == 1, "fast path did not engage"
+        assert got == want
+
+
+def test_bias_cli_flag_and_env(monkeypatch):
+    """--updateBias and TPUMS_SGD_BIAS both reach SGDStep; the explicit
+    flag wins over the environment."""
+    captured = {}
+    real_init = SGDStep.__init__
+
+    def spy_init(self, *a, **kw):
+        captured["update_bias"] = kw.get("update_bias", False)
+        real_init(self, *a, **kw)
+
+    monkeypatch.setattr(SGDStep, "__init__", spy_init)
+    # serve a tiny model so run() has an endpoint to talk to
+    from flink_ms_tpu.serve.server import LookupServer
+    from flink_ms_tpu.serve.table import ModelTable
+
+    table = ModelTable(2)
+    table.put("MEAN-U", "0;0;0")
+    table.put("MEAN-I", "0;0;0")
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0).start()
+    try:
+        import tempfile
+
+        src = tempfile.mkdtemp()
+        out = tempfile.mkdtemp()
+        with open(f"{src}/r.tsv", "w") as f:
+            f.write("1\t2\t3.0\n")
+
+        def run_once(extra, env_val):
+            if env_val is None:
+                monkeypatch.delenv("TPUMS_SGD_BIAS", raising=False)
+            else:
+                monkeypatch.setenv("TPUMS_SGD_BIAS", env_val)
+            sgd_mod.run(Params.from_args([
+                "--mode", "once", "--outputMode", "hdfs",
+                "--input", f"{src}/r.tsv",
+                "--outputPath", f"{out}/updates.txt",
+                "--jobId", "any", "--jobManagerHost", "127.0.0.1",
+                "--jobManagerPort", str(srv.port), *extra,
+            ]))
+            return captured["update_bias"]
+
+        assert run_once([], None) is False
+        assert run_once([], "1") is True
+        assert run_once(["--updateBias", "false"], "1") is False
+        assert run_once(["--updateBias", "true"], None) is True
+    finally:
+        srv.stop()
