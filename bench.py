@@ -38,12 +38,18 @@ BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 (per-device peak for MFU; default inferred from device_kind),
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
-serving_ha,serving_elastic; default all), BENCH_INGEST_ROWS /
+serving_ha,serving_elastic,serving_rehearsal; default all),
+BENCH_INGEST_ROWS /
 BENCH_INGEST_K / BENCH_INGEST_PROP_PROBES (serving-ingest replay scale),
 BENCH_HA_USERS / BENCH_HA_DURATION_S / BENCH_HA_WORKERS /
 BENCH_HA_HEARTBEAT_S / BENCH_HA_TTL_S (serving-HA kill-a-replica arms),
 BENCH_ELASTIC_USERS / BENCH_ELASTIC_WINDOW_S (serving-elastic live
 2->4 rescale: p50/p99 before/during/after + cutover duration),
+BENCH_HA_RATE_QPS / BENCH_ELASTIC_RATE_QPS (open-loop pacing of the
+HA/elastic query arms; latency recorded from intended send time),
+BENCH_REHEARSAL_* (closed-loop SLO rehearsal: SHARDS / REPLICATION /
+USERS / BASE_QPS / PEAK_QPS / BURST_QPS / THREADS / AUTOSCALE / KILL /
+OUT — emits SLO_REPORT.json, see obs/workload.py),
 BENCH_ALS_PRECISION / BENCH_ALS_EXCHANGE (kernel-config A/B),
 BENCH_SKIP_QUALITY=1 / BENCH_RMSE_REF_NNZ / BENCH_RMSE_REF_ITERS (ALS
 quality anchor), BENCH_SVM_TARGET / BENCH_SVM_REF_ROUNDS / BENCH_SVM_FLIP
@@ -1101,7 +1107,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
     small = os.environ.get("BENCH_SMALL") == "1"
     sections = os.environ.get(
         "BENCH_SECTIONS",
-        "als,svm,serving,svmserve,serving_ingest,serving_ha,serving_elastic"
+        "als,svm,serving,svmserve,serving_ingest,serving_ha,"
+        "serving_elastic,serving_rehearsal"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1172,6 +1179,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_ingest", "run_serving_ingest_section", lambda f: f(small)),
         ("serving_ha", "run_serving_ha_section", lambda f: f(small)),
         ("serving_elastic", "run_serving_elastic_section",
+         lambda f: f(small)),
+        ("serving_rehearsal", "run_serving_rehearsal_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

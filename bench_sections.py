@@ -1417,10 +1417,13 @@ def run_serving_ha_section(small: bool) -> dict:
     from flink_ms_tpu.serve.ha import ReplicaSupervisor
     from flink_ms_tpu.serve.journal import Journal
 
+    from flink_ms_tpu.obs.workload import OpenLoopPacer
+
     n_users = int(os.environ.get("BENCH_HA_USERS", 500 if small else 5_000))
     duration_s = float(
         os.environ.get("BENCH_HA_DURATION_S", 6 if small else 20))
     workers = int(os.environ.get("BENCH_HA_WORKERS", 2))
+    rate_qps = float(os.environ.get("BENCH_HA_RATE_QPS", 300))
 
     tmp = tempfile.mkdtemp(prefix="bench_ha_")
     # fast liveness cadence so detection/recovery fit the bench window; the
@@ -1452,7 +1455,7 @@ def run_serving_ha_section(small: bool) -> dict:
                 os.path.join(tmp, f"ports-{tag}"), state_backend="memory",
                 check_interval_s=registry.heartbeat_interval_s(),
                 respawn_delay_s=0.1)
-            ms, counts = [], {"ok": 0, "err": 0}
+            ms, svc_ms, counts = [], [], {"ok": 0, "err": 0}
             stop = threading.Event()
 
             # tight retry budget (~30 ms of backoff): enough for R=2 to
@@ -1460,12 +1463,18 @@ def run_serving_ha_section(small: bool) -> dict:
             # R=1 respawn+replay outage — that contrast is the metric
             def load():
                 rnd = np.random.default_rng(1)
+                # OPEN loop: a paced schedule that never skips a slot, with
+                # latency measured from the INTENDED send time — the R=1
+                # outage builds real backlog and it shows in p99 instead of
+                # being coordinated-omission'd away by the blocked client
+                pacer = OpenLoopPacer(rate_qps)
                 with sup.client(
                         retry=RetryPolicy(attempts=3, backoff_s=0.01,
                                           max_backoff_s=0.1),
                         timeout_s=10) as c:
                     while not stop.is_set():
                         key = keys[int(rnd.integers(len(keys)))]
+                        t_int = pacer.next_slot()
                         t0 = time.perf_counter()
                         try:
                             if c.query_state(ALS_STATE, key) is None:
@@ -1474,7 +1483,9 @@ def run_serving_ha_section(small: bool) -> dict:
                                 counts["ok"] += 1
                         except Exception:
                             counts["err"] += 1
-                        ms.append((time.perf_counter() - t0) * 1000.0)
+                        done = time.perf_counter()
+                        ms.append((done - t_int) * 1000.0)
+                        svc_ms.append((done - t0) * 1000.0)
 
             with sup.start():
                 assert sup.wait_all_ready(120), "HA cluster never ready"
@@ -1510,12 +1521,16 @@ def run_serving_ha_section(small: bool) -> dict:
             out.update(
                 {f"serving_ha_{tag}_{q}_ms": v
                  for q, v in _pcts(ms).items()})
+            out.update(
+                {f"serving_ha_{tag}_svc_{q}_ms": v
+                 for q, v in _pcts(svc_ms).items()})
             out[f"serving_ha_{tag}_recovery_s"] = (
                 None if t_ready is None else round(t_ready - t_kill, 2))
             _log(f"[bench:ha] {tag}: {total} queries, "
                  f"{counts['err']} errors, availability "
                  f"{out[f'serving_ha_{tag}_availability']}, recovery "
                  f"{out[f'serving_ha_{tag}_recovery_s']}s")
+        out["serving_ha_openloop_rate_qps"] = rate_qps
         return out
     finally:
         for key, val in saved.items():
@@ -1542,10 +1557,13 @@ def run_serving_elastic_section(small: bool) -> dict:
     from flink_ms_tpu.serve.elastic import ElasticClient, ScaleController
     from flink_ms_tpu.serve.journal import Journal
 
+    from flink_ms_tpu.obs.workload import OpenLoopPacer
+
     n_users = int(
         os.environ.get("BENCH_ELASTIC_USERS", 400 if small else 4_000))
     window_s = float(
         os.environ.get("BENCH_ELASTIC_WINDOW_S", 3 if small else 10))
+    rate_qps = float(os.environ.get("BENCH_ELASTIC_RATE_QPS", 300))
 
     tmp = tempfile.mkdtemp(prefix="bench_elastic_")
     saved = {key: os.environ.get(key) for key in
@@ -1572,12 +1590,17 @@ def run_serving_elastic_section(small: bool) -> dict:
                               port_dir=os.path.join(tmp, "ports"),
                               ready_timeout_s=180)
         phases = {"before": [], "during": [], "after": []}
+        svc_phases = {"before": [], "during": [], "after": []}
         phase = ["before"]
         counts = {"ok": 0, "err": 0}
         stop = threading.Event()
 
         def load():
             rnd = np.random.default_rng(1)
+            # open-loop pacing: the cutover stall shows up as backlog in
+            # the "during" p99 (latency from intended send), with the
+            # old send->reply statistic kept alongside as *_svc_*
+            pacer = OpenLoopPacer(rate_qps)
             with ElasticClient(
                     "bench-elastic",
                     retry=RetryPolicy(attempts=6, backoff_s=0.02,
@@ -1585,6 +1608,7 @@ def run_serving_elastic_section(small: bool) -> dict:
                     timeout_s=10) as c:
                 while not stop.is_set():
                     key = keys[int(rnd.integers(len(keys)))]
+                    t_int = pacer.next_slot()
                     t0 = time.perf_counter()
                     try:
                         if c.query_state(ALS_STATE, key) is None:
@@ -1593,8 +1617,9 @@ def run_serving_elastic_section(small: bool) -> dict:
                             counts["ok"] += 1
                     except Exception:
                         counts["err"] += 1
-                    phases[phase[0]].append(
-                        (time.perf_counter() - t0) * 1000.0)
+                    done = time.perf_counter()
+                    phases[phase[0]].append((done - t_int) * 1000.0)
+                    svc_phases[phase[0]].append((done - t0) * 1000.0)
 
         try:
             rec = ctl.scale_to(2)
@@ -1622,8 +1647,12 @@ def run_serving_elastic_section(small: bool) -> dict:
         out["serving_elastic_availability"] = (
             round(counts["ok"] / total, 6) if total else None)
         out["serving_elastic_cutover_s"] = round(cutover_s, 2)
+        out["serving_elastic_openloop_rate_qps"] = rate_qps
         for name, ms in phases.items():
             out.update({f"serving_elastic_{name}_{q}_ms": v
+                        for q, v in _pcts(ms).items()})
+        for name, ms in svc_phases.items():
+            out.update({f"serving_elastic_{name}_svc_{q}_ms": v
                         for q, v in _pcts(ms).items()})
         _log(f"[bench:elastic] {total} queries, {counts['err']} errors, "
              f"cutover {out['serving_elastic_cutover_s']}s, p99 "
@@ -1639,3 +1668,72 @@ def run_serving_elastic_section(small: bool) -> dict:
             else:
                 os.environ[key] = val
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_serving_rehearsal_section(small: bool) -> dict:
+    """Closed-loop production rehearsal (obs/workload.py + obs/slo.py):
+    zipfian mixed-verb open-loop traffic with a correlated burst against a
+    live 2-shard replicated elastic group, while the autoscaler (tripped
+    by the burst) performs a live scale-out and a chaos kill takes down a
+    serving replica — all attributed on one timeline and gated by per-verb
+    SLOs.  Emits the machine-readable ``SLO_REPORT.json`` artifact; the
+    flat keys below are the bench-level summary of it."""
+    from flink_ms_tpu.obs.slo import human_summary
+    from flink_ms_tpu.obs.workload import run_rehearsal
+
+    out_path = os.environ.get("BENCH_REHEARSAL_OUT", "SLO_REPORT.json")
+    report = run_rehearsal(
+        out_path=out_path,
+        shards=int(os.environ.get("BENCH_REHEARSAL_SHARDS", 2)),
+        replication=int(os.environ.get("BENCH_REHEARSAL_REPLICATION", 2)),
+        users=int(os.environ.get(
+            "BENCH_REHEARSAL_USERS", 200 if small else 2_000)),
+        base_qps=float(os.environ.get(
+            "BENCH_REHEARSAL_BASE_QPS", 80 if small else 200)),
+        peak_qps=float(os.environ.get(
+            "BENCH_REHEARSAL_PEAK_QPS", 160 if small else 400)),
+        burst_qps=float(os.environ.get(
+            "BENCH_REHEARSAL_BURST_QPS", 420 if small else 1_000)),
+        warm_s=2.0 if small else 4.0,
+        ramp_s=3.0 if small else 6.0,
+        burst_s=5.0 if small else 10.0,
+        cool_s=3.0 if small else 6.0,
+        threads=int(os.environ.get(
+            "BENCH_REHEARSAL_THREADS", 4 if small else 8)),
+        autoscale=os.environ.get("BENCH_REHEARSAL_AUTOSCALE", "live"),
+        kill=os.environ.get("BENCH_REHEARSAL_KILL", "1") != "0",
+        seed=0,
+    )
+    for line in human_summary(report).splitlines():
+        _log(f"[bench:rehearsal] {line}")
+
+    wl = report["workload"]
+    timeline = report["timeline"]
+    out = {
+        "serving_rehearsal_ok": report["ok"],
+        "serving_rehearsal_scheduled": wl["scheduled"],
+        "serving_rehearsal_completed": wl["completed"],
+        "serving_rehearsal_achieved_qps": wl["achieved_qps"],
+        "serving_rehearsal_max_sched_lag_s": wl["max_sched_lag_s"],
+        "serving_rehearsal_errors": report["errors"]["total"],
+        "serving_rehearsal_unattributed_errors":
+            report["errors"]["unattributed"],
+        "serving_rehearsal_breaches": len(report["breaches"]),
+        "serving_rehearsal_unattributed_breaches": sum(
+            1 for b in report["breaches"] if not b["attributed_to"]),
+        "serving_rehearsal_kills": sum(
+            1 for e in timeline if "kill" in e.get("kind", "")),
+        "serving_rehearsal_cutovers": sum(
+            1 for e in timeline if e.get("kind") == "elastic_cutover"),
+        "serving_rehearsal_report": report.get("report_path", out_path),
+    }
+    for verb, v in report["verbs"].items():
+        tag = verb.lower()
+        out[f"serving_rehearsal_{tag}_availability"] = v["availability"]
+        out[f"serving_rehearsal_{tag}_p99_ms"] = v["p99_ms"]
+        out[f"serving_rehearsal_{tag}_svc_p99_ms"] = v["service_p99_ms"]
+        out[f"serving_rehearsal_{tag}_fleet_p99_ms"] = v["fleet_p99_ms"]
+        out[f"serving_rehearsal_{tag}_burn_rate"] = v["burn_rate"]
+        out[f"serving_rehearsal_{tag}_p99_bucket_delta"] = \
+            v["p99_bucket_delta"]
+    return out

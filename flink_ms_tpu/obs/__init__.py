@@ -6,6 +6,10 @@ scrape.
 - ``obs.tracing`` — wire-propagated trace ids (``tid=`` tab field),
   thread-local context, structured JSONL event log.
 - ``obs.scrape`` — registry-driven fleet scrape + per-shard aggregation.
+- ``obs.workload`` — open-loop zipfian mixed-verb traffic engine with
+  coordinated-omission-safe recording + the closed-loop rehearsal driver.
+- ``obs.slo`` — declarative per-verb objectives, error-budget burn rates,
+  and the ``SLOReport`` artifact with event attribution.
 
 Knobs: ``TPUMS_METRICS=0`` disables collection (observations become one
 attribute check); ``TPUMS_TRACE=<path>`` mirrors events to a JSONL file
@@ -46,3 +50,7 @@ from .tracing import (  # noqa: F401
     trace_span,
     unstamp_reply,
 )
+
+# workload/slo are intentionally NOT imported eagerly: they pull in the
+# serving stack when actually driven.  Import them as submodules
+# (``from flink_ms_tpu.obs import workload, slo``).

@@ -74,6 +74,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 from ..core.params import Params
+from ..obs import metrics as obs_metrics
 from ..obs import tracing as obs_tracing
 from . import registry
 from .client import RetryPolicy
@@ -424,6 +425,11 @@ class ElasticClient:
             self._maybe_swap(force=True)
             if self.generation == was:
                 raise
+            # absorbed by the swap: count it per verb so the SLO layer can
+            # attribute cutover-window retries separately from failovers
+            obs_metrics.get_registry().counter(
+                "tpums_client_gen_retries_total",
+                verb=HAShardedClient._OP_VERB.get(op, op.upper())).inc()
             return getattr(self._inner, op)(*args)
 
     # -- query surface (HAShardedClient-compatible) ------------------------

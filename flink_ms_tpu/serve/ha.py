@@ -183,6 +183,7 @@ class HAShardedClient:
         reg = obs_metrics.get_registry()
         self._obs_failovers = reg.counter("tpums_client_failovers_total")
         self._obs_refreshes = reg.counter("tpums_client_refreshes_total")
+        self._obs_reg = reg
         self._shards = [_ShardSet() for _ in range(num_workers)]
         from concurrent.futures import ThreadPoolExecutor
 
@@ -241,6 +242,19 @@ class HAShardedClient:
             ss.clients[ep] = c
         return c
 
+    # which wire verb each client op's final failure burns budget against
+    # (the SLO layer attributes client-visible errors per verb)
+    _OP_VERB = {
+        "query_state": "GET", "query_states": "MGET",
+        "topk_by_vector_pipelined": "TOPKV", "count": "COUNT",
+        "ping": "PING", "health": "HEALTH",
+    }
+
+    def _count_error(self, op: str) -> None:
+        self._obs_reg.counter(
+            "tpums_client_errors_total",
+            verb=self._OP_VERB.get(op, op.upper())).inc()
+
     def _call(self, shard: int, op: str, *args):
         """Run ``QueryClient.<op>(*args)`` against shard ``shard`` with
         failover: connection-class errors cool the replica down and move
@@ -280,6 +294,7 @@ class HAShardedClient:
                         host=ep[0], port=ep[1], error=str(e))
                     failures += 1
                     if failures >= self.retry.attempts:
+                        self._count_error(op)
                         raise
                     self.retry.sleep(failures - 1)
                     continue
@@ -288,6 +303,7 @@ class HAShardedClient:
             # full pass failed: the set itself is stale (respawned
             # replicas live on new ports) — force re-resolution
             self._refresh(shard, force=True)
+        self._count_error(op)
         if last_err is not None:
             raise last_err
         raise ConnectionError(

@@ -40,6 +40,7 @@ import sys
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..core.params import Params
+from ..obs import metrics as obs_metrics
 from ..obs import tracing as obs_tracing
 from .client import QueryClient
 from .consumer import (
@@ -124,8 +125,18 @@ class ShardedQueryClient:
     def owner(self, key: str) -> int:
         return owner_of(key, self.num_workers)
 
+    def _count_error(self, verb: str) -> None:
+        # no failover here: a raise IS client-visible — attribute it per
+        # verb (same series the HA client's terminal failures land in)
+        obs_metrics.get_registry().counter(
+            "tpums_client_errors_total", verb=verb).inc()
+
     def query_state(self, name: str, key: str) -> Optional[str]:
-        return self._clients[self.owner(key)].query_state(name, key)
+        try:
+            return self._clients[self.owner(key)].query_state(name, key)
+        except (ConnectionError, OSError, TimeoutError):
+            self._count_error("GET")
+            raise
 
     def query_states(self, name: str, keys) -> list:
         """Batched lookups: one MGET per worker that owns any of the keys,
@@ -142,10 +153,16 @@ class ShardedQueryClient:
             # (profiled, scripts/shard_profile.py: 2-key MGET p50 0.104 ms
             # pooled vs 0.041 ms sequential — per-worker service is
             # ~0.02 ms) — issue the sub-MGETs serially on this thread
-            for w, positions in by_owner.items():
-                for p, v in zip(positions, self._clients[w].query_states(
-                        name, [keys[p] for p in positions])):
-                    out[p] = v
+            try:
+                for w, positions in by_owner.items():
+                    for p, v in zip(positions,
+                                    self._clients[w].query_states(
+                                        name,
+                                        [keys[p] for p in positions])):
+                        out[p] = v
+            except (ConnectionError, OSError, TimeoutError):
+                self._count_error("MGET")
+                raise
             return out
         from concurrent.futures import wait as _futures_wait
 
@@ -165,9 +182,13 @@ class ShardedQueryClient:
         # in-flight future would race the next query on its worker's
         # lock-free QueryClient socket and cross-wire replies
         _futures_wait(list(futures.values()))
-        for w, positions in by_owner.items():
-            for p, v in zip(positions, futures[w].result()):
-                out[p] = v
+        try:
+            for w, positions in by_owner.items():
+                for p, v in zip(positions, futures[w].result()):
+                    out[p] = v
+        except (ConnectionError, OSError, TimeoutError):
+            self._count_error("MGET")
+            raise
         return out
 
     def topk(self, name: str, user_id: str, k: int):
@@ -211,7 +232,11 @@ class ShardedQueryClient:
             for c in self._clients
         ]
         _futures_wait(futs)  # join all before any result() can raise
-        per_worker = [f.result() for f in futs]
+        try:
+            per_worker = [f.result() for f in futs]
+        except (ConnectionError, OSError, TimeoutError):
+            self._count_error("TOPKV")
+            raise
         for j, i in enumerate(known):
             merged: List[Tuple[str, float]] = []
             for worker_results in per_worker:

@@ -66,6 +66,8 @@ DURATION_S = float(os.environ.get("CHAOS_DURATION_S", 30))
 KILL_EVERY_S = float(os.environ.get("CHAOS_KILL_EVERY_S", 5))
 THREADS = int(os.environ.get("CHAOS_THREADS", 4))
 N_USERS = int(os.environ.get("CHAOS_USERS", 200))
+TOPK_PCT = float(os.environ.get("CHAOS_TOPK_PCT", 20))  # % of ops that are TOPK
+TOPK_K = int(os.environ.get("CHAOS_TOPK_K", 8))
 
 
 def seed_journal(base):
@@ -103,6 +105,14 @@ def main() -> int:
     ok = [0] * THREADS
     errs = [0] * THREADS
     lat_ms = [[] for _ in range(THREADS)]
+    # per-verb attribution: kills hit GET (single shard, failover retries)
+    # and TOPK (all-shard fan-out, fails if ANY shard's owner set is down)
+    # very differently — report them separately so an outage's blast
+    # radius is visible per verb, not smeared into one aggregate.
+    VERBS = ("GET", "TOPK")
+    verb_ok = [{v: 0 for v in VERBS} for _ in range(THREADS)]
+    verb_err = [{v: 0 for v in VERBS} for _ in range(THREADS)]
+    verb_ms = [{v: [] for v in VERBS} for _ in range(THREADS)]
     stop = threading.Event()
     kills = []   # (t_kill, shard, replica)
 
@@ -113,17 +123,32 @@ def main() -> int:
             attempts=6, backoff_s=0.02, max_backoff_s=0.5), timeout_s=10)
         r = random.Random(widx)
         with c:
+            if TOPK_PCT > 0:  # warm the TOPK JIT outside the measured loop
+                try:
+                    c.topk(ALS_STATE, keys[0][:-2], TOPK_K)
+                except Exception:
+                    pass
             while not stop.is_set():
                 key = keys[r.randrange(len(keys))]
+                verb = "TOPK" if r.random() * 100.0 < TOPK_PCT else "GET"
                 t0 = time.perf_counter()
                 try:
-                    if c.query_state(ALS_STATE, key) is None:
-                        errs[widx] += 1
+                    if verb == "TOPK":
+                        good = c.topk(ALS_STATE, key[:-2],
+                                      TOPK_K) is not None
                     else:
-                        ok[widx] += 1
+                        good = c.query_state(ALS_STATE, key) is not None
                 except Exception:
+                    good = False
+                dt_ms = (time.perf_counter() - t0) * 1000.0
+                if good:
+                    ok[widx] += 1
+                    verb_ok[widx][verb] += 1
+                else:
                     errs[widx] += 1
-                lat_ms[widx].append((time.perf_counter() - t0) * 1000.0)
+                    verb_err[widx][verb] += 1
+                lat_ms[widx].append(dt_ms)
+                verb_ms[widx][verb].append(dt_ms)
 
     with sup.start():
         if not sup.wait_all_ready(120):
@@ -181,11 +206,25 @@ def main() -> int:
     flat = [x for lane in lat_ms for x in lane]
     total_ok, total_err = sum(ok), sum(errs)
     total = total_ok + total_err
+    by_verb = {}
+    for v in VERBS:
+        v_ok = sum(lane[v] for lane in verb_ok)
+        v_err = sum(lane[v] for lane in verb_err)
+        v_tot = v_ok + v_err
+        if not v_tot:
+            continue
+        by_verb[v] = {
+            "queries": v_tot, "ok": v_ok, "errors": v_err,
+            "availability": round(v_ok / v_tot, 6),
+            "latency_ms": pcts([x for lane in verb_ms for x in lane[v]]),
+        }
     summary = {
         "workers": W, "replication": R, "duration_s": DURATION_S,
+        "topk_pct": TOPK_PCT,
         "queries": total, "ok": total_ok, "errors": total_err,
         "availability": round(total_ok / total, 6) if total else None,
         "latency_ms": pcts(flat),
+        "by_verb": by_verb,
         "kills": len(kills),
         "respawns": sup.respawns,
         "recovery_s": recoveries,

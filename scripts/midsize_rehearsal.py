@@ -11,7 +11,13 @@ not just exercises:
   - staging resume across a simulated restart (iteration-boundary
     snapshots, second run resumes instead of recomputing, final factors
     identical to an uninterrupted fit),
-  - SVM chain stacking (K > D) with convergence at scale.
+  - SVM chain stacking (K > D) with convergence at scale,
+  - a serving-plane SLO rehearsal on the closed-loop workload engine
+    (obs/workload.py): zipfian mixed-verb load + autoscaler + replica
+    kill, report must be schema-valid with zero unattributed errors
+    (gate with REHEARSAL_SERVING=0; knobs REHEARSAL_SERVING_SHARDS /
+    _REPLICATION / _USERS / _BASE_QPS / _PEAK_QPS / _BURST_QPS /
+    _THREADS / _AUTOSCALE / _KILL).
 
 Writes one JSON artifact (default REHEARSAL_r05.json next to the repo
 root; override with REHEARSAL_OUT) and exits non-zero on any violated
@@ -481,6 +487,74 @@ def main() -> int:
                         error=f"{type(e).__name__}: {e}")
         finally:
             shutil.rmtree(mp_dir, ignore_errors=True)
+
+    # -- serving-plane rehearsal on the closed-loop workload engine -------
+    # (obs/workload.py + obs/slo.py): zipfian mixed-verb open-loop load
+    # against a live sharded group with autoscaler + one replica kill, SLO
+    # accounting from the fleet scrape.  The hand-rolled query loop this
+    # script used to need lives in the engine now — this stage just sets
+    # knobs and checks the report.
+    if os.environ.get("REHEARSAL_SERVING", "1") != "0":
+        from flink_ms_tpu.obs.slo import validate_report
+        from flink_ms_tpu.obs.workload import run_rehearsal
+
+        serving_out = os.path.join(
+            tempfile.mkdtemp(prefix="rehearsal_serving_"),
+            "SLO_REPORT.json")
+        try:
+            report = run_rehearsal(
+                out_path=serving_out,
+                shards=int(os.environ.get("REHEARSAL_SERVING_SHARDS", 2)),
+                replication=int(
+                    os.environ.get("REHEARSAL_SERVING_REPLICATION", 2)),
+                users=int(os.environ.get("REHEARSAL_SERVING_USERS", 400)),
+                base_qps=float(
+                    os.environ.get("REHEARSAL_SERVING_BASE_QPS", 120)),
+                peak_qps=float(
+                    os.environ.get("REHEARSAL_SERVING_PEAK_QPS", 240)),
+                burst_qps=float(
+                    os.environ.get("REHEARSAL_SERVING_BURST_QPS", 480)),
+                warm_s=2.0, ramp_s=3.0, burst_s=4.0, cool_s=2.0,
+                threads=int(
+                    os.environ.get("REHEARSAL_SERVING_THREADS", 4)),
+                autoscale=os.environ.get(
+                    "REHEARSAL_SERVING_AUTOSCALE", "live"),
+                kill=os.environ.get("REHEARSAL_SERVING_KILL", "1") != "0",
+                seed=0,
+            )
+            problems = validate_report(report)
+            ok &= check("serving_slo_report_schema_valid", not problems,
+                        problems=problems[:3])
+            ok &= check("serving_zero_unattributed_errors",
+                        report["errors"]["unattributed"] == 0,
+                        errors=report["errors"]["total"])
+            unattr_breaches = [
+                b for b in report["breaches"] if not b.get("attribution")]
+            ok &= check("serving_breaches_attributed", not unattr_breaches,
+                        breaches=len(report["breaches"]))
+            wl = report["workload"]
+            ok &= check("serving_open_loop_kept_schedule",
+                        wl["completed"] == wl["scheduled"],
+                        scheduled=wl["scheduled"], completed=wl["completed"],
+                        max_lag_s=wl["max_sched_lag_s"])
+            ART["serving"] = {
+                "ok": report["ok"],
+                "scheduled": wl["scheduled"],
+                "achieved_qps": wl["achieved_qps"],
+                "errors": report["errors"]["total"],
+                "breaches": len(report["breaches"]),
+                "kills": sum(1 for e in report["timeline"]
+                             if "kill" in e.get("kind", "")),
+                "verbs": {v: {"availability": d["availability"],
+                              "p99_ms": d["p99_ms"],
+                              "burn_rate": d["burn_rate"]}
+                          for v, d in report["verbs"].items()},
+            }
+        except Exception as e:
+            ok &= check("serving_rehearsal_completes", False,
+                        error=f"{type(e).__name__}: {e}")
+        finally:
+            shutil.rmtree(os.path.dirname(serving_out), ignore_errors=True)
 
     ART["ok"] = bool(ok)
     out_path = os.environ.get("REHEARSAL_OUT") or os.path.join(

@@ -238,3 +238,56 @@ def test_metrics_verb_roundtrip():
         assert parsed["enabled"] is True
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# bucketed_quantiles edge cases (the bench and SLO layers lean on these)
+# ---------------------------------------------------------------------------
+
+def test_bucketed_quantiles_empty_is_nan():
+    import math
+
+    out = M.bucketed_quantiles([], (1, 50, 99, 100))
+    assert len(out) == 4
+    assert all(math.isnan(v) for v in out)
+
+
+def test_bucketed_quantiles_all_mass_in_overflow_bucket():
+    # every value beyond the last finite bound lands in +Inf; the
+    # interpolation must clamp to the last finite bound, not explode
+    top = M.LATENCY_BUCKETS_S[-1]
+    out = M.bucketed_quantiles([top * 10, top * 100], (50, 99))
+    assert all(v == top for v in out)
+
+
+def test_bucketed_quantiles_single_observation():
+    v = 0.00123
+    p1, p50, p99 = M.bucketed_quantiles([v], (1, 50, 99))
+    # one observation: every quantile resolves inside the bucket holding v
+    lo = max(b for b in M.LATENCY_BUCKETS_S if b < v)
+    hi = min(b for b in M.LATENCY_BUCKETS_S if b >= v)
+    for q in (p1, p50, p99):
+        assert lo <= q <= hi
+    # and they are monotone in q
+    assert p1 <= p50 <= p99
+
+
+def test_quantile_monotonicity_under_merge():
+    import random
+
+    rng = random.Random(0)
+    a_vals = [rng.uniform(1e-4, 1e-2) for _ in range(500)]
+    b_vals = [rng.uniform(1e-3, 1e-1) for _ in range(300)]
+    a = M.Histogram("h").fill(a_vals)
+    b = M.Histogram("h").fill(b_vals)
+    merged = M.Histogram("h").fill(a_vals).merge(b)
+    # merged quantiles == quantiles of the concatenated data (merge is
+    # bucket-wise add, so this is exact, not approximate)
+    both = M.bucketed_quantiles(a_vals + b_vals, (10, 50, 90, 99))
+    for q, expect in zip((10, 50, 90, 99), both):
+        assert merged.quantile(q) == pytest.approx(expect, rel=1e-9)
+    # monotone in q, and bracketed by the per-part extremes
+    qs = [merged.quantile(q) for q in (1, 10, 50, 90, 99)]
+    assert qs == sorted(qs)
+    assert min(a.quantile(1), b.quantile(1)) <= qs[0]
+    assert qs[-1] <= max(a.quantile(99), b.quantile(99))

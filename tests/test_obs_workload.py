@@ -1,0 +1,244 @@
+"""Workload-engine unit tests: zipfian key draw, verb mix, phase
+schedules, open-loop pacing, and — the property the engine exists for —
+coordinated-omission-safe recording: a stalled backend shows up in the
+attributed (intended-send) percentiles even though each individual
+request's service time stays small."""
+
+import math
+import random
+import time
+
+import pytest
+
+from flink_ms_tpu.obs.workload import (
+    OpenLoopPacer,
+    Phase,
+    PhaseSchedule,
+    VerbMix,
+    WorkloadEngine,
+    WorkloadRecorder,
+    ZipfKeys,
+)
+
+
+# ---------------------------------------------------------------------------
+# ZipfKeys
+# ---------------------------------------------------------------------------
+
+def test_zipf_is_skewed_and_in_range():
+    keys = ZipfKeys(1000, exponent=1.1, seed=0)
+    rng = random.Random(1)
+    draws = [keys.sample(rng) for _ in range(5000)]
+    assert all(0 <= d < 1000 for d in draws)
+    # the hottest 1% of keys should carry far more than 1% of the mass
+    assert keys.hot_share(0.01) > 0.10
+    from collections import Counter
+    top10 = sum(c for _, c in Counter(draws).most_common(10))
+    assert top10 / len(draws) > 0.15   # uniform would give ~1%
+
+
+def test_zipf_deterministic_across_instances():
+    a, b = ZipfKeys(500, seed=7), ZipfKeys(500, seed=7)
+    assert a.ids == b.ids
+    ra, rb = random.Random(3), random.Random(3)
+    assert [a.sample(ra) for _ in range(100)] == \
+        [b.sample(rb) for _ in range(100)]
+
+
+def test_zipf_permutation_spreads_hot_keys():
+    # rank 0 must not always be id 0 — the permutation is the point
+    assert any(ZipfKeys(100, seed=s).ids[0] != 0 for s in range(5))
+
+
+# ---------------------------------------------------------------------------
+# VerbMix
+# ---------------------------------------------------------------------------
+
+def test_verb_mix_from_string_and_distribution():
+    mix = VerbMix.from_string("GET=80,TOPK=20")
+    rng = random.Random(0)
+    draws = [mix.choose(rng) for _ in range(2000)]
+    frac_get = draws.count("GET") / len(draws)
+    assert 0.74 < frac_get < 0.86
+    assert set(draws) == {"GET", "TOPK"}
+
+
+def test_verb_mix_rejects_empty():
+    with pytest.raises(ValueError):
+        VerbMix({"GET": 0.0})
+
+
+# ---------------------------------------------------------------------------
+# PhaseSchedule
+# ---------------------------------------------------------------------------
+
+def test_ramp_burst_schedule_shape():
+    s = PhaseSchedule.ramp_burst(base_qps=100, peak_qps=200, burst_qps=400,
+                                 warm_s=1.0, ramp_s=1.0, burst_s=1.0,
+                                 cool_s=1.0)
+    assert s.duration_s == pytest.approx(4.0)
+    assert s.rate_at(0.1) == 100
+    assert s.phase_at(2.5).name == "burst"
+    assert s.rate_at(2.5) == 400
+    assert s.rate_at(3.9) == 100
+    assert s.rate_at(99) == 0
+    offs = s.intended_offsets()
+    # warm 100 + ramp steps (133/166/200 qps over 1/3s each) + burst 400
+    # + cool 100
+    assert len(offs) == pytest.approx(100 + 165 + 400 + 100, abs=10)
+    ts = [t for t, _ in offs]
+    assert ts == sorted(ts)
+    assert all(0 <= t < 4.0 for t in ts)
+    burst_ops = [t for t, name in offs if name == "burst"]
+    assert len(burst_ops) == 400
+
+
+def test_diurnal_schedule_ramps_up_then_down():
+    s = PhaseSchedule.diurnal(base_qps=10, peak_qps=100, duration_s=8,
+                              steps=8)
+    rates = [p.rate_qps for p in s.phases]
+    assert rates[0] < rates[3]          # ramps up
+    assert rates[-1] < rates[4]         # ramps back down
+    assert max(rates) <= 100 and min(rates) >= 10
+
+
+# ---------------------------------------------------------------------------
+# OpenLoopPacer
+# ---------------------------------------------------------------------------
+
+def test_pacer_spacing_and_catchup():
+    pacer = OpenLoopPacer(1000.0)       # 1ms slots
+    slots = [pacer.next_slot() for _ in range(5)]
+    for a, b in zip(slots, slots[1:]):
+        assert b - a == pytest.approx(0.001, abs=1e-6)
+    # stall the caller: the pacer must hand out PAST slots immediately
+    # (never skip), accumulating measurable lag
+    time.sleep(0.05)
+    t0 = time.perf_counter()
+    late = [pacer.next_slot() for _ in range(10)]
+    assert time.perf_counter() - t0 < 0.02      # no sleeping while behind
+    assert all(s < t0 for s in late)
+    assert pacer.lag_s > 0.02
+
+
+# ---------------------------------------------------------------------------
+# WorkloadRecorder
+# ---------------------------------------------------------------------------
+
+def test_recorder_stats_and_error_samples():
+    rec = WorkloadRecorder(max_error_samples=2)
+    t = 100.0
+    for i in range(10):
+        rec.record("GET", t, t + 0.001, t + 0.003, ok=True)
+    for i in range(3):
+        rec.record("GET", t, t + 0.001, t + 0.002, ok=False,
+                   error="boom", phase="burst", wall_ts=123.0 + i)
+    stats = rec.verb_stats()["GET"]
+    assert stats["requests"] == 13
+    assert stats["errors"] == 3
+    assert stats["availability"] == pytest.approx(10 / 13, abs=1e-6)
+    assert stats["p99_ms"] is not None
+    # attributed latency (3ms from intended) > service latency (2ms)
+    assert stats["p99_ms"] > stats["service_p99_ms"]
+    assert rec.error_count == 3
+    assert len(rec.error_samples) == 2          # bounded ring
+    assert rec.error_samples[0]["ts"] == 123.0
+    assert rec.error_samples[0]["phase"] == "burst"
+    snap = rec.snapshot()
+    names = {h["name"] for h in snap["histograms"]}
+    assert "tpums_client_latency_seconds" in names
+    assert "tpums_client_service_seconds" in names
+
+
+# ---------------------------------------------------------------------------
+# WorkloadEngine — coordinated omission
+# ---------------------------------------------------------------------------
+
+class _StallOps:
+    """Fast backend with ONE long stall; closed-loop recording would hide
+    the backlog the stall creates."""
+
+    def __init__(self, stall_at: int, stall_s: float):
+        self.stall_at = stall_at
+        self.stall_s = stall_s
+        self.calls = 0
+
+    def execute(self, verb, rng):
+        self.calls += 1
+        if self.calls == self.stall_at:
+            time.sleep(self.stall_s)
+        return True
+
+
+def test_engine_records_stall_backlog_in_attributed_latency():
+    ops = _StallOps(stall_at=20, stall_s=0.4)
+    schedule = PhaseSchedule([Phase("steady", 1.0, 200.0)])
+    rec = WorkloadRecorder()
+    eng = WorkloadEngine(ops, schedule, VerbMix({"GET": 1.0}),
+                         recorder=rec, threads=1, seed=0)
+    summary = eng.run()
+    # open loop: every scheduled op executed, none silently dropped
+    assert summary["completed"] == summary["scheduled"] == 200
+    assert summary["errors"] == 0
+    stats = rec.verb_stats()["GET"]
+    # the 0.4s stall delays ~80 queued sends; attributed p99 carries it
+    assert stats["p99_ms"] > 100.0
+    # service latency of the non-stalled ops stays tiny: the gap IS the
+    # coordinated-omission correction
+    assert stats["p99_ms"] > 5 * stats["service_p99_ms"] or \
+        stats["service_p99_ms"] > 100.0
+    assert summary["max_sched_lag_s"] > 0.2
+
+
+def test_engine_mixed_verbs_and_phase_events():
+    from flink_ms_tpu.obs import recent_events
+
+    class _CountOps:
+        def __init__(self):
+            self.by_verb = {}
+
+        def execute(self, verb, rng):
+            self.by_verb[verb] = self.by_verb.get(verb, 0) + 1
+            return True
+
+    ops = _CountOps()
+    schedule = PhaseSchedule([Phase("a", 0.2, 300.0),
+                              Phase("b_burst", 0.2, 300.0)])
+    eng = WorkloadEngine(ops, schedule, VerbMix({"GET": 3, "UPDATE": 1}),
+                         threads=2, seed=1, name="t-mix")
+    summary = eng.run()
+    assert summary["completed"] == 120
+    assert set(summary["scheduled_by_verb"]) == {"GET", "UPDATE"}
+    assert summary["scheduled_by_verb"]["GET"] > \
+        summary["scheduled_by_verb"]["UPDATE"]
+    assert sum(ops.by_verb.values()) == 120
+    # both phases announced on the event ring with wall-clock windows
+    phases = [e for e in recent_events(kind="workload_phase")
+              if e.get("workload") == "t-mix"]
+    assert [e["phase"] for e in phases] == ["a", "b_burst"]
+    assert len(summary["phases"]) == 2
+    assert summary["phases"][0]["t_end"] <= \
+        summary["phases"][1]["t_start"] + 1e-6
+
+
+def test_engine_goodput_counts_failures():
+    class _FlakyOps:
+        def __init__(self):
+            self.calls = 0
+
+        def execute(self, verb, rng):
+            self.calls += 1
+            if self.calls % 5 == 0:
+                raise ConnectionError("down")
+            return True
+
+    schedule = PhaseSchedule([Phase("p", 0.2, 250.0)])
+    rec = WorkloadRecorder()
+    eng = WorkloadEngine(_FlakyOps(), schedule, VerbMix({"GET": 1}),
+                         recorder=rec, threads=1, seed=0)
+    summary = eng.run()
+    assert summary["completed"] == 50
+    assert summary["errors"] == 10
+    assert summary["goodput"] == pytest.approx(0.8)
+    assert rec.error_count == 10
+    assert all("ConnectionError" in s["error"] for s in rec.error_samples)

@@ -1,0 +1,217 @@
+"""SLO accounting unit tests: objective spec roundtrip, burn-rate math,
+per-verb fleet windows, report assembly with event attribution, and the
+schema validator the tier-1 smoke gates on."""
+
+import math
+
+import pytest
+
+from flink_ms_tpu.obs import metrics as obs_metrics
+from flink_ms_tpu.obs import slo as obs_slo
+from flink_ms_tpu.obs.slo import (
+    SLOObjective,
+    SLOSpec,
+    bucket_index,
+    build_report,
+    burn_rate,
+    human_summary,
+    validate_report,
+    verb_windows,
+)
+from flink_ms_tpu.obs.workload import WorkloadRecorder
+
+
+# ---------------------------------------------------------------------------
+# spec + burn rate
+# ---------------------------------------------------------------------------
+
+def test_spec_roundtrip_and_lookup():
+    spec = SLOSpec([SLOObjective("GET", availability=0.999, p99_ms=25.0),
+                    SLOObjective("TOPK", availability=0.99,
+                                 burn_rate_max=3.0)])
+    again = SLOSpec.from_dict(spec.to_dict())
+    assert [o.to_dict() for o in again.objectives] == \
+        [o.to_dict() for o in spec.objectives]
+    assert again.for_verb("GET").p99_ms == 25.0
+    assert again.for_verb("TOPK").burn_rate_max == 3.0
+    assert again.for_verb("NOPE") is None
+
+
+def test_default_spec_covers_requested_verbs():
+    spec = SLOSpec.default(["GET", "UPDATE", "WEIRD"])
+    assert {o.verb for o in spec.objectives} == {"GET", "UPDATE", "WEIRD"}
+    assert spec.for_verb("GET").p99_ms is not None
+    assert spec.for_verb("UPDATE").p99_ms is None      # journal write
+    assert spec.for_verb("WEIRD").availability == 0.999
+
+
+def test_burn_rate_math():
+    # 0.1% errors against a 99.9% target burns the budget exactly
+    assert burn_rate(10000, 10, 0.999) == pytest.approx(1.0)
+    assert burn_rate(10000, 20, 0.999) == pytest.approx(2.0)
+    assert burn_rate(10000, 0, 0.999) == 0.0
+    assert burn_rate(0, 0, 0.999) is None
+    assert burn_rate(100, 1, None) is None
+    assert burn_rate(100, 1, 1.0) is None       # zero budget
+
+
+def test_bucket_index():
+    bounds = obs_metrics.LATENCY_BUCKETS_S
+    assert bucket_index(None) is None
+    assert bucket_index(float("nan")) is None
+    i = bucket_index(0.00105)
+    j = bucket_index(0.00105 * 1.2)   # > one ladder step (10^(1/16)=1.155)
+    assert j == i + 1
+
+
+# ---------------------------------------------------------------------------
+# verb windows from fleet merges
+# ---------------------------------------------------------------------------
+
+def _fleet_snap(per_verb):
+    """Minimal fleet merge: {verb: (count, sum_s, errors)}."""
+    reg = obs_metrics.MetricsRegistry()
+    for verb, (n, total_s, errs) in per_verb.items():
+        h = reg.histogram("tpums_server_latency_seconds", verb=verb)
+        for _ in range(n):
+            h.observe(total_s / n)
+        reg.counter("tpums_server_errors_total", verb=verb).inc(errs)
+    return reg.snapshot()
+
+
+def test_verb_windows_deltas():
+    before = _fleet_snap({"GET": (100, 0.1, 0), "TOPKV": (10, 0.5, 1)})
+    after = _fleet_snap({"GET": (300, 0.4, 2), "TOPKV": (10, 0.5, 1)})
+    win = verb_windows(before, after)
+    assert win["GET"]["requests"] == 200
+    assert win["GET"]["errors"] == 2
+    assert win["GET"]["hist"]["count"] == 200
+    # p99 of the delta window is quantile-able
+    p99 = obs_metrics.snapshot_quantile(win["GET"]["hist"], 99)
+    assert not math.isnan(p99)
+    # TOPKV did not move -> no window entry
+    assert "TOPKV" not in win
+
+
+# ---------------------------------------------------------------------------
+# report assembly + attribution
+# ---------------------------------------------------------------------------
+
+def _recorder_with_traffic(t0, errors_at=()):
+    rec = WorkloadRecorder()
+    for i in range(200):
+        rec.record("GET", t0 + i * 0.001, t0 + i * 0.001,
+                   t0 + i * 0.001 + 0.002, ok=True)
+    for ts in errors_at:
+        rec.record("GET", ts, ts, ts + 0.01, ok=False,
+                   error="ConnectionError('down')", wall_ts=ts)
+    return rec
+
+
+def _workload_summary(t0, dur=10.0, scheduled=None):
+    return {
+        "name": "t", "scheduled": scheduled or 200,
+        "scheduled_by_verb": {"GET": scheduled or 200},
+        "completed": 200, "ok": 200, "errors": 0,
+        "goodput": 1.0, "duration_s": dur, "achieved_qps": 20.0,
+        "max_sched_lag_s": 0.0, "threads": 1, "mix": {"GET": 1.0},
+        "phases": [{"name": "warm", "rate_qps": 10.0,
+                    "t_start": t0, "t_end": t0 + dur / 2},
+                   {"name": "burst", "rate_qps": 50.0,
+                    "t_start": t0 + dur / 2, "t_end": t0 + dur}],
+        "t_start": t0, "t_end": t0 + dur,
+    }
+
+
+def test_report_attributes_errors_to_kill_event():
+    t0 = 1000.0
+    spec = SLOSpec.default(["GET"])
+    rec = _recorder_with_traffic(t0, errors_at=(t0 + 3.0, t0 + 3.2))
+    before = _fleet_snap({"GET": (0, 0.0, 0)})
+    after = _fleet_snap({"GET": (200, 0.4, 0)})
+    timeline = [{"ts": t0 + 2.5, "kind": "rehearsal_kill", "shard": 0}]
+    report = build_report(spec, _workload_summary(t0), rec, before, after,
+                          fleet_samples=[(t0, before), (t0 + 10, after)],
+                          timeline=timeline)
+    assert validate_report(report) == []
+    assert report["errors"]["total"] == 2
+    assert report["errors"]["attributed"] == 2
+    assert report["errors"]["unattributed"] == 0
+    causes = [s["attributed_to"]["kind"]
+              for s in report["errors"]["samples"]]
+    assert causes == ["rehearsal_kill", "rehearsal_kill"]
+    # availability 200/202 < 0.999 -> breach, attributed (kill within
+    # the attribution window of the worst burn window)
+    br = [b for b in report["breaches"]
+          if b["objective"] == "availability"]
+    assert br and br[0]["verb"] == "GET"
+    assert not report["ok"]
+
+
+def test_report_counts_unattributed_errors():
+    t0 = 2000.0
+    spec = SLOSpec.default(["GET"])
+    # one error nowhere near any event or burst phase
+    rec = _recorder_with_traffic(t0, errors_at=(t0 + 2.0,))
+    before = _fleet_snap({"GET": (0, 0.0, 0)})
+    after = _fleet_snap({"GET": (200, 0.4, 0)})
+    report = build_report(spec, _workload_summary(t0, dur=100.0), rec,
+                          before, after, timeline=[])
+    assert report["errors"]["unattributed"] == 1
+    assert report["errors"]["samples"][0]["attributed_to"] is None
+    assert not report["ok"]
+
+
+def test_report_attributes_burst_phase_errors():
+    t0 = 3000.0
+    spec = SLOSpec.default(["GET"])
+    # error inside the burst phase window, no disruptive events at all
+    rec = _recorder_with_traffic(t0, errors_at=(t0 + 7.0,))
+    before = _fleet_snap({"GET": (0, 0.0, 0)})
+    after = _fleet_snap({"GET": (200, 0.4, 0)})
+    report = build_report(spec, _workload_summary(t0), rec, before, after,
+                          timeline=[])
+    s = report["errors"]["samples"][0]
+    assert s["attributed_to"]["kind"] == "workload_phase"
+    assert s["attributed_to"]["phase"] == "burst"
+    assert report["errors"]["unattributed"] == 0
+
+
+def test_report_clean_run_passes_and_buckets_agree():
+    t0 = 4000.0
+    spec = SLOSpec.default(["GET"])
+    rec = _recorder_with_traffic(t0)
+    # server saw the same 2ms the client service series saw
+    before = _fleet_snap({"GET": (0, 0.0, 0)})
+    after = _fleet_snap({"GET": (200, 0.4, 0)})
+    report = build_report(spec, _workload_summary(t0), rec, before, after,
+                          fleet_samples=[(t0, before), (t0 + 10, after)])
+    assert validate_report(report) == []
+    assert report["ok"]
+    v = report["verbs"]["GET"]
+    assert v["requests"] == 200 and v["errors"] == 0
+    assert v["availability"] == 1.0
+    assert v["burn_rate"] == 0.0
+    assert v["p99_bucket_delta"] == 0
+    assert v["p99_bucket_agreement"] is True
+    assert v["objectives"]["availability"]["ok"]
+    assert report["window_burns"][0]["burn_rate"] == 0.0
+    # human summary renders without blowing up and carries the verdict
+    text = human_summary(report)
+    assert "PASS" in text and "GET" in text
+
+
+def test_validate_report_catches_missing_keys():
+    assert validate_report({}) != []
+    assert validate_report("nope") == ["report is not a dict"]
+    t0 = 5000.0
+    report = build_report(SLOSpec.default(["GET"]),
+                          _workload_summary(t0),
+                          _recorder_with_traffic(t0),
+                          _fleet_snap({}), _fleet_snap({}))
+    assert validate_report(report) == []
+    del report["verbs"]["GET"]["burn_rate"]
+    report["breaches"].append({"verb": "GET", "objective": "x"})
+    problems = validate_report(report)
+    assert any("burn_rate" in p for p in problems)
+    assert any("breaches[0]" in p for p in problems)

@@ -1,0 +1,56 @@
+"""Tier-1 SLO smoke: a tiny closed-loop rehearsal against a real 2-shard
+replicated group — the report must come back schema-valid with zero
+client-visible errors (R=2 absorbs everything in a clean run) and traffic
+recorded for every verb in the blend."""
+
+import json
+
+from flink_ms_tpu.obs.slo import validate_report
+from flink_ms_tpu.obs.workload import run_rehearsal
+
+
+def test_slo_smoke_rehearsal(tmp_path):
+    out = tmp_path / "SLO_REPORT.json"
+    report = run_rehearsal(
+        out_path=str(out),
+        shards=2,
+        replication=2,
+        users=100,
+        base_qps=50.0,
+        peak_qps=80.0,
+        burst_qps=120.0,
+        warm_s=1.0, ramp_s=1.0, burst_s=1.5, cool_s=1.0,
+        threads=3,
+        autoscale="off",
+        kill=False,
+        seed=0,
+    )
+    # schema-valid, and the artifact on disk round-trips
+    assert validate_report(report) == []
+    disk = json.loads(out.read_text())
+    assert validate_report(disk) == []
+    assert disk["schema"] == report["schema"]
+
+    # zero in-quota errors: R=2, no kill, no rescale -> nothing may fail
+    assert report["errors"]["total"] == 0
+    assert report["errors"]["unattributed"] == 0
+
+    # every verb in the default blend saw traffic and recorded both
+    # latency series
+    verbs = report["verbs"]
+    for verb in ("GET", "MGET", "TOPK", "TOPKV", "UPDATE"):
+        assert verb in verbs, f"no traffic recorded for {verb}"
+        assert verbs[verb]["requests"] > 0
+        assert verbs[verb]["availability"] == 1.0
+        assert verbs[verb]["p99_ms"] is not None
+        assert verbs[verb]["service_p99_ms"] is not None
+
+    # read verbs hit the fleet: scraped server-side windows line up with
+    # what the client sent (GET maps 1:1)
+    assert verbs["GET"]["fleet_requests"] == verbs["GET"]["requests"]
+    assert verbs["GET"]["fleet_errors"] == 0
+
+    # the open loop kept schedule: all ops executed, no silent drops
+    wl = report["workload"]
+    assert wl["completed"] == wl["scheduled"]
+    assert wl["goodput"] == 1.0
