@@ -38,7 +38,8 @@ BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 (per-device peak for MFU; default inferred from device_kind),
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
-serving_ha,serving_elastic,serving_rehearsal; default all),
+serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap;
+default all),
 BENCH_INGEST_ROWS /
 BENCH_INGEST_K / BENCH_INGEST_PROP_PROBES (serving-ingest replay scale),
 BENCH_HA_USERS / BENCH_HA_DURATION_S / BENCH_HA_WORKERS /
@@ -50,6 +51,9 @@ HA/elastic query arms; latency recorded from intended send time),
 BENCH_REHEARSAL_* (closed-loop SLO rehearsal: SHARDS / REPLICATION /
 USERS / BASE_QPS / PEAK_QPS / BURST_QPS / THREADS / AUTOSCALE / KILL /
 OUT — emits SLO_REPORT.json, see obs/workload.py),
+BENCH_BOOTSTRAP_* (KEYS / BASE_ROWS / MULTS / DIM: snapshot-shipped
+bootstrap flatness — cold replay-vs-snapshot, elastic 2->4 cutover
+with snapshots on/off, HA respawn recovery, each at MULTS x journal),
 BENCH_ALS_PRECISION / BENCH_ALS_EXCHANGE (kernel-config A/B),
 BENCH_SKIP_QUALITY=1 / BENCH_RMSE_REF_NNZ / BENCH_RMSE_REF_ITERS (ALS
 quality anchor), BENCH_SVM_TARGET / BENCH_SVM_REF_ROUNDS / BENCH_SVM_FLIP
@@ -1108,7 +1112,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
     sections = os.environ.get(
         "BENCH_SECTIONS",
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
-        "serving_elastic,serving_rehearsal"
+        "serving_elastic,serving_rehearsal,serving_bootstrap"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1181,6 +1185,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_elastic", "run_serving_elastic_section",
          lambda f: f(small)),
         ("serving_rehearsal", "run_serving_rehearsal_section",
+         lambda f: f(small)),
+        ("serving_bootstrap", "run_serving_bootstrap_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

@@ -1737,3 +1737,221 @@ def run_serving_rehearsal_section(small: bool) -> dict:
         out[f"serving_rehearsal_{tag}_p99_bucket_delta"] = \
             v["p99_bucket_delta"]
     return out
+
+
+def run_serving_bootstrap_section(small: bool) -> dict:
+    """Recovery and resharding cost vs journal length: is bootstrap
+    O(state) or O(history)?  Three arms, each run at journal lengths of
+    BENCH_BOOTSTRAP_MULTS x the base row count:
+
+      cold     in-process ServingJob cold start — full replay (the first
+               job, which then publishes a snapshot at ready) vs
+               snapshot-shipped bootstrap (a second job over the same
+               journal), both timed via job.bootstrap_seconds;
+      cutover  elastic 2 -> 4 rescale (serve/elastic.py) with snapshots
+               on vs off — the g+1 generation either bulk-loads the
+               gen-g snapshot family or replays the whole journal;
+      ha       ReplicaSupervisor respawn after SIGKILL (1 shard, R=2,
+               snapshots on) — kill -> the respawned pid registers ready.
+
+    Headlines are flatness ratios (time at max mult / time at min mult);
+    the snapshot-on paths must stay ~flat (<= 1.5x, ISSUE acceptance)
+    while replay paths grow with the journal."""
+    import signal
+    import threading
+
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.serve import registry
+    from flink_ms_tpu.serve import snapshot as snapshot_mod
+    from flink_ms_tpu.serve.consumer import (
+        ALS_STATE,
+        MemoryStateBackend,
+        ServingJob,
+        parse_als_record,
+    )
+    from flink_ms_tpu.serve.elastic import ScaleController
+    from flink_ms_tpu.serve.ha import ReplicaSupervisor
+    from flink_ms_tpu.serve.journal import Journal
+
+    keys_n = int(os.environ.get("BENCH_BOOTSTRAP_KEYS",
+                                300 if small else 2_000))
+    base_rows = int(os.environ.get("BENCH_BOOTSTRAP_BASE_ROWS",
+                                   2_000 if small else 20_000))
+    mults = sorted(int(m) for m in os.environ.get(
+        "BENCH_BOOTSTRAP_MULTS",
+        "1,100" if small else "1,10,100").split(",") if m.strip())
+    dim = int(os.environ.get("BENCH_BOOTSTRAP_DIM", 8))
+    proc_mults = [mults[0], mults[-1]] if len(mults) > 1 else mults
+
+    tmp = tempfile.mkdtemp(prefix="bench_bootstrap_")
+    saved = {key: os.environ.get(key) for key in
+             ("TPUMS_HEARTBEAT_S", "TPUMS_REPLICA_TTL_S",
+              "TPUMS_REGISTRY_DIR")}
+    os.environ["TPUMS_HEARTBEAT_S"] = "0.2"
+    os.environ["TPUMS_REPLICA_TTL_S"] = "1.2"
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    out: dict = {}
+
+    rng = np.random.default_rng(0)
+    vec = rng.normal(size=dim)
+
+    def build_journal(root: str, rows: int) -> Journal:
+        # keys_n live keys, then updates cycling over them: the stream a
+        # compactor/snapshot exists for — history >> state
+        j = Journal(root, "models")
+        batch = [F.format_als_row(u, "U", vec) for u in range(keys_n)]
+        for i in range(max(0, rows - keys_n)):
+            batch.append(F.format_als_row(i % keys_n, "I", vec))
+            if len(batch) >= 10_000:
+                j.append(batch, flush=False)
+                batch = []
+        if batch:
+            j.append(batch)
+        return j
+
+    def wait_plan(root: str, owner=None, members=1, timeout_s=60.0):
+        # ready fires BEFORE the snapshot publish (serve/consumer.py flips
+        # _ready first), so poll for the manifest(s) before depending on it
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            plan = snapshot_mod.resolve(root, owner=owner)
+            if plan is not None and len(plan["members"]) >= members:
+                return plan
+            time.sleep(0.05)
+        raise AssertionError("snapshot never published")
+
+    def in_process_job(j: Journal) -> ServingJob:
+        return ServingJob(j, ALS_STATE, parse_als_record,
+                          MemoryStateBackend(), port=0, topk_index=False,
+                          poll_interval_s=0.02, snapshots=True,
+                          snapshot_min_bytes=1)
+
+    try:
+        # -- arm 1: in-process cold start, replay vs snapshot -------------
+        cold_replay, cold_snap = {}, {}
+        for mult in mults:
+            rows = base_rows * mult
+            j = build_journal(os.path.join(tmp, f"cold{mult}"), rows)
+            job1 = in_process_job(j)
+            job1.start()
+            assert job1.wait_ready(600), "cold replay bootstrap timed out"
+            cold_replay[mult] = job1.bootstrap_seconds
+            root = snapshot_mod.snapshot_root(j.dir, "models")
+            wait_plan(root, owner=(0, 1))
+            job1.stop()
+            job2 = in_process_job(j)
+            job2.start()
+            assert job2.wait_ready(600), "snapshot bootstrap timed out"
+            assert job2.bootstrap_source == "snapshot", (
+                f"expected snapshot bootstrap, got {job2.bootstrap_source}")
+            cold_snap[mult] = job2.bootstrap_seconds
+            job2.stop()
+            out[f"serving_bootstrap_rows_{mult}x"] = rows
+            out[f"serving_bootstrap_cold_replay_s_{mult}x"] = round(
+                cold_replay[mult], 4)
+            out[f"serving_bootstrap_cold_snap_s_{mult}x"] = round(
+                cold_snap[mult], 4)
+            _log(f"[bench:bootstrap] cold {mult}x ({rows} rows): replay "
+                 f"{cold_replay[mult]:.3f}s snapshot {cold_snap[mult]:.3f}s")
+
+        # -- arm 2: elastic 2 -> 4 cutover, snapshots on vs off -----------
+        cutover = {True: {}, False: {}}
+        for mult in proc_mults:
+            rows = base_rows * mult
+            for snaps_on in (True, False):
+                tag = "on" if snaps_on else "off"
+                run_dir = os.path.join(tmp, f"cut{mult}{tag}")
+                j = build_journal(os.path.join(run_dir, "bus"), rows)
+                ctl = ScaleController(
+                    f"bench-boot-{mult}-{tag}", j.dir, "models",
+                    port_dir=os.path.join(run_dir, "ports"),
+                    ready_timeout_s=600, snapshots=snaps_on,
+                    snapshot_min_bytes=1 if snaps_on else None)
+                try:
+                    rec = ctl.scale_to(2)
+                    assert rec["shards"] == 2, "gen-1 bootstrap failed"
+                    if snaps_on:
+                        # both gen-1 shards must have published before the
+                        # g+1 generation can family-load their snapshots
+                        wait_plan(snapshot_mod.snapshot_root(
+                            j.dir, "models"), members=2)
+                    t0 = time.time()
+                    rec = ctl.scale_to(4)
+                    cutover[snaps_on][mult] = time.time() - t0
+                    assert rec["shards"] == 4, "cutover failed"
+                finally:
+                    ctl.stop(drop_topology=True)
+                out[f"serving_bootstrap_cutover_s_{mult}x_{tag}"] = round(
+                    cutover[snaps_on][mult], 2)
+                _log(f"[bench:bootstrap] cutover {mult}x snapshots={tag}: "
+                     f"{cutover[snaps_on][mult]:.2f}s")
+
+        # -- arm 3: HA respawn recovery, snapshots on ---------------------
+        ha_rec = {}
+        for mult in proc_mults:
+            rows = base_rows * mult
+            run_dir = os.path.join(tmp, f"ha{mult}")
+            j = build_journal(os.path.join(run_dir, "bus"), rows)
+            sup = ReplicaSupervisor(
+                1, 2, j.dir, "models",
+                port_dir=os.path.join(run_dir, "ports"),
+                job_group=f"bench-boot-ha-{mult}",
+                state_backend="memory", check_interval_s=0.2,
+                respawn_delay_s=0.05,
+                extra_args=["--snapshotMinBytes", "1"])
+            try:
+                sup.start()
+                assert sup.wait_all_ready(600), "HA fleet never ready"
+                wait_plan(snapshot_mod.snapshot_root(j.dir, "models"),
+                          owner=(0, 1))
+                victim = sup.procs[(0, 0)]
+                old_pid = victim.pid
+                t_kill = time.time()
+                victim.send_signal(signal.SIGKILL)
+                deadline = t_kill + 600
+                while time.time() < deadline:
+                    # a NEW pid registering ready is the unambiguous
+                    # recovery signal (the stale record still says ready
+                    # until the respawn overwrites it)
+                    members = registry.resolve_replicas(sup.group_of(0))
+                    if any(e.get("replica") == 0 and e.get("ready")
+                           and e.get("pid") not in (None, old_pid)
+                           for e in members):
+                        ha_rec[mult] = time.time() - t_kill
+                        break
+                    time.sleep(0.02)
+                assert mult in ha_rec, "respawned replica never re-ready"
+            finally:
+                sup.stop()
+            out[f"serving_bootstrap_ha_recovery_s_{mult}x"] = round(
+                ha_rec[mult], 2)
+            _log(f"[bench:bootstrap] ha {mult}x: recovery "
+                 f"{ha_rec[mult]:.2f}s")
+
+        # -- headlines: flatness = t(max mult) / t(min mult) --------------
+        def flatness(d: dict):
+            lo, hi = min(d), max(d)
+            if lo == hi or not d[lo]:
+                return None
+            return round(d[hi] / max(d[lo], 1e-6), 3)
+
+        out["serving_bootstrap_cold_flatness"] = flatness(cold_snap)
+        out["serving_bootstrap_cold_replay_ratio"] = flatness(cold_replay)
+        out["serving_bootstrap_cutover_flatness"] = flatness(cutover[True])
+        out["serving_bootstrap_cutover_flatness_off"] = flatness(
+            cutover[False])
+        out["serving_bootstrap_ha_flatness"] = flatness(ha_rec)
+        _log(f"[bench:bootstrap] flatness cold/cutover/ha = "
+             f"{out['serving_bootstrap_cold_flatness']}/"
+             f"{out['serving_bootstrap_cutover_flatness']}/"
+             f"{out['serving_bootstrap_ha_flatness']} "
+             f"(replay-cold {out['serving_bootstrap_cold_replay_ratio']}, "
+             f"cutover-off {out['serving_bootstrap_cutover_flatness_off']})")
+        return out
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -39,7 +39,8 @@ from ..core.formats import CHUNK_ALS, CHUNK_SVM, split_journal_chunk
 from ..core.params import Params
 from ..obs import metrics as obs_metrics
 from ..obs import tracing as obs_tracing
-from .journal import Journal
+from . import snapshot as snapshot_mod
+from .journal import Journal, OffsetTruncatedError
 from .server import LookupServer
 from .table import ModelTable, _fnv1a_batch
 
@@ -171,6 +172,9 @@ class ServingJob:
         replica_index: Optional[int] = None,
         topology_group: Optional[str] = None,
         generation: Optional[int] = None,
+        snapshots: Optional[bool] = None,
+        snapshot_min_bytes: Optional[int] = None,
+        compact: Optional[bool] = None,
     ):
         if start_from not in ("earliest", "latest"):
             raise ValueError("start_from must be earliest|latest")
@@ -224,6 +228,43 @@ class ServingJob:
         self.ingest_batches = 0
         self.ingest_apply_s = 0.0
         self.checkpoints_deferred = 0
+        # snapshot-shipped bootstrap (serve/snapshot.py): durable columnar
+        # per-shard snapshot artifacts published at checkpoint cadence; a
+        # (re)starting job bulk-loads the newest valid one and replays only
+        # the journal tail behind it — O(state) recovery instead of
+        # O(history) replay.  The native (rocksdb) table IS its own durable
+        # O(state) artifact, so snapshots apply to the in-RAM tables only.
+        if snapshots is None:
+            snapshots = os.environ.get("TPUMS_SNAPSHOTS", "1") != "0"
+        _sf = getattr(parse_fn, "shard_filter", None)
+        self._snap_owner = (int(_sf[0]), int(_sf[1])) if _sf else (0, 1)
+        self._snapshots_on = bool(snapshots) and hasattr(self.table, "_shards")
+        self._snap_root = snapshot_mod.snapshot_root(journal.dir, journal.topic)
+        if snapshot_min_bytes is None:
+            try:
+                snapshot_min_bytes = int(
+                    os.environ.get("TPUMS_SNAPSHOT_MIN_BYTES", 1 << 20)
+                )
+            except ValueError:
+                snapshot_min_bytes = 1 << 20
+        self._snap_min_bytes = max(int(snapshot_min_bytes), 1)
+        self._last_snap_offset = 0
+        self.bootstrap_source = "replay"
+        self.bootstrap_seconds: Optional[float] = None
+        self._bootstrap_t0: Optional[float] = None
+        # background journal compactor (serve/compact.py): the journal is
+        # shared, so exactly one member per fleet folds it — worker 0 of
+        # replica 0 (a solo job qualifies)
+        if compact is None:
+            from .compact import compact_enabled
+
+            compact = compact_enabled()
+        self._compact_on = (
+            bool(compact)
+            and self._snap_owner[0] == 0
+            and replica_index in (None, 0)
+        )
+        self._compactor = None
         # registry instruments (obs/): the ingest plane as scrapeable
         # series — labeled by state name only (a replica fleet is one job
         # per process; in-process test jobs share series and assert deltas)
@@ -244,6 +285,20 @@ class ServingJob:
             "tpums_checkpoints_deferred", state=st)
         self._obs_ready_flips = reg.counter(
             "tpums_ready_transitions_total", state=st)
+        # bootstrap/snapshot plane: how long a (re)start took to ready,
+        # which source fed it, restore failures that used to be swallowed
+        self._obs_restore_fail = reg.counter(
+            "tpums_checkpoint_restore_failures_total", state=st)
+        self._obs_bootstrap_s = reg.histogram(
+            "tpums_bootstrap_seconds", state=st)
+        self._obs_snap_age = reg.gauge(
+            "tpums_snapshot_age_seconds", state=st)
+        self._obs_snap_pub = reg.counter(
+            "tpums_snapshots_published_total", state=st)
+        self._obs_snap_restore_fail = reg.counter(
+            "tpums_snapshot_restore_failures_total", state=st)
+        self._obs_truncated = reg.counter(
+            "tpums_journal_truncated_total", state=st)
         # HA plane (serve/ha.py): membership in a replica set, announced
         # through the registry so clients and supervisors can resolve the
         # whole set by the logical shard-group id
@@ -310,12 +365,42 @@ class ServingJob:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "ServingJob":
-        restored = self.backend.restore(self.table)
+        self._bootstrap_t0 = time.monotonic()
+        restored = None
+        try:
+            restored = self.backend.restore(self.table)
+        except Exception as e:
+            # a corrupt/missing checkpoint is a counted event, not a crash:
+            # bootstrap falls down the chain (snapshot, else full replay)
+            self._obs_restore_fail.inc()
+            print(
+                f"[serve:{self.state_name}] checkpoint restore failed "
+                f"({e}); falling back to snapshot/replay bootstrap",
+                file=sys.stderr,
+            )
         if restored is not None:
             self.offset = restored
+            self.bootstrap_source = "checkpoint"
             print(
                 f"[serve:{self.state_name}] restored {len(self.table)} rows, "
                 f"journal offset {self.offset}",
+                file=sys.stderr,
+            )
+        # snapshot overlay: a published snapshot AHEAD of the checkpoint
+        # (or of offset 0) replaces that much replay with one columnar
+        # bulk-load; last-writer-wins overlay keeps a checkpoint-restored
+        # table convergent
+        info = self._try_snapshot_bootstrap(min_offset=self.offset + 1)
+        if info is not None:
+            self.offset = info["offset"]
+            self._last_snap_offset = info["offset"]
+            self.bootstrap_source = "snapshot"
+            if info.get("age_s") is not None:
+                self._obs_snap_age.set(info["age_s"])
+            print(
+                f"[serve:{self.state_name}] snapshot bootstrap: "
+                f"{info['rows']} rows from {info['members']} member(s), "
+                f"tail replay from offset {self.offset}",
                 file=sys.stderr,
             )
         self.server.start()
@@ -333,6 +418,14 @@ class ServingJob:
             target=self._heartbeat_loop, name="registry-heartbeat", daemon=True
         )
         self._hb_thread.start()
+        if self._compact_on:
+            from .compact import CompactorThread
+
+            # shares this job's stop event, so it stands down with stop()
+            self._compactor = CompactorThread(
+                self.journal, self.parse_fn, stop_event=self._stop
+            )
+            self._compactor.start()
         return self
 
     # -- liveness / readiness (HA plane surface) ---------------------------
@@ -370,7 +463,113 @@ class ServingJob:
             "topology_group": self.topology_group,
             "generation": self.generation,
             "topology_gen": self._observed_topology_gen,
+            "bootstrap_source": self.bootstrap_source,
+            "bootstrap_seconds": self.bootstrap_seconds,
         }
+
+    # -- snapshot bootstrap / publication (serve/snapshot.py) --------------
+
+    def _try_snapshot_bootstrap(
+        self, min_offset: int = 0, max_offset: Optional[int] = None
+    ) -> Optional[dict]:
+        """Bulk-load the newest valid snapshot covering this worker's key
+        slice (fallback chain: bad checksum -> older snapshot -> None, and
+        the caller replays the journal instead).  Corrupt members are
+        counted in ``tpums_snapshot_restore_failures_total``."""
+        if not self._snapshots_on:
+            return None
+        try:
+            return snapshot_mod.bootstrap(
+                self.table,
+                self._snap_root,
+                owner=self._snap_owner,
+                min_offset=min_offset,
+                max_offset=max_offset,
+                on_corrupt=lambda m: self._obs_snap_restore_fail.inc(),
+            )
+        except Exception as e:
+            # never let the bootstrap fast path kill a job that could have
+            # replayed its way up instead
+            print(
+                f"[serve:{self.state_name}] snapshot bootstrap failed "
+                f"({e}); replaying journal",
+                file=sys.stderr,
+            )
+            return None
+
+    def _maybe_publish_snapshot(self) -> None:
+        """Publish a snapshot artifact at the current (table, offset) —
+        called between chunks (same consistency point as a checkpoint) once
+        at least ``snapshot_min_bytes`` of journal landed since the last
+        one."""
+        if not self._snapshots_on or self.offset <= 0:
+            return
+        if self.offset - self._last_snap_offset < self._snap_min_bytes:
+            return
+        try:
+            manifest = snapshot_mod.publish(
+                self._snap_root,
+                self.table,
+                self.offset,
+                shard=self._snap_owner[0],
+                num_shards=self._snap_owner[1],
+                group=self.topology_group,
+                gen=self.generation,
+                topic=self.journal.topic,
+            )
+        except Exception as e:
+            print(
+                f"[serve:{self.state_name}] snapshot publish failed ({e})",
+                file=sys.stderr,
+            )
+            return
+        self._last_snap_offset = self.offset
+        self._obs_snap_pub.inc()
+        self._obs_snap_age.set(0.0)
+        obs_tracing.event(
+            "snapshot_published", state=self.state_name, job_id=self.job_id,
+            offset=self.offset, rows=manifest["rows"],
+            shard=self._snap_owner[0], num_shards=self._snap_owner[1])
+
+    def _recover_truncated(self, err: OffsetTruncatedError) -> int:
+        """The consume loop hit journal history that no longer exists
+        byte-for-byte.  Returns the offset to resume from; the table stays
+        convergent on every path (last-writer-wins re-application)."""
+        self._obs_truncated.inc()
+        if err.lossless:
+            # a fold replaced bytes we were mid-way through: re-reading the
+            # compacted prefix from its base is a last-writer-wins superset
+            # of what we already applied — zero loss
+            self.journal.compacted_rereads += 1
+            print(
+                f"[serve:{self.state_name}] journal compacted under us at "
+                f"{err.offset}; re-reading fold from {err.resume_offset}",
+                file=sys.stderr,
+            )
+            return err.resume_offset
+        # rows below resume_offset are GONE (retention); a snapshot at or
+        # above our applied offset covers the hole without data loss
+        info = self._try_snapshot_bootstrap(min_offset=err.offset)
+        if info is not None:
+            self._last_snap_offset = max(
+                self._last_snap_offset, info["offset"])
+            print(
+                f"[serve:{self.state_name}] offset {err.offset} expired; "
+                f"snapshot covers through {info['offset']}",
+                file=sys.stderr,
+            )
+            return info["offset"]
+        # no snapshot covers it: resume with an explicit, counted gap —
+        # the pre-typed-error journal behavior, now impossible to hit
+        # silently
+        lost = err.resume_offset - err.offset
+        self.journal.expired_bytes_skipped += lost
+        print(
+            f"[serve:{self.state_name}] offset {err.offset} expired and no "
+            f"snapshot covers it; skipping {lost} lost bytes",
+            file=sys.stderr,
+        )
+        return err.resume_offset
 
     def _heartbeat_now(self) -> None:
         from . import registry
@@ -517,6 +716,7 @@ class ServingJob:
                     # a corrupt/missing checkpoint must not kill the
                     # supervisor thread; continue from the in-memory state
                     # (at-least-once replay keeps the table convergent)
+                    self._obs_restore_fail.inc()
                     print(
                         f"[serve:{self.state_name}] checkpoint restore failed "
                         f"({re}); continuing from in-memory state at offset "
@@ -555,42 +755,49 @@ class ServingJob:
             rows_before = self.ingest_rows
             errs_before = self.parse_errors
             t0 = time.perf_counter()
-            if (
-                native_mode is not None
-                and hasattr(self.table, "ingest_lines")
-                and not getattr(self.table, "_listeners", True)
-            ):
-                self.ingest_path = "native"
-                chunk, next_offset = self.journal.read_bytes_from(
-                    self.offset, max_bytes=chunk_cap
-                )
-                got_any = bool(chunk)
-                if chunk:
-                    rows, errs = self.table.ingest_lines(chunk, native_mode)
-                    self.parse_errors += errs
-                    self.ingest_rows += rows
-                    self.ingest_batches += 1
-            elif columnar_mode is not None and self.ingest_mode != "scalar":
-                # columnar path: numpy splits the whole byte chunk into
-                # key/value columns, ownership filtering and shard routing
-                # are vectorized, and listeners get ONE batched callback
-                self.ingest_path = "columnar"
-                chunk, next_offset = self.journal.read_bytes_from(
-                    self.offset, max_bytes=chunk_cap
-                )
-                got_any = bool(chunk)
-                if chunk:
-                    self._apply_chunk_columnar(chunk, columnar_mode)
-                    self.ingest_batches += 1
-            else:
-                self.ingest_path = "scalar"
-                lines, next_offset = self.journal.read_from(
-                    self.offset, max_bytes=chunk_cap
-                )
-                got_any = bool(lines)
-                if lines:
-                    self._apply_lines(lines)
-                    self.ingest_batches += 1
+            try:
+                if (
+                    native_mode is not None
+                    and hasattr(self.table, "ingest_lines")
+                    and not getattr(self.table, "_listeners", True)
+                ):
+                    self.ingest_path = "native"
+                    chunk, next_offset = self.journal.read_bytes_from(
+                        self.offset, max_bytes=chunk_cap
+                    )
+                    got_any = bool(chunk)
+                    if chunk:
+                        rows, errs = self.table.ingest_lines(
+                            chunk, native_mode)
+                        self.parse_errors += errs
+                        self.ingest_rows += rows
+                        self.ingest_batches += 1
+                elif columnar_mode is not None and self.ingest_mode != "scalar":
+                    # columnar path: numpy splits the whole byte chunk into
+                    # key/value columns, ownership filtering and shard routing
+                    # are vectorized, and listeners get ONE batched callback
+                    self.ingest_path = "columnar"
+                    chunk, next_offset = self.journal.read_bytes_from(
+                        self.offset, max_bytes=chunk_cap
+                    )
+                    got_any = bool(chunk)
+                    if chunk:
+                        self._apply_chunk_columnar(chunk, columnar_mode)
+                        self.ingest_batches += 1
+                else:
+                    self.ingest_path = "scalar"
+                    lines, next_offset = self.journal.read_from(
+                        self.offset, max_bytes=chunk_cap
+                    )
+                    got_any = bool(lines)
+                    if lines:
+                        self._apply_lines(lines)
+                        self.ingest_batches += 1
+            except OffsetTruncatedError as err:
+                # our offset points at folded or expired history: recover
+                # (compacted re-read / snapshot / counted gap) and poll again
+                self.offset = self._recover_truncated(err)
+                continue
             if got_any:
                 dt = time.perf_counter() - t0
                 self.ingest_apply_s += dt
@@ -622,12 +829,30 @@ class ServingJob:
                 # heartbeat cadence would otherwise delay failback by up to
                 # one interval)
                 self._ready.set()
+                if self._bootstrap_t0 is not None:
+                    # cold-path bookkeeping, once per process lifetime: how
+                    # long start()->ready took and which source fed it —
+                    # the flatness the serving_bootstrap bench tracks
+                    self.bootstrap_seconds = (
+                        time.monotonic() - self._bootstrap_t0
+                    )
+                    self._bootstrap_t0 = None
+                    self._obs_bootstrap_s.observe(self.bootstrap_seconds)
+                    obs_metrics.get_registry().counter(
+                        "tpums_bootstrap_source", state=self.state_name,
+                        source=self.bootstrap_source).inc()
                 self._heartbeat_now()
                 self._obs_ready_flips.inc()
                 obs_tracing.event(
                     "ready", state=self.state_name, job_id=self.job_id,
                     offset=self.offset, replica_of=self.replica_of,
-                    replica=self.replica_index)
+                    replica=self.replica_index,
+                    source=self.bootstrap_source)
+                # a fresh snapshot right at ready makes the NEXT joiner's
+                # bootstrap O(state) even before a checkpoint interval
+                # elapses (min-bytes gated, so a snapshot-fed start that
+                # replayed a short tail won't immediately republish)
+                self._maybe_publish_snapshot()
             now = time.time()
             if now - last_checkpoint >= self.checkpoint_interval_s:
                 # a full-chunk poll means we're inside a cold-start replay
@@ -647,6 +872,7 @@ class ServingJob:
                     self.backend.snapshot(self.table, self.offset)
                     last_checkpoint = now
                     self._obs_ckpt.inc()
+                    self._maybe_publish_snapshot()
             if not got_any:
                 self._stop.wait(self.poll_interval_s)
 
@@ -783,6 +1009,11 @@ def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
         native_server=params.get_bool("nativeServer", False),
         start_from=params.get("startFrom", "earliest"),
         ingest_mode=params.get("ingestMode"),
+        snapshots=(
+            params.get_bool("snapshots") if params.has("snapshots") else None
+        ),
+        snapshot_min_bytes=params.get_int("snapshotMinBytes"),
+        compact=params.get_bool("compact") if params.has("compact") else None,
     )
     print(
         f"[serve] {state_name} serving topic '{journal.topic}' on port "

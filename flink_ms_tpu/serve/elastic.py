@@ -129,6 +129,8 @@ class ScaleController:
         defer: bool = False,
         lease_wait_s: float = 30.0,
         env: Optional[dict] = None,
+        snapshots: Optional[bool] = None,
+        snapshot_min_bytes: Optional[int] = None,
     ):
         self.group = group
         self.journal_dir = journal_dir
@@ -138,6 +140,8 @@ class ScaleController:
         self.host = host
         self.replication = replication
         self.extra_args = tuple(extra_args)
+        self.snapshots = snapshots
+        self.snapshot_min_bytes = snapshot_min_bytes
         self.checkpoint_uri = checkpoint_uri
         # drain grace: long enough for every client refresh cadence to
         # observe the new record before the old generation stops serving
@@ -199,6 +203,14 @@ class ScaleController:
                           ) -> ReplicaSupervisor:
         extra = list(self.extra_args)
         extra += ["--topologyGroup", self.group, "--topologyGen", str(gen)]
+        # snapshot-first bootstrap knobs: a warming g+1 worker bulk-loads
+        # the newest valid snapshot family published by generation g and
+        # replays only the journal tail — the cutover cost stays O(state)
+        # as the journal grows (serve/snapshot.py)
+        if self.snapshots is not None:
+            extra += ["--snapshots", "true" if self.snapshots else "false"]
+        if self.snapshot_min_bytes is not None:
+            extra += ["--snapshotMinBytes", str(self.snapshot_min_bytes)]
         if self.checkpoint_uri:
             extra += ["--checkpointDataUri",
                       f"{self.checkpoint_uri.rstrip('/')}/gen-{gen}"]

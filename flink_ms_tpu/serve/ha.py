@@ -684,7 +684,8 @@ def run_supervisor(params: Params) -> ReplicaSupervisor:
     extra: List[str] = []
     for passthrough in ("svm", "shards", "checkPointInterval",
                         "checkpointDataUri", "nativeServer", "ingestMode",
-                        "topologyGroup", "topologyGen"):
+                        "topologyGroup", "topologyGen",
+                        "snapshots", "snapshotMinBytes", "compact"):
         if params.has(passthrough):
             extra += [f"--{passthrough}", params.get(passthrough)]
     sup = ReplicaSupervisor(

@@ -14,21 +14,76 @@ appends; when ``segment_bytes`` is configured, a full segment is sealed
 and a new one starts at the current end offset, and ``retain_segments``
 bounds disk by deleting the oldest sealed segments.  Offsets are global
 byte positions (segment base + position), contiguous across rotation, so
-consumer checkpoints are unaffected.  A consumer whose committed offset
-has been expired by retention resumes at the earliest retained offset
-(Kafka's ``auto.offset.reset=earliest`` semantics) and the skipped byte
-count is surfaced on the journal object.
+consumer checkpoints are unaffected.
+
+Compaction (Kafka's log-compacted-topic semantics, the property the
+reference's model transport rides): sealed segments may be FOLDED
+last-writer-wins per key into a single compacted prefix segment
+(``<topic>.clog.<base>.<logical_end>``).  The compacted segment keeps the
+global-byte-offset contract by carrying both its base offset and the
+logical end offset of the history it replaces: a reader AT the base gets
+the folded rows and then jumps to ``logical_end``, where the untouched
+tail segments continue at their original offsets — live tailers past the
+fold never notice.  When a compacted prefix exists, ``retain_segments``
+stops blind-deleting: retention becomes "compacted prefix + tail" and the
+compactor bounds disk instead (see ``serve/compact.py``).
+
+A reader whose offset points at history that no longer exists byte-for-
+byte gets a typed ``OffsetTruncatedError`` — never a silent skip.  Two
+flavors: an offset below the earliest retained base names rows that are
+GONE (``lossless=False``; resuming at ``resume_offset`` loses data and
+must be an explicit, counted decision), while an offset strictly inside a
+compacted prefix names rows that were folded (``lossless=True``; resuming
+at ``resume_offset`` — the prefix base — re-reads a last-writer-wins
+superset, so state converges with zero loss).  Callers opt back into the
+old Kafka ``auto.offset.reset=earliest`` behavior with
+``on_truncated="reset"``, which counts the skipped bytes in
+``expired_bytes_skipped``.
 
 The log format is plain text lines, so journals are interoperable with the
 reference's model files and greppable during ops.  Segment files are
-``<topic>.log`` (base offset 0) and ``<topic>.log.<base>``.
+``<topic>.log`` (base offset 0), ``<topic>.log.<base>``, and
+``<topic>.clog.<base>.<logical_end>`` for the compacted prefix.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import Iterable, List, Optional, Tuple
+from typing import Callable, Iterable, List, NamedTuple, Optional, Tuple
+
+
+class OffsetTruncatedError(RuntimeError):
+    """A reader's offset points at journal history that no longer exists
+    byte-for-byte.
+
+    Attributes:
+        offset:        the offset the reader asked for
+        resume_offset: the earliest offset a replay may resume from
+        lossless:      True when resuming at ``resume_offset`` re-delivers
+                       a last-writer-wins superset of the missing range (a
+                       compacted prefix); False when the rows are gone
+                       (retention deleted them) and resuming skips data
+    """
+
+    def __init__(self, offset: int, resume_offset: int, lossless: bool,
+                 reason: str):
+        super().__init__(
+            f"offset {offset} truncated ({reason}); resume at "
+            f"{resume_offset} ({'lossless' if lossless else 'LOSSY'})"
+        )
+        self.offset = offset
+        self.resume_offset = resume_offset
+        self.lossless = lossless
+        self.reason = reason
+
+
+class _Seg(NamedTuple):
+    base: int
+    path: str
+    # logical end offset for a COMPACTED segment (the history it replaced
+    # ran [base, logical_end)); None for a plain segment
+    logical_end: Optional[int]
 
 
 class Journal:
@@ -56,43 +111,97 @@ class Journal:
         self._lock = threading.Lock()
         self.expired_bytes_skipped = 0  # consumer-side observability
         self.torn_bytes_skipped = 0     # newline-less tails of sealed segments
-        self._seg_cache: Optional[List[Tuple[int, str]]] = None
+        self.compacted_rereads = 0      # reset-mode restarts into a fold
+        self._seg_cache: Optional[List[_Seg]] = None
 
     # -- segment layout ------------------------------------------------------
 
-    def _segments(self) -> List[Tuple[int, str]]:
-        """Sorted [(base_offset, path)] of existing segments."""
-        prefix = f"{self.topic}.log"
-        out: List[Tuple[int, str]] = []
+    def _scan(self) -> List[_Seg]:
+        """All raw segment files on disk, sorted by (base, plain-first)."""
+        plain = f"{self.topic}.log"
+        clog = f"{self.topic}.clog."
+        out: List[_Seg] = []
         try:
             names = os.listdir(self.dir)
         except FileNotFoundError:
             return []
         for name in names:
-            if name == prefix:
-                out.append((0, os.path.join(self.dir, name)))
-            elif name.startswith(prefix + "."):
-                suffix = name[len(prefix) + 1:]
+            if name == plain:
+                out.append(_Seg(0, os.path.join(self.dir, name), None))
+            elif name.startswith(plain + "."):
+                suffix = name[len(plain) + 1:]
                 try:
-                    out.append((int(suffix), os.path.join(self.dir, name)))
+                    out.append(
+                        _Seg(int(suffix), os.path.join(self.dir, name), None)
+                    )
                 except ValueError:
                     continue  # unrelated file
-        out.sort()
+            elif name.startswith(clog):
+                parts = name[len(clog):].split(".")
+                if len(parts) != 2:
+                    continue  # in-flight tmp file or unrelated
+                try:
+                    base, lend = int(parts[0]), int(parts[1])
+                except ValueError:
+                    continue
+                if lend > base:
+                    out.append(
+                        _Seg(base, os.path.join(self.dir, name), lend)
+                    )
+        out.sort(key=lambda s: (s.base, s.logical_end is None,
+                                -(s.logical_end or 0)))
         return out
 
-    def _active_segment(self) -> Tuple[int, str]:
-        segs = self._segments()
-        if not segs:
-            return 0, self.path
-        return segs[-1]
+    @staticmethod
+    def _shadow(raw: List[_Seg]) -> List[_Seg]:
+        """Resolve the reader view: a compacted segment shadows every
+        segment whose base falls inside its [base, logical_end) range —
+        the plain originals it folded (kept briefly during the atomic
+        swap, or left by a crash mid-cleanup) and any older, narrower
+        fold."""
+        folds = [s for s in raw if s.logical_end is not None]
+        view: List[_Seg] = []
+        for s in raw:
+            shadowed = any(
+                f is not s
+                and f.base <= s.base < f.logical_end
+                and (s.logical_end is None or s.logical_end <= f.logical_end)
+                for f in folds
+            )
+            if not shadowed:
+                view.append(s)
+        return view
 
-    def _segments_cached(self, refresh: bool = False) -> List[Tuple[int, str]]:
-        """Consumer-side segment list; one os.listdir only when the cache
+    def _segments(self) -> List[Tuple[int, str]]:
+        """Sorted [(base_offset, path)] of the reader-visible segments
+        (compacted prefix included, shadowed leftovers excluded)."""
+        return [(s.base, s.path) for s in self._shadow(self._scan())]
+
+    def _view(self) -> List[_Seg]:
+        return self._shadow(self._scan())
+
+    def _view_cached(self, refresh: bool = False) -> List[_Seg]:
+        """Consumer-side segment view; one os.listdir only when the cache
         is cold, explicitly refreshed, or the topic has no known segments
         (a poll on the hot path must not list the whole journal dir)."""
         if refresh or not self._seg_cache:
-            self._seg_cache = self._segments()
+            self._seg_cache = self._view()
         return self._seg_cache
+
+    def _active_segment(self) -> Tuple[int, str]:
+        """The append target: the highest-base plain segment, or a fresh
+        plain segment at ``logical_end`` when the whole log is one fold."""
+        view = self._view()
+        if not view:
+            return 0, self.path
+        last = view[-1]
+        if last.logical_end is not None:
+            # fully-compacted log: appends restart a plain segment exactly
+            # at the fold's logical end, keeping offsets contiguous
+            return last.logical_end, os.path.join(
+                self.dir, f"{self.topic}.log.{last.logical_end}"
+            )
+        return last.base, last.path
 
     # -- producer side -------------------------------------------------------
 
@@ -144,14 +253,30 @@ class Journal:
                 return base + f.tell()
 
     def _apply_retention_locked(self) -> None:
+        raw = self._scan()
+        view = self._shadow(raw)
+        # leftovers a fold superseded are garbage regardless of policy:
+        # delete them (also finishes the cleanup a compactor crash left)
+        visible = {s.path for s in view}
+        for s in raw:
+            if s.path not in visible:
+                try:
+                    os.remove(s.path)
+                except OSError:
+                    pass
         if self.retain_segments is None:
             return
-        segs = self._segments()
+        if any(s.logical_end is not None for s in view):
+            # compacted prefix present: retention is "compacted prefix +
+            # tail".  Blind deletion of sealed tail segments would strand
+            # readers AND race the compactor that is about to fold them —
+            # the compactor bounds disk by folding, not retention.
+            return
         # +1: the about-to-be-created active segment counts toward the bound
-        excess = len(segs) + 1 - self.retain_segments
-        for base, path in segs[:max(excess, 0)]:
+        excess = len(view) + 1 - self.retain_segments
+        for s in view[:max(excess, 0)]:
             try:
-                os.remove(path)
+                os.remove(s.path)
             except OSError:
                 pass
 
@@ -166,12 +291,97 @@ class Journal:
             except FileNotFoundError:
                 pass
 
+    # -- compaction (serve/compact.py drives this) ---------------------------
+
+    def compact_prefix(
+        self,
+        fold_fn: Callable[[bytes], bytes],
+        min_segments: int = 2,
+    ) -> Optional[dict]:
+        """Fold every SEALED segment (all but the active one) into a single
+        compacted prefix segment, last-writer-wins per key.
+
+        ``fold_fn`` receives the concatenated bytes of the sealed prefix
+        (complete, newline-terminated rows in journal order) and returns
+        the folded bytes — key semantics live in ``serve/compact.py`` so
+        the journal stays format-agnostic.  The swap is atomic: the fold
+        is written to a tmp file, fsynced, renamed to
+        ``<topic>.clog.<base>.<logical_end>``, and only then are the
+        folded originals deleted — a reader either sees the old segments
+        or the complete fold, never a torn mix, and a SIGKILL at any point
+        leaves a valid segment set (the tmp file is invisible to
+        ``_scan`` and the shadow rule hides not-yet-deleted originals).
+
+        Returns a stats dict, or None when there is nothing to fold (fewer
+        than ``min_segments`` sealed segments, or no new sealed rows since
+        the previous fold) or the prefix raced retention/another fold.
+        """
+        view = self._view()
+        if len(view) < 2:
+            return None  # nothing sealed: never fold the active segment
+        prefix = view[:-1]
+        if not any(s.logical_end is None for s in prefix):
+            return None  # fold already covers every sealed row
+        if len(prefix) < max(min_segments, 1):
+            return None
+        contents: List[bytes] = []
+        rotted = False
+        for s in prefix:
+            try:
+                with open(s.path, "rb") as f:
+                    contents.append(f.read())
+            except (FileNotFoundError, OSError):
+                rotted = True  # raced retention/another compactor: retry later
+                break
+        if rotted:
+            return None
+        data = b"".join(contents)
+        folded = fold_fn(data)
+        if folded and not folded.endswith(b"\n"):
+            folded += b"\n"
+        base = prefix[0].base
+        logical_end = view[-1].base  # first offset NOT folded (the tail)
+        if logical_end <= base:
+            return None
+        final = os.path.join(
+            self.dir, f"{self.topic}.clog.{base}.{logical_end}"
+        )
+        tmp = f"{final}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(folded)
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, final)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        with self._lock:
+            for s in prefix:
+                if s.path != final:
+                    try:
+                        os.remove(s.path)
+                    except OSError:
+                        pass
+            self._seg_cache = None
+        return {
+            "segments_folded": len(prefix),
+            "base": base,
+            "logical_end": logical_end,
+            "bytes_in": len(data),
+            "bytes_out": len(folded),
+            "bytes_reclaimed": len(data) - len(folded),
+        }
+
     # -- consumer side -------------------------------------------------------
 
     def start_offset(self) -> int:
         """Earliest retained offset (0 unless retention expired segments)."""
-        segs = self._segments()
-        return segs[0][0] if segs else 0
+        view = self._view()
+        return view[0].base if view else 0
 
     def end_offset(self) -> int:
         base, path = self._active_segment()
@@ -203,45 +413,81 @@ class Journal:
         return base
 
     def read_bytes_from(
-        self, offset: int, max_bytes: int = 1 << 24
+        self, offset: int, max_bytes: int = 1 << 24,
+        on_truncated: str = "raise",
     ) -> Tuple[bytes, int]:
         """Poll the raw complete-lines byte chunk after ``offset`` —
         (chunk ending at its last newline, next_offset).  The zero-decode
-        variant of ``read_from`` for native bulk ingest.  An offset inside
-        an expired segment skips forward to the earliest retained offset
-        (counted in ``expired_bytes_skipped``)."""
-        out = self._try_read(offset, max_bytes, refresh=False)
-        if out is not None and (out[0] or out[1] != offset):
-            return out
-        # nothing advanced with the cached layout: rescan once — a new
-        # segment may have been rolled, or retention may have moved the
-        # earliest base — then report whatever the fresh view yields
-        out = self._try_read(offset, max_bytes, refresh=True)
-        return out if out is not None else (b"", offset)
+        variant of ``read_from`` for native bulk ingest.
+
+        An offset pointing at history that no longer exists byte-for-byte
+        (expired by retention, or folded into a compacted prefix) raises
+        ``OffsetTruncatedError`` so the caller can bootstrap from a
+        snapshot instead of silently skipping rows.
+        ``on_truncated="reset"`` opts back into the old
+        ``auto.offset.reset=earliest`` behavior: resume at the earliest
+        replayable offset, counting lost bytes in
+        ``expired_bytes_skipped`` (a compacted-prefix restart is lossless
+        and counts in ``compacted_rereads`` instead).
+
+        A read that lands exactly on a compacted prefix base returns the
+        WHOLE folded prefix in one chunk, ``max_bytes`` notwithstanding:
+        intermediate positions inside a fold are not valid offsets (the
+        fold is O(state), the same bound as a snapshot bulk-load).
+        """
+        if on_truncated not in ("raise", "reset"):
+            raise ValueError("on_truncated must be raise|reset")
+        try:
+            out = self._try_read(offset, max_bytes, refresh=False)
+            if out is not None and (out[0] or out[1] != offset):
+                return out
+            # nothing advanced with the cached layout: rescan once — a new
+            # segment may have been rolled, retention may have moved the
+            # earliest base, or a fold may have replaced the prefix — then
+            # report whatever the fresh view yields
+            out = self._try_read(offset, max_bytes, refresh=True)
+            return out if out is not None else (b"", offset)
+        except OffsetTruncatedError as e:
+            if on_truncated != "reset":
+                raise
+            if e.lossless:
+                self.compacted_rereads += 1
+            else:
+                self.expired_bytes_skipped += e.resume_offset - offset
+            return self.read_bytes_from(
+                e.resume_offset, max_bytes, on_truncated="reset"
+            )
 
     def _try_read(
         self, offset: int, max_bytes: int, refresh: bool
     ) -> Optional[Tuple[bytes, int]]:
-        segs = self._segments_cached(refresh)
+        segs = self._view_cached(refresh)
         if not segs:
             return None
-        base, path = segs[0]
-        for b, p in reversed(segs):
-            if offset >= b:
-                base, path = b, p
+        if offset < segs[0].base:
+            if not refresh:
+                return None  # stale cache must not fabricate a truncation
+            raise OffsetTruncatedError(
+                offset, segs[0].base, lossless=False,
+                reason="below earliest retained segment",
+            )
+        seg = segs[0]
+        for s in reversed(segs):
+            if offset >= s.base:
+                seg = s
                 break
-        if offset < base:  # expired by retention: reset to earliest
-            self.expired_bytes_skipped += base - offset
-            offset = base
+        if seg.logical_end is not None:
+            return self._read_compacted(seg, offset, max_bytes, refresh)
+        base, path = seg.base, seg.path
         try:
             size = os.path.getsize(path)
             with open(path, "rb") as f:
                 f.seek(offset - base)
                 chunk = f.read(max_bytes)
-        except FileNotFoundError:  # expired between scan and read
+        except FileNotFoundError:  # expired/folded between scan and read
             return None
         sealed_end = next(
-            (b for b, _ in segs if b > base), None
+            (s.base for s in segs if s.base > base), None
         )  # this segment is sealed iff a later one exists
         if not chunk:
             if sealed_end is not None and offset >= base + size:
@@ -262,13 +508,49 @@ class Journal:
         complete = chunk[: last_nl + 1]
         return complete, offset + len(complete)
 
-    def read_from(self, offset: int, max_bytes: int = 1 << 24) -> Tuple[List[str], int]:
+    def _read_compacted(
+        self, seg: _Seg, offset: int, max_bytes: int, refresh: bool
+    ) -> Optional[Tuple[bytes, int]]:
+        assert seg.logical_end is not None
+        if offset >= seg.logical_end:
+            # at/past the fold's logical end with no later segment visible
+            # (the tail normally starts exactly there): nothing to read yet
+            return b"", offset
+        if offset != seg.base:
+            # A byte offset strictly inside the folded range indexes the
+            # OLD byte stream; the fold has a different physical layout,
+            # so the position is untranslatable.  Restarting at the base
+            # re-reads the fold — a last-writer-wins superset of what the
+            # reader already applied — hence lossless.
+            if not refresh:
+                return None
+            raise OffsetTruncatedError(
+                offset, seg.base, lossless=True,
+                reason="inside compacted prefix",
+            )
+        try:
+            with open(seg.path, "rb") as f:
+                content = f.read()
+        except FileNotFoundError:  # superseded by a newer fold mid-read
+            return None
+        if not content:
+            # everything in the prefix was superseded: continue at the tail
+            return self._try_read(seg.logical_end, max_bytes, False)
+        return content, seg.logical_end
+
+    def read_from(
+        self, offset: int, max_bytes: int = 1 << 24,
+        on_truncated: str = "raise",
+    ) -> Tuple[List[str], int]:
         """Poll records after `offset`; returns (lines, next_offset).
 
         Only complete lines are returned; a torn tail (producer mid-append)
-        stays unconsumed until its newline lands.
+        stays unconsumed until its newline lands.  Truncated offsets raise
+        ``OffsetTruncatedError`` (see ``read_bytes_from``).
         """
-        complete, next_offset = self.read_bytes_from(offset, max_bytes)
+        complete, next_offset = self.read_bytes_from(
+            offset, max_bytes, on_truncated=on_truncated
+        )
         if not complete:
             return [], next_offset
         return complete.decode("utf-8").splitlines(), next_offset

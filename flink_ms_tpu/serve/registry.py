@@ -418,6 +418,48 @@ def drop_topology(group: str) -> None:
         pass
 
 
+# ---------------------------------------------------------------------------
+# snapshot manifests (serve/snapshot.py publishes, fleet scrape reads)
+# ---------------------------------------------------------------------------
+
+def snapshot_scope(
+    group: Optional[str], topic: Optional[str], num_shards: int, shard: int
+) -> str:
+    """One registry record per (group-or-topic, sharding, shard): the
+    LATEST published snapshot for that slice."""
+    return f"snap/{group or topic or 'default'}/{num_shards}/{shard}"
+
+
+def _snapshot_path(scope: str) -> str:
+    return _group_path(scope, "snap.json")
+
+
+def publish_snapshot(scope: str, manifest: dict) -> None:
+    """Register the slice's latest snapshot manifest.  Best-effort by
+    design: bootstrap resolves snapshots from the data dirs (which survive
+    a wiped registry); this record only feeds fleet observability."""
+    os.makedirs(registry_dir(), exist_ok=True)
+    path = _snapshot_path(scope)
+    record = {"kind": "snapshot", "scope": scope,
+              "published_at": time.time(), "manifest": dict(manifest)}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def resolve_snapshot(scope: str) -> Optional[dict]:
+    """The slice's latest registered snapshot manifest, or None."""
+    record = _read_record(_snapshot_path(scope), "snapshot")
+    return record.get("manifest") if record else None
+
+
 def generation_of(entry: dict, group: str, gen_sep: str = "@g"
                   ) -> Optional[int]:
     """Parse the topology generation out of a worker entry's shard-group id

@@ -422,6 +422,13 @@ def run_worker(params: Params) -> ServingJob:
         replica_index=replica_index,
         topology_group=topology_group,
         generation=topology_gen,
+        # snapshot-first bootstrap + background compactor knobs (defaults
+        # come from TPUMS_SNAPSHOTS / TPUMS_COMPACT when flags are absent)
+        snapshots=(
+            params.get_bool("snapshots") if params.has("snapshots") else None
+        ),
+        snapshot_min_bytes=params.get_int("snapshotMinBytes"),
+        compact=params.get_bool("compact") if params.has("compact") else None,
     ).start()
     print(
         f"[serve:sharded] worker {worker_index}/{num_workers}"

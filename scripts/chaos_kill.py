@@ -8,12 +8,20 @@ time (kill -> the respawned replica registers ready again).
     python scripts/chaos_kill.py [env knobs below]
 
 Knobs (env):
-    CHAOS_MODE=ha          "ha" (kill serving replicas, below) or "elastic"
+    CHAOS_MODE=ha          "ha" (kill serving replicas, below), "elastic"
                            (kill a WARMING replica mid-bootstrap during a
                            live scale-out — the elastic plane's cutover
                            failure model: the supervisor respawns it,
                            replay resumes, the cutover still completes,
-                           and no client ever saw the warming generation)
+                           and no client ever saw the warming generation),
+                           or "snapshot" (run with aggressive snapshot
+                           publishing + background journal compaction and
+                           SIGKILL replicas mid-publish / mid-fold: every
+                           surviving snapshot must still pass its checksum
+                           gate, respawns must bootstrap from a snapshot,
+                           and clients see zero errors at R >= 2)
+    CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
+                           history over few keys so the fold has work)
     CHAOS_WORKERS=2        shards
     CHAOS_REPLICATION=2    replicas per shard (1 reproduces the reference's
                            single-owner outage behavior)
@@ -343,5 +351,209 @@ def elastic_main() -> int:
         ctl.stop(drop_topology=True)
 
 
+def snapshot_main() -> int:
+    """SIGKILL replicas mid-snapshot-publish and mid-compaction.  The
+    cluster runs with a tiny publish threshold (a snapshot per
+    checkpoint) and an aggressive background compactor while a producer
+    keeps appending, so kills land inside both write paths.  Contracts
+    under test (serve/snapshot.py atomic tmp-dir publish, serve/journal.py
+    atomic fold swap): every snapshot visible to resolution still passes
+    its checksum gate, respawned replicas bootstrap from a snapshot (not
+    full replay), and clients see zero errors at R >= 2."""
+    from flink_ms_tpu.serve import snapshot as snapshot_mod
+    from flink_ms_tpu.serve.client import QueryClient
+
+    rows = int(os.environ.get("CHAOS_ROWS", 20_000))
+    base = tempfile.mkdtemp(prefix="tpums_chaos_snap_")
+    # long history over few keys in SMALL segments: both the publisher
+    # and the compactor have continuous work to be killed in the middle of
+    journal = Journal(os.path.join(base, "bus"), "models",
+                      segment_bytes=32 << 10)
+    rng = np.random.default_rng(0)
+    k = 4
+    batch = [F.format_als_row(u, "U", rng.normal(size=k))
+             for u in range(N_USERS)]
+    for i in range(rows):
+        batch.append(F.format_als_row(i % N_USERS, "I", rng.normal(size=k)))
+        if len(batch) >= 2_000:
+            journal.append(batch, flush=False)
+            batch = []
+    if batch:
+        journal.append(batch)
+    keys = [f"{u}-U" for u in range(N_USERS)]
+    snap_root = snapshot_mod.snapshot_root(journal.dir, "models")
+
+    # workers inherit these: compact on shard 0 replica 0, fast cadence
+    os.environ["TPUMS_COMPACT_INTERVAL_S"] = os.environ.get(
+        "TPUMS_COMPACT_INTERVAL_S", "0.2")
+    os.environ["TPUMS_COMPACT_MIN_SEGMENTS"] = os.environ.get(
+        "TPUMS_COMPACT_MIN_SEGMENTS", "2")
+    sup = ReplicaSupervisor(
+        W, R, journal.dir, "models", os.path.join(base, "ports"),
+        state_backend="memory",
+        check_interval_s=registry.heartbeat_interval_s(),
+        respawn_delay_s=0.1,
+        extra_args=["--snapshotMinBytes", "1", "--compact", "true"],
+    )
+    event("chaos_snapshot_start", workers=W, replication=R, rows=rows,
+          group=sup.job_group, duration_s=DURATION_S,
+          kill_every_s=KILL_EVERY_S)
+    ok = [0] * THREADS
+    errs = [0] * THREADS
+    stop = threading.Event()
+    kills = []        # (t_kill, shard, replica, old_pid)
+    recoveries = []   # (recovery_s or None, bootstrap_source or None)
+
+    def load(widx):
+        c = sup.client(retry=RetryPolicy(
+            attempts=6, backoff_s=0.02, max_backoff_s=0.5), timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                try:
+                    good = c.query_state(
+                        ALS_STATE, keys[r.randrange(len(keys))]) is not None
+                except Exception:
+                    good = False
+                (ok if good else errs)[widx] += 1
+
+    def produce():
+        # keep the journal moving so checkpoints (and therefore snapshot
+        # publishes) and folds keep happening throughout the kill window
+        r = np.random.default_rng(7)
+        i = 0
+        while not stop.is_set():
+            journal.append(
+                [F.format_als_row((i + j) % N_USERS, "I", r.normal(size=k))
+                 for j in range(500)], flush=False)
+            i += 500
+            time.sleep(0.05)
+
+    def other_replicas_ready(shard, replica):
+        members = registry.resolve_replicas(sup.group_of(shard))
+        return any(e.get("replica") != replica and e.get("ready")
+                   for e in members)
+
+    def wait_recovered(shard, replica, old_pid, timeout_s=60.0):
+        # a NEW pid registering ready is the unambiguous signal (the
+        # stale record still says ready until the respawn overwrites it)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            members = registry.resolve_replicas(sup.group_of(shard))
+            if any(e.get("replica") == replica and e.get("ready")
+                   and e.get("pid") not in (None, old_pid)
+                   for e in members):
+                return True
+            time.sleep(0.05)
+        return False
+
+    with sup.start():
+        if not sup.wait_all_ready(120):
+            event("chaos_abort", reason="cluster never became ready")
+            return 2
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        threads.append(threading.Thread(target=produce, daemon=True))
+        for t in threads:
+            t.start()
+        t_end = time.time() + DURATION_S
+        next_kill = time.time() + (KILL_EVERY_S or float("inf"))
+        r = random.Random(42)
+        victim_cycle = 0
+        while time.time() < t_end:
+            time.sleep(0.05)
+            if not (KILL_EVERY_S and time.time() >= next_kill):
+                continue
+            # bias kills onto shard 0 — it hosts the compactor and (like
+            # every shard) the replica-0 snapshot publisher — alternating
+            # replicas so both the publish and fold paths get hit, but
+            # never kill a replica whose peers aren't ready (that would
+            # make client errors expected instead of contract-violating)
+            shard = 0 if victim_cycle % 2 == 0 else r.randrange(W)
+            replica = victim_cycle % R
+            victim_cycle += 1
+            proc = sup.procs.get((shard, replica))
+            if (proc is None or proc.poll() is not None
+                    or not other_replicas_ready(shard, replica)):
+                next_kill = time.time() + 0.25
+                continue
+            event("chaos_kill", shard=shard, replica=replica,
+                  pid=proc.pid, group=sup.group_of(shard))
+            proc.send_signal(signal.SIGKILL)
+            t_kill = time.time()
+            kills.append((t_kill, shard, replica, proc.pid))
+            if wait_recovered(shard, replica, proc.pid):
+                rec = round(time.time() - t_kill, 2)
+                source = None
+                try:
+                    with QueryClient(
+                            sup.host, sup.ports[(shard, replica)],
+                            timeout_s=5) as qc:
+                        source = qc.health(ALS_STATE).get(
+                            "bootstrap_source")
+                except Exception:
+                    pass
+                event("chaos_recovery", shard=shard, replica=replica,
+                      recovery_s=rec, bootstrap_source=source)
+                recoveries.append((rec, source))
+            else:
+                event("chaos_recovery", shard=shard, replica=replica,
+                      recovery_s=None, bootstrap_source=None)
+                recoveries.append((None, None))
+            next_kill = time.time() + KILL_EVERY_S * (0.5 + r.random())
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        # checksum-gate audit: every snapshot that resolution would hand
+        # a bootstrapping replica must verify; interrupted publishes may
+        # leave .tmp- dirs behind, which must stay invisible
+        snapshot_audit = {"verified": 0, "plans": 0, "tmp_leftovers": 0,
+                          "corrupt": []}
+        if os.path.isdir(snap_root):
+            snapshot_audit["tmp_leftovers"] = sum(
+                1 for n in os.listdir(snap_root) if n.startswith(".tmp-"))
+        for shard in range(W):
+            plan = snapshot_mod.resolve(snap_root, owner=(shard, W))
+            if plan is None:
+                continue
+            snapshot_audit["plans"] += 1
+            for member in plan["members"]:
+                try:
+                    snapshot_mod.read_columns(member)
+                    snapshot_audit["verified"] += 1
+                except snapshot_mod.SnapshotCorruptError as e:
+                    snapshot_audit["corrupt"].append(str(e))
+
+    total_ok, total_err = sum(ok), sum(errs)
+    total = total_ok + total_err
+    snap_bootstraps = sum(1 for _, src in recoveries if src == "snapshot")
+    recovered = [rec for rec, _ in recoveries if rec is not None]
+    summary = {
+        "mode": "snapshot", "workers": W, "replication": R,
+        "rows_seeded": rows, "duration_s": DURATION_S,
+        "queries": total, "ok": total_ok, "errors": total_err,
+        "availability": round(total_ok / total, 6) if total else None,
+        "kills": len(kills), "respawns": sup.respawns,
+        "recovery_s": [rec for rec, _ in recoveries],
+        "bootstrap_sources": [src for _, src in recoveries],
+        "snapshot_bootstraps": snap_bootstraps,
+        "snapshot_audit": snapshot_audit,
+        "timeline": [e for e in recent_events()
+                     if e["kind"].startswith(("chaos_", "replica_"))],
+    }
+    print(json.dumps(summary, indent=1))
+    failed = (
+        (R >= 2 and total_err > 0)            # zero-visible-error contract
+        or not kills                           # the chaos never happened
+        or len(recovered) < len(kills)         # a respawn never came back
+        or snapshot_audit["corrupt"]           # a bad checksum was served
+        or snapshot_audit["plans"] < W         # a shard has no snapshot
+        or snap_bootstraps == 0                # recovery replayed history
+    )
+    return 1 if failed else 0
+
+
 if __name__ == "__main__":
-    sys.exit(elastic_main() if MODE == "elastic" else main())
+    sys.exit({"elastic": elastic_main,
+              "snapshot": snapshot_main}.get(MODE, main)())

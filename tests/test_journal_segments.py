@@ -4,13 +4,15 @@ deletion, and auto.offset.reset=earliest semantics for expired offsets."""
 
 import os
 
-from flink_ms_tpu.serve.journal import Journal
+import pytest
+
+from flink_ms_tpu.serve.journal import Journal, OffsetTruncatedError
 
 
-def _drain(j, offset=0):
+def _drain(j, offset=0, on_truncated="raise"):
     out = []
     while True:
-        lines, offset = j.read_from(offset)
+        lines, offset = j.read_from(offset, on_truncated=on_truncated)
         if not lines:
             return out, offset
         out.extend(lines)
@@ -40,8 +42,16 @@ def test_retention_deletes_oldest_and_resets_consumer(tmp_path):
     segs = [n for n in os.listdir(tmp_path) if n.startswith("t.log")]
     assert len(segs) <= 2
     assert j.start_offset() > 0
-    # an expired committed offset resumes at the earliest retained offset
-    got, _ = _drain(j, 0)
+    # an expired committed offset is a TYPED error by default — never a
+    # silent skip (the bootstrap path catches it and falls back to a
+    # snapshot, serve/consumer.py)
+    with pytest.raises(OffsetTruncatedError) as ei:
+        j.read_from(0)
+    assert ei.value.lossless is False
+    assert ei.value.resume_offset == j.start_offset()
+    # opting back into auto.offset.reset=earliest resumes at the earliest
+    # retained offset and counts the loss
+    got, _ = _drain(j, 0, on_truncated="reset")
     assert got == rows[-len(got):]  # a suffix of the stream, in order
     assert got, "nothing survived retention"
     assert j.expired_bytes_skipped > 0

@@ -1,0 +1,333 @@
+"""Snapshot-shipped bootstrap (serve/snapshot.py + consumer wiring):
+columnar publish/restore through ``put_many_columns``, the fallback chain
+(bad checksum -> older snapshot -> full replay), resharded family loads,
+truncation recovery, and the restore-failure counters that used to be
+swallowed."""
+
+import os
+import shutil
+import time
+
+import pytest
+
+from flink_ms_tpu.obs import metrics as obs_metrics
+from flink_ms_tpu.serve import registry
+from flink_ms_tpu.serve import snapshot as sm
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    MemoryStateBackend,
+    ServingJob,
+    parse_als_record,
+)
+from flink_ms_tpu.serve.journal import Journal, OffsetTruncatedError
+from flink_ms_tpu.serve.table import ModelTable, _fnv1a
+
+
+def _table(n_rows, n_shards=4, tag="v"):
+    t = ModelTable(n_shards)
+    for i in range(n_rows):
+        t.put(f"k{i}-I", f"{tag}{i}")
+    return t
+
+
+def _counter_value(name, **labels):
+    snap = obs_metrics.get_registry().snapshot()
+    for c in snap.get("counters", []):
+        if c["name"] == name and all(
+            c.get("labels", {}).get(k) == v for k, v in labels.items()
+        ):
+            return c["value"]
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# artifact layer
+# ---------------------------------------------------------------------------
+
+def test_publish_restore_roundtrip_uses_columns(tmp_path, monkeypatch):
+    root = str(tmp_path / "snaps")
+    t = _table(500)
+    m = sm.publish(root, t, offset=12345, shard=0, num_shards=1, topic="als")
+    assert m["rows"] == 500 and m["offset"] == 12345
+    assert m["format"] == sm.SNAP_FORMAT
+    # manifest is discoverable and verifiable
+    (found,) = sm.list_manifests(root)
+    assert found["checksum"] == m["checksum"]
+    keys, vals = sm.read_columns(found)
+    assert len(keys) == len(vals) == 500
+    # restore goes through the columnar bulk path, not per-row puts
+    calls = []
+    t2 = ModelTable(4)
+    orig = ModelTable.put_many_columns
+
+    def spy(self, ks, vs, hashes=None):
+        calls.append(len(ks))
+        return orig(self, ks, vs, hashes=hashes)
+
+    monkeypatch.setattr(ModelTable, "put_many_columns", spy)
+    monkeypatch.setattr(
+        ModelTable, "put",
+        lambda *a, **k: pytest.fail("restore must not use per-row put"))
+    info = sm.bootstrap(t2, root, owner=(0, 1))
+    assert info == {"offset": 12345, "rows": 500, "members": 1,
+                    "exact": True, "age_s": info["age_s"]}
+    assert info["age_s"] is not None and info["age_s"] < 60
+    assert sum(calls) == 500
+    monkeypatch.undo()
+    assert dict(t2._shards[0]) == dict(t._shards[0])
+    assert t2.get("k7-I") == "v7"
+
+
+def test_empty_table_snapshot_roundtrip(tmp_path):
+    root = str(tmp_path / "snaps")
+    sm.publish(root, ModelTable(2), offset=10, shard=0, num_shards=1)
+    t = ModelTable(2)
+    info = sm.bootstrap(t, root, owner=(0, 1))
+    assert info["rows"] == 0 and info["offset"] == 10
+    assert len(t) == 0
+
+
+def test_fallback_chain_bad_checksum_to_older_to_replay(tmp_path):
+    root = str(tmp_path / "snaps")
+    t_old = _table(100, tag="old")
+    t_new = _table(100, tag="new")
+    sm.publish(root, t_old, offset=100, shard=0, num_shards=1, keep=5)
+    time.sleep(0.002)
+    sm.publish(root, t_new, offset=200, shard=0, num_shards=1, keep=5)
+    ms = sm.list_manifests(root)
+    assert [m["offset"] for m in ms] == [100, 200]
+    # corrupt the NEWEST: chain must fall to the older valid snapshot
+    with open(os.path.join(ms[1]["path"], "vals.txt"), "ab") as f:
+        f.write(b"garbage\n")
+    corrupt_seen = []
+    t = ModelTable(4)
+    info = sm.bootstrap(t, root, owner=(0, 1),
+                        on_corrupt=lambda m: corrupt_seen.append(m["path"]))
+    assert info["offset"] == 100
+    assert t.get("k3-I") == "old3"
+    assert corrupt_seen == [ms[1]["path"]]
+    # corrupt the older one too: chain ends in None -> caller full-replays
+    shutil.rmtree(ms[0]["path"])
+    os.makedirs(ms[0]["path"])
+    with open(os.path.join(ms[0]["path"], "MANIFEST.json"), "w") as f:
+        f.write("{not json")
+    t2 = ModelTable(4)
+    info2 = sm.bootstrap(t2, root, owner=(0, 1),
+                         on_corrupt=lambda m: corrupt_seen.append(m["path"]))
+    # checksum verification happens BEFORE any rows load, so the table is
+    # untouched and the caller full-replays the journal
+    assert info2 is None and len(t2) == 0
+
+
+def test_read_columns_verifies_before_load(tmp_path):
+    root = str(tmp_path / "snaps")
+    sm.publish(root, _table(50), offset=50, shard=0, num_shards=1)
+    (m,) = sm.list_manifests(root)
+    with open(os.path.join(m["path"], "keys.txt"), "ab") as f:
+        f.write(b"extra-key\n")
+    t = ModelTable(4)
+    with pytest.raises(sm.SnapshotCorruptError):
+        sm.load_plan(t, {"members": [m], "exact": True, "offset": 50})
+    assert len(t) == 0  # nothing applied from a bad member
+
+
+def test_resolve_prefers_exact_identity_then_family(tmp_path):
+    root = str(tmp_path / "snaps")
+    # a 2-shard family at offset 100/90 + an exact (4,1) snapshot at 80
+    t0 = ModelTable(2)
+    t1 = ModelTable(2)
+    for i in range(200):
+        k = f"k{i}-I"
+        (t0 if _fnv1a(k) % 2 == 0 else t1).put(k, f"v{i}")
+    sm.publish(root, t0, offset=100, shard=0, num_shards=2)
+    sm.publish(root, t1, offset=90, shard=1, num_shards=2)
+    sm.publish(root, ModelTable(2), offset=80, shard=1, num_shards=4)
+    # exact (4,1) exists but the 2-family replays from min(100,90)=90 > 80
+    plan = sm.resolve(root, owner=(1, 4))
+    assert plan["exact"] is False and plan["offset"] == 90
+    assert len(plan["members"]) == 2
+    # a worker with the family's own identity takes the exact fast path
+    plan0 = sm.resolve(root, owner=(0, 2))
+    assert plan0["exact"] is True and plan0["offset"] == 100
+    # family load filters to the new owner's hash slice
+    t = ModelTable(2)
+    rows = sm.load_plan(t, plan, owner=(1, 4))
+    for shard in t._shards:
+        for k in shard:
+            assert _fnv1a(k) % 4 == 1
+    assert rows == sum(1 for i in range(200)
+                       if _fnv1a(f"k{i}-I") % 4 == 1)
+    # incomplete family (missing shard) is never offered
+    os.remove(os.path.join(
+        sm.resolve(root, owner=(1, 2))["members"][0]["path"],
+        "MANIFEST.json"))
+    plan2 = sm.resolve(root, owner=(1, 4))
+    assert plan2 is None or all(
+        m["num_shards"] != 2 for m in plan2["members"])
+
+
+def test_prune_keeps_newest_per_slice(tmp_path):
+    root = str(tmp_path / "snaps")
+    for off in (10, 20, 30, 40):
+        sm.publish(root, _table(5), offset=off, shard=0, num_shards=1,
+                   keep=2)
+        time.sleep(0.002)
+    offs = [m["offset"] for m in sm.list_manifests(root)]
+    assert offs == [30, 40]
+
+
+def test_partial_tmp_dir_is_invisible(tmp_path):
+    root = str(tmp_path / "snaps")
+    sm.publish(root, _table(5), offset=10, shard=0, num_shards=1)
+    # a crash mid-publish leaves only a tmp dir: never resolvable
+    os.makedirs(os.path.join(root, ".tmp-snap-1-0-99-123-456"))
+    assert [m["offset"] for m in sm.list_manifests(root)] == [10]
+
+
+def test_registry_snapshot_record(tmp_path):
+    root = str(tmp_path / "snaps")
+    m = sm.publish(root, _table(5), offset=10, shard=0, num_shards=2,
+                   group="g1", topic="als")
+    scope = registry.snapshot_scope("g1", "als", 2, 0)
+    rec = registry.resolve_snapshot(scope)
+    assert rec is not None and rec["offset"] == 10
+    assert rec["checksum"] == m["checksum"]
+
+
+# ---------------------------------------------------------------------------
+# consumer wiring
+# ---------------------------------------------------------------------------
+
+def _seed_journal(tmp_path, n=2000, keys=200):
+    j = Journal(str(tmp_path / "journal"), "als")
+    for i in range(n):
+        j.append([f"{i % keys},I,v{i}"], flush=False)
+    j.sync()
+    return j
+
+
+def _job(j, **kw):
+    kw.setdefault("backend", MemoryStateBackend())
+    kw.setdefault("port", 0)
+    kw.setdefault("topk_index", False)
+    kw.setdefault("poll_interval_s", 0.02)
+    return ServingJob(j, ALS_STATE, parse_als_record, kw.pop("backend"), **kw)
+
+
+def test_job_bootstraps_from_snapshot_and_replays_tail(tmp_path):
+    j = _seed_journal(tmp_path)
+    # first job replays fully, publishes a snapshot at ready
+    job1 = _job(j, snapshot_min_bytes=1).start()
+    assert job1.wait_ready(30)
+    assert job1.bootstrap_source == "replay"
+    snap_off = job1.offset
+    job1.stop()
+    ms = sm.list_manifests(sm.snapshot_root(j.dir, j.topic))
+    assert ms and ms[-1]["offset"] == snap_off
+    # tail rows after the snapshot
+    j.append(["0,I,tail-row"])
+    job2 = _job(j, snapshot_min_bytes=1).start()
+    try:
+        assert job2.wait_ready(30)
+        assert job2.bootstrap_source == "snapshot"
+        assert job2.bootstrap_seconds is not None
+        assert job2.table.get("0-I") == "tail-row"  # tail replayed on top
+        assert job2.table.get("7-I") == "v1807"
+        assert job2.health()["bootstrap_source"] == "snapshot"
+    finally:
+        job2.stop()
+
+
+def test_job_snapshots_disabled_replays(tmp_path):
+    j = _seed_journal(tmp_path, n=200)
+    job1 = _job(j, snapshot_min_bytes=1).start()
+    assert job1.wait_ready(30)
+    job1.stop()
+    job2 = _job(j, snapshots=False).start()
+    try:
+        assert job2.wait_ready(30)
+        assert job2.bootstrap_source == "replay"
+        assert len(job2.table) == 200
+    finally:
+        job2.stop()
+
+
+def test_job_falls_back_to_replay_on_corrupt_snapshot(tmp_path):
+    j = _seed_journal(tmp_path, n=400)
+    job1 = _job(j, snapshot_min_bytes=1).start()
+    assert job1.wait_ready(30)
+    job1.stop()
+    root = sm.snapshot_root(j.dir, j.topic)
+    (m,) = sm.list_manifests(root)
+    with open(os.path.join(m["path"], "vals.txt"), "ab") as f:
+        f.write(b"junk\n")
+    before = _counter_value(
+        "tpums_snapshot_restore_failures_total", state=ALS_STATE)
+    job2 = _job(j).start()
+    try:
+        assert job2.wait_ready(30)
+        assert job2.bootstrap_source == "replay"
+        assert len(job2.table) == 200
+        assert _counter_value(
+            "tpums_snapshot_restore_failures_total", state=ALS_STATE
+        ) == before + 1
+    finally:
+        job2.stop()
+
+
+def test_checkpoint_restore_failure_is_counted_not_fatal(tmp_path):
+    j = _seed_journal(tmp_path, n=100)
+
+    class BrokenBackend(MemoryStateBackend):
+        def restore(self, table):
+            raise RuntimeError("corrupt checkpoint")
+
+    before = _counter_value(
+        "tpums_checkpoint_restore_failures_total", state=ALS_STATE)
+    job = _job(j, backend=BrokenBackend(), snapshots=False).start()
+    try:
+        assert job.wait_ready(30)
+        assert job.bootstrap_source == "replay"
+        assert len(job.table) == 100
+        assert _counter_value(
+            "tpums_checkpoint_restore_failures_total", state=ALS_STATE
+        ) == before + 1
+    finally:
+        job.stop()
+
+
+def test_truncated_offset_recovers_via_snapshot(tmp_path):
+    """A consumer stranded below the earliest retained offset covers the
+    hole with a snapshot at-or-above its position — zero data loss."""
+    j = _seed_journal(tmp_path, n=600, keys=60)
+    end = j.end_offset()
+    root = sm.snapshot_root(j.dir, j.topic)
+    t = ModelTable(8)
+    for i in range(600):
+        t.put(f"{i % 60}-I", f"v{i}")
+    sm.publish(root, t, end, shard=0, num_shards=1, topic="als")
+    job = _job(j)
+    err = OffsetTruncatedError(0, 500, lossless=False, reason="expired")
+    resume = job._recover_truncated(err)
+    assert resume == end
+    assert job.table.get("59-I") == "v599"
+    # lossless flavor: resume at the fold base, count the re-read
+    err2 = OffsetTruncatedError(700, 650, lossless=True, reason="fold")
+    assert job._recover_truncated(err2) == 650
+    assert j.compacted_rereads == 1
+    # lossy with NO covering snapshot: counted gap, resume offset honored
+    shutil.rmtree(root)
+    job2 = _job(j)
+    err3 = OffsetTruncatedError(0, 500, lossless=False, reason="expired")
+    assert job2._recover_truncated(err3) == 500
+    assert j.expired_bytes_skipped == 500
+
+
+def test_min_offset_skips_stale_snapshot(tmp_path):
+    """A snapshot BEHIND the restored checkpoint offset is useless and
+    must not be loaded."""
+    root = str(tmp_path / "snaps")
+    sm.publish(root, _table(10, tag="stale"), offset=100, shard=0,
+               num_shards=1)
+    assert sm.resolve(root, owner=(0, 1), min_offset=101) is None
+    assert sm.resolve(root, owner=(0, 1), min_offset=100) is not None
