@@ -31,7 +31,10 @@ Knobs (all ``TPUMS_COMPACT_*``):
 One compactor per journal directory: the fold/swap is crash-safe against
 readers and the producer (atomic rename + shadowing), but two concurrent
 compactors would duplicate work — the serving CLI only enables the
-background thread on worker 0 / replica 0 of a fleet.
+background thread on worker 0 / replica 0 of a fleet, and an elastic
+worker additionally stands down (``active_fn``) unless its topology
+generation is the group's ACTIVE one, so a warming gen-g+1 fleet never
+folds the shared journal alongside the still-active gen g.
 """
 
 from __future__ import annotations
@@ -197,7 +200,11 @@ class CompactorThread(threading.Thread):
     """Background fold pass on a fixed cadence, stopping with its owner.
 
     Failures never propagate — a fold pass that loses a race (retention,
-    a concurrent fold, the producer rotating) simply retries next tick."""
+    a concurrent fold, the producer rotating) simply retries next tick.
+    ``active_fn`` (checked fresh each tick) lets the owner stand the
+    compactor down without stopping it — an elastic worker passes its
+    am-I-the-active-generation check so exactly one fleet folds the
+    shared journal through a cutover."""
 
     def __init__(
         self,
@@ -206,6 +213,7 @@ class CompactorThread(threading.Thread):
         interval_s: Optional[float] = None,
         min_segments: Optional[int] = None,
         stop_event: Optional[threading.Event] = None,
+        active_fn: Optional[Callable[[], bool]] = None,
     ):
         super().__init__(name="journal-compactor", daemon=True)
         self.journal = journal
@@ -216,16 +224,22 @@ class CompactorThread(threading.Thread):
         self.min_segments = (
             compact_min_segments() if min_segments is None else min_segments
         )
-        self._stop = stop_event if stop_event is not None else threading.Event()
+        # NOT self._stop: that would shadow threading.Thread's private
+        # _stop() method and blow up inside Thread.join()
+        self._stop_event = (
+            stop_event if stop_event is not None else threading.Event()
+        )
+        self.active_fn = active_fn
         self.passes = 0
         self.folds = 0
         self.rows_folded = 0
         self.bytes_reclaimed = 0
+        self.standdowns = 0
         self.last_stats: Optional[dict] = None
         self.last_error: Optional[str] = None
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_event.set()
 
     def run_once(self) -> Optional[dict]:
         self.passes += 1
@@ -246,7 +260,12 @@ class CompactorThread(threading.Thread):
         return stats
 
     def run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while not self._stop_event.wait(self.interval_s):
+            if self.active_fn is not None and not self.active_fn():
+                # e.g. a warming elastic generation: the gen-g fleet is
+                # still the journal's compactor — skip, re-check next tick
+                self.standdowns += 1
+                continue
             self.run_once()
 
 

@@ -254,7 +254,10 @@ class ServingJob:
         self._bootstrap_t0: Optional[float] = None
         # background journal compactor (serve/compact.py): the journal is
         # shared, so exactly one member per fleet folds it — worker 0 of
-        # replica 0 (a solo job qualifies)
+        # replica 0 (a solo job qualifies).  Elastic jobs additionally
+        # stand the thread down per-tick unless their generation is the
+        # group's ACTIVE one (_compactor_active): during a cutover, gen g
+        # and the warming gen g+1 both have a worker 0 on the same journal
         if compact is None:
             from .compact import compact_enabled
 
@@ -423,7 +426,8 @@ class ServingJob:
 
             # shares this job's stop event, so it stands down with stop()
             self._compactor = CompactorThread(
-                self.journal, self.parse_fn, stop_event=self._stop
+                self.journal, self.parse_fn, stop_event=self._stop,
+                active_fn=self._compactor_active,
             )
             self._compactor.start()
         return self
@@ -547,9 +551,12 @@ class ServingJob:
                 file=sys.stderr,
             )
             return err.resume_offset
-        # rows below resume_offset are GONE (retention); a snapshot at or
-        # above our applied offset covers the hole without data loss
-        info = self._try_snapshot_bootstrap(min_offset=err.offset)
+        # rows below resume_offset are GONE (retention); only a snapshot
+        # that reaches the retained region (offset >= resume_offset) covers
+        # the hole with zero loss.  One below resume_offset must NOT be
+        # resumed from — its offset points back into the hole, so the next
+        # read re-raises this same truncation and the loop livelocks
+        info = self._try_snapshot_bootstrap(min_offset=err.resume_offset)
         if info is not None:
             self._last_snap_offset = max(
                 self._last_snap_offset, info["offset"])
@@ -559,17 +566,40 @@ class ServingJob:
                 file=sys.stderr,
             )
             return info["offset"]
-        # no snapshot covers it: resume with an explicit, counted gap —
-        # the pre-typed-error journal behavior, now impossible to hit
-        # silently
-        lost = err.resume_offset - err.offset
+        # a snapshot strictly inside the hole can't be resumed from, but
+        # bulk-loading it still narrows the loss: state through its offset
+        # is covered, and only (snapshot offset, resume_offset) is gone
+        info = self._try_snapshot_bootstrap(
+            min_offset=err.offset + 1, max_offset=err.resume_offset)
+        if info is not None:
+            self._last_snap_offset = max(
+                self._last_snap_offset, info["offset"])
+        base = info["offset"] if info is not None else err.offset
+        # resume with an explicit, counted gap — the pre-typed-error
+        # journal behavior, now impossible to hit silently
+        lost = err.resume_offset - base
         self.journal.expired_bytes_skipped += lost
         print(
-            f"[serve:{self.state_name}] offset {err.offset} expired and no "
-            f"snapshot covers it; skipping {lost} lost bytes",
+            f"[serve:{self.state_name}] offset {err.offset} expired; no "
+            f"snapshot reaches retained offset {err.resume_offset}; "
+            f"skipping {lost} lost bytes (state covered through {base})",
             file=sys.stderr,
         )
         return err.resume_offset
+
+    def _compactor_active(self) -> bool:
+        """Per-tick compactor gate (CompactorThread ``active_fn``): True
+        when this worker's topology generation is the group's ACTIVE one,
+        as observed at heartbeat time.  During an elastic cutover both
+        gen g and the warming gen g+1 have a worker 0 on the shared
+        journal; the warming fleet stands down until its generation is
+        published active (and the retired fleet stands down right after),
+        keeping the one-compactor-per-journal invariant.  Non-elastic
+        jobs always qualify."""
+        if self.topology_group is None or self.generation is None:
+            return True
+        obs = self._observed_topology_gen
+        return obs is None or int(obs) == int(self.generation)
 
     def _heartbeat_now(self) -> None:
         from . import registry

@@ -157,15 +157,35 @@ def _register(manifest: dict, topic: Optional[str]) -> None:
 
 
 def _prune(root: str, num_shards: int, shard: int, keep: int) -> None:
+    import shutil
+
+    all_ms = list_manifests(root)  # oldest-first
     mine = [
-        m for m in list_manifests(root)
+        m for m in all_ms
         if m["num_shards"] == num_shards and m["shard"] == shard
     ]
     mine.sort(key=lambda m: (m["offset"], m["ts"]))
-    import shutil
-
+    removed = set()
     for old in mine[:-keep]:
         shutil.rmtree(old["path"], ignore_errors=True)
+        removed.add(old["path"])
+    # foreign-topology leftovers: after an elastic reshard nobody publishes
+    # under the OLD num_shards anymore, so its family would outlive every
+    # identity-scoped prune above — unbounded growth across reshards.
+    # Once a COMPLETE family of the publisher's (current) topology exists,
+    # any foreign snapshot at or below that family's replay offset is
+    # strictly superseded for every bootstrapper (exact or resharded:
+    # resolve() always prefers the higher-offset plan) — reclaim it.
+    newest_cur: dict = {}
+    for m in all_ms:
+        if m["num_shards"] == num_shards and m["path"] not in removed:
+            newest_cur[m["shard"]] = m  # oldest-first scan: newest wins
+    if set(newest_cur.keys()) < set(range(num_shards)):
+        return
+    floor = min(m["offset"] for m in newest_cur.values())
+    for m in all_ms:
+        if m["num_shards"] != num_shards and m["offset"] <= floor:
+            shutil.rmtree(m["path"], ignore_errors=True)
 
 
 # -- discovery / verification ------------------------------------------------
@@ -217,8 +237,13 @@ def read_columns(manifest: dict) -> Tuple[List[str], List[str]]:
         raise SnapshotCorruptError(path, f"unreadable columns: {e}")
     if _columns_checksum(keys_b, vals_b) != manifest["checksum"]:
         raise SnapshotCorruptError(path, "checksum mismatch")
-    keys = keys_b.decode("utf-8").splitlines() if keys_b else []
-    vals = vals_b.decode("utf-8").splitlines() if vals_b else []
+    # exact mirror of the writer's '"\n".join(col) + "\n"' encoding: split
+    # on \n ONLY and drop the one trailing empty element.  splitlines()
+    # would also break on \x85/\u2028/\u2029/\v/\f, which are legal INSIDE
+    # a key or value (the ingest paths split raw bytes on \n alone) — that
+    # skew fails the row-count check below on every restore
+    keys = keys_b.decode("utf-8").split("\n")[:-1] if keys_b else []
+    vals = vals_b.decode("utf-8").split("\n")[:-1] if vals_b else []
     if len(keys) != len(vals) or len(keys) != manifest["rows"]:
         raise SnapshotCorruptError(
             path,

@@ -294,3 +294,29 @@ def test_compactor_thread_run_once_and_races(tmp_path):
     j2.append(["1,I,x"])
     assert compact_journal(
         j2, parse_fn=parse_als_record, min_segments=1) is None
+
+
+def test_compactor_thread_active_fn_stands_down(tmp_path):
+    """``active_fn`` gates each tick: an inactive owner (e.g. a warming
+    elastic generation) folds nothing, and folding starts as soon as the
+    gate flips — no restart needed."""
+    import time
+
+    j = Journal(str(tmp_path), "t", segment_bytes=64)
+    for i in range(40):
+        j.append([f"{i % 5},I,v{i}"], flush=False)
+    j.sync()
+    active = [False]
+    ct = CompactorThread(j, parse_als_record, interval_s=0.01,
+                         min_segments=1, active_fn=lambda: active[0])
+    ct.start()
+    deadline = time.time() + 10
+    while ct.standdowns == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert ct.standdowns > 0 and ct.folds == 0 and ct.passes == 0
+    active[0] = True
+    while ct.folds == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    ct.stop()
+    ct.join(timeout=5)
+    assert ct.folds >= 1

@@ -323,6 +323,85 @@ def test_truncated_offset_recovers_via_snapshot(tmp_path):
     assert j.expired_bytes_skipped == 500
 
 
+def test_truncated_offset_snapshot_below_resume_no_livelock(tmp_path):
+    """Regression: a snapshot whose offset sits INSIDE the retention hole
+    (below ``err.resume_offset``) cannot cover it — resuming at it would
+    immediately re-raise the same truncation and spin forever.  Recovery
+    must resume in retained history, counting only the narrowed gap."""
+    j = _seed_journal(tmp_path, n=600, keys=60)
+    root = sm.snapshot_root(j.dir, j.topic)
+    t = ModelTable(8)
+    for i in range(400):
+        t.put(f"{i % 60}-I", f"v{i}")
+    sm.publish(root, t, 400, shard=0, num_shards=1, topic="als")
+    job = _job(j)
+    skipped0 = j.expired_bytes_skipped
+    err = OffsetTruncatedError(0, 500, lossless=False, reason="expired")
+    resume = job._recover_truncated(err)
+    assert resume == 500  # retained history, NOT the snapshot's 400
+    # the in-hole snapshot still narrowed the loss: state through 400 is
+    # bulk-loaded and only (400, 500) counts as gone
+    assert j.expired_bytes_skipped - skipped0 == 100
+    assert job.table.get("39-I") == "v399"
+    # hitting the same hole again converges the same way — never 400
+    err2 = OffsetTruncatedError(0, 500, lossless=False, reason="expired")
+    assert job._recover_truncated(err2) == 500
+
+
+def test_snapshot_roundtrip_unicode_line_separators(tmp_path):
+    """splitlines() regression: \\x85/\\u2028/\\u2029/\\v/\\f inside a key
+    or value are legal (the ingest paths split raw bytes on \\n only) and
+    must not skew the column split — with splitlines() every such
+    snapshot failed row-count verification at restore, silently disabling
+    the O(state) bootstrap."""
+    root = str(tmp_path / "snaps")
+    t = ModelTable(2)
+    t.put("k\u2028ey-I", "v\x85al\u2029ue\v\f")
+    t.put("plain-I", "v2")
+    sm.publish(root, t, offset=10, shard=0, num_shards=1)
+    t2 = ModelTable(2)
+    info = sm.bootstrap(t2, root, owner=(0, 1))
+    assert info is not None and info["rows"] == 2
+    assert t2.get("k\u2028ey-I") == "v\x85al\u2029ue\v\f"
+    assert t2.get("plain-I") == "v2"
+
+
+def test_prune_reclaims_superseded_foreign_topology(tmp_path):
+    """After an elastic reshard nobody publishes under the OLD num_shards
+    again, so identity-scoped pruning alone would leak its family forever.
+    It is reclaimed once a COMPLETE current-topology family sits at-or-
+    above its offsets — not before, and never while it is ahead."""
+    root = str(tmp_path / "snaps")
+    for s in range(4):
+        sm.publish(root, _table(5), offset=100 + s, shard=s, num_shards=4)
+    # current (2,*) family incomplete: the 4-family is still the best
+    # resharded plan anyone can resolve — kept
+    sm.publish(root, _table(5), offset=200, shard=0, num_shards=2)
+    assert any(m["num_shards"] == 4 for m in sm.list_manifests(root))
+    # complete (2,*) family above every old offset: old family reclaimed
+    sm.publish(root, _table(5), offset=210, shard=1, num_shards=2)
+    assert all(m["num_shards"] == 2 for m in sm.list_manifests(root))
+    # a foreign snapshot AHEAD of the current family's floor survives
+    sm.publish(root, _table(5), offset=300, shard=0, num_shards=3)
+    sm.publish(root, _table(5), offset=220, shard=0, num_shards=2)
+    assert any(m["num_shards"] == 3 for m in sm.list_manifests(root))
+
+
+def test_compactor_gate_follows_active_generation(tmp_path):
+    """Exactly one fleet folds the shared journal through a cutover: a
+    warming generation stands down until the registry names it active,
+    and the retired generation stands down right after."""
+    j = _seed_journal(tmp_path, n=10)
+    job = _job(j, topology_group="g", generation=2)
+    job._observed_topology_gen = 1   # warming: gen 1 is still active
+    assert not job._compactor_active()
+    job._observed_topology_gen = 2   # cutover published our generation
+    assert job._compactor_active()
+    job._observed_topology_gen = 3   # superseded by gen 3
+    assert not job._compactor_active()
+    assert _job(j)._compactor_active()  # non-elastic: always qualifies
+
+
 def test_min_offset_skips_stale_snapshot(tmp_path):
     """A snapshot BEHIND the restored checkpoint offset is useless and
     must not be loaded."""
