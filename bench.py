@@ -38,8 +38,10 @@ BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 (per-device peak for MFU; default inferred from device_kind),
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
-serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap;
-default all),
+serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
+serving_native; default all),
+BENCH_NATIVE_KEYS / BENCH_NATIVE_GETS / BENCH_NATIVE_TOPKS /
+BENCH_NATIVE_ITEMS (serving-native tab-vs-B2 wire protocol A/B scale),
 BENCH_INGEST_ROWS /
 BENCH_INGEST_K / BENCH_INGEST_PROP_PROBES (serving-ingest replay scale),
 BENCH_HA_USERS / BENCH_HA_DURATION_S / BENCH_HA_WORKERS /
@@ -860,6 +862,8 @@ _COMPACT_KEYS = (
     "serving_ha_r2_availability", "serving_ha_r2_recovery_s",
     "serving_elastic_cutover_s", "serving_elastic_during_p99_ms",
     "serving_elastic_errors",
+    "serving_native_get_b2_c64_p50_us", "serving_native_get_b2_speedup_c64",
+    "serving_native_topk_b2_speedup_c64", "serving_native_cutover_errors",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1112,7 +1116,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
     sections = os.environ.get(
         "BENCH_SECTIONS",
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
-        "serving_elastic,serving_rehearsal,serving_bootstrap"
+        "serving_elastic,serving_rehearsal,serving_bootstrap,"
+        "serving_native"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1187,6 +1192,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_rehearsal", "run_serving_rehearsal_section",
          lambda f: f(small)),
         ("serving_bootstrap", "run_serving_bootstrap_section",
+         lambda f: f(small)),
+        ("serving_native", "run_serving_native_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

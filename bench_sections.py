@@ -1955,3 +1955,319 @@ def run_serving_bootstrap_section(small: bool) -> dict:
             else:
                 os.environ[key] = val
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# serving-native section: wire protocol v2 A/B on the full native query path
+# ---------------------------------------------------------------------------
+
+def _get_loop(port, state, keys, total, proto_mode):
+    """Strict request/reply GETs (1 in flight) -> (qps, p50_us)."""
+    from flink_ms_tpu.serve.client import QueryClient
+
+    lat_us = []
+    with QueryClient("127.0.0.1", port, timeout_s=600,
+                     proto=proto_mode) as c:
+        c.ping()  # connect + HELLO negotiation outside the clock
+        for i in range(min(total, 200)):  # warm both planes' caches
+            c._roundtrip(f"GET\t{state}\t{keys[i % len(keys)]}")
+        t_all = time.perf_counter()
+        for i in range(total):
+            t0 = time.perf_counter()
+            r = c._roundtrip(f"GET\t{state}\t{keys[i % len(keys)]}")
+            lat_us.append((time.perf_counter() - t0) * 1e6)
+            if not r or r[0] not in "VN":
+                raise RuntimeError(f"bad reply: {r!r}")
+        elapsed = time.perf_counter() - t_all
+    return round(total / elapsed, 1), round(
+        float(np.percentile(lat_us, 50)), 2)
+
+
+def _get_pipelined(port, state, keys, window, batches, proto_mode):
+    """GETs down one connection with `window` in flight -> (qps, p50_us)
+    where p50 is the per-request cost of the median window (pipelining
+    amortizes framing + syscalls over the whole window — in B2 mode each
+    window is ONE frame on the wire each way)."""
+    from flink_ms_tpu.serve.client import QueryClient
+
+    per_batch_us = []
+    with QueryClient("127.0.0.1", port, timeout_s=600,
+                     proto=proto_mode) as c:
+        c.ping()
+        reqs = [f"GET\t{state}\t{keys[i % len(keys)]}"
+                for i in range(window)]
+        c.pipeline(reqs, window=window)  # warm-up window
+        t_all = time.perf_counter()
+        for _ in range(batches):
+            t0 = time.perf_counter()
+            replies = c.pipeline(reqs, window=window)
+            per_batch_us.append(
+                (time.perf_counter() - t0) * 1e6 / window)
+            bad = [r for r in replies if not r or r[0] not in "VN"]
+            if bad:
+                raise RuntimeError(f"bad replies: {bad[:3]!r}")
+        elapsed = time.perf_counter() - t_all
+    return round(batches * window / elapsed, 1), round(
+        float(np.percentile(per_batch_us, 50)), 2)
+
+
+def run_serving_native_section(small: bool) -> dict:
+    """The round-8 wire-protocol A/B: tab (v1) vs binary batched (B2)
+    framing over the SAME servers, plus a native-fleet elastic cutover
+    smoke.  Three subsections:
+
+      get     point lookups against the C++ epoll server at 1/16/64 in
+              flight.  At 1 in flight the two framings are within noise
+              (both are one small write + one small read); the win is the
+              pipelined window, where B2 ships the whole window as one
+              frame each way.  Headline:
+              ``serving_native_get_b2_c64_p50_us`` (< 15 us acceptance).
+      topk    batched TOPK against the Python plane's microbatcher
+              (TPUMS_TOPK_BATCH_MAX=64) at 64 in flight.  For a v1 client
+              "64 in flight" means 64 strict request/reply connections
+              (the line protocol has no in-connection batching); one B2
+              connection with window=64 ships each window as a single
+              frame and hands the microbatcher all 64 queries atomically.
+              A single-connection tab pipeline (``topk_tabpipe``) is
+              recorded for context.  Headline:
+              ``serving_native_topk_b2_speedup_c64`` (>= 2x acceptance).
+      cutover subprocess native fleet (--stateBackend rocksdb
+              --nativeServer true) rescaled 2 -> 4 under a query stream:
+              zero client-visible errors, cutover wall-clock recorded.
+    """
+    import threading
+
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.serve.client import QueryClient, RetryPolicy
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve.server import LookupServer
+    from flink_ms_tpu.serve.table import ModelTable
+
+    out: dict = {}
+    n_keys = int(os.environ.get("BENCH_NATIVE_KEYS",
+                                1_024 if small else 8_192))
+    get_total = int(os.environ.get("BENCH_NATIVE_GETS",
+                                   2_000 if small else 20_000))
+    topk_total = int(os.environ.get("BENCH_NATIVE_TOPKS",
+                                    256 if small else 1_024))
+    dim = 16
+    rng = np.random.default_rng(0)
+    tmp = tempfile.mkdtemp(prefix="bench_native_")
+    saved = {key: os.environ.get(key) for key in
+             ("TPUMS_HEARTBEAT_S", "TPUMS_REPLICA_TTL_S",
+              "TPUMS_REGISTRY_DIR", "TPUMS_TOPK_BATCH_MAX")}
+
+    def payload(vec):
+        return ";".join(repr(round(float(x), 4)) for x in vec)
+
+    try:
+        # -- GET framing A/B on the C++ server ----------------------------
+        try:
+            from flink_ms_tpu.serve.native_store import (NativeLookupServer,
+                                                         NativeStore)
+
+            store = NativeStore(os.path.join(tmp, "store"))
+            keys = []
+            for u in range(n_keys):
+                store.put(f"{u}-U", payload(rng.normal(size=dim)))
+                keys.append(f"{u}-U")
+            with NativeLookupServer(store, ALS_STATE, job_id="bench",
+                                    port=0) as nsrv:
+                for mode in ("tab", "b2"):
+                    qps, p50 = _get_loop(nsrv.port, ALS_STATE, keys,
+                                         get_total, mode)
+                    out[f"serving_native_get_{mode}_c1_qps"] = qps
+                    out[f"serving_native_get_{mode}_c1_p50_us"] = p50
+                    for win in (16, 64):
+                        qps, p50 = _get_pipelined(
+                            nsrv.port, ALS_STATE, keys, win,
+                            max(get_total // win, 20), mode)
+                        out[f"serving_native_get_{mode}_c{win}_qps"] = qps
+                        out[f"serving_native_get_{mode}_c{win}_p50_us"] = p50
+                    _log(f"[bench:native] GET {mode}: c1 "
+                         f"{out[f'serving_native_get_{mode}_c1_qps']} qps, "
+                         f"c64 {out[f'serving_native_get_{mode}_c64_qps']} "
+                         f"qps / "
+                         f"{out[f'serving_native_get_{mode}_c64_p50_us']} "
+                         "us/req p50")
+            store.close()
+            tab64 = out.get("serving_native_get_tab_c64_qps")
+            b64 = out.get("serving_native_get_b2_c64_qps")
+            if tab64 and b64:
+                out["serving_native_get_b2_speedup_c64"] = round(
+                    b64 / tab64, 2)
+        except Exception:
+            _log(traceback.format_exc())
+            out["serving_native_get_error"] = traceback.format_exc(limit=3)
+
+        # -- batched TOPK framing A/B through the microbatcher ------------
+        try:
+            os.environ["TPUMS_TOPK_BATCH_MAX"] = "64"
+            from flink_ms_tpu.serve.topk import make_als_topk_handler
+
+            table = ModelTable(dim)
+            n_items = int(os.environ.get("BENCH_NATIVE_ITEMS",
+                                         512 if small else 2_048))
+            n_users = 256
+            for i in range(n_items):
+                table.put(f"{i}-I", payload(rng.normal(size=dim)))
+            for u in range(n_users):
+                table.put(f"{u}-U", payload(rng.normal(size=dim)))
+            handler = make_als_topk_handler(table)
+            srv = LookupServer({ALS_STATE: table}, host="127.0.0.1",
+                               port=0, job_id="bench",
+                               topk_handlers={ALS_STATE: handler}).start()
+            try:
+                k = 10
+                handler.index.warm_batch_shapes(k, 64)
+                topk_rng = np.random.default_rng(1)
+                reqs = [
+                    "TOPK\t%s\t%d\t%d" % (
+                        ALS_STATE, int(topk_rng.integers(0, n_users)), k)
+                    for _ in range(topk_total)
+                ]
+
+                # tab headline arm: 64 in flight for a v1 client means 64
+                # strict request/reply CONNECTIONS — the line protocol has
+                # no in-connection batching, so the microbatcher only sees
+                # whatever the 64 sockets happen to deliver concurrently.
+                def _tab_worker(my_reqs, barrier, errs, idx):
+                    try:
+                        with QueryClient("127.0.0.1", srv.port,
+                                         timeout_s=600, proto="tab") as c:
+                            c._roundtrip(my_reqs[0])  # warm
+                            barrier.wait()
+                            for r in my_reqs:
+                                rep = c._roundtrip(r)
+                                if not rep or rep[0] not in "VN":
+                                    raise RuntimeError(f"bad topk: {rep!r}")
+                    except Exception as e:  # pragma: no cover - surfaced below
+                        errs[idx] = e
+                        barrier.abort()
+
+                conns = 64
+                per_conn = max(topk_total // conns, 4)
+                barrier = threading.Barrier(conns + 1)
+                errs: dict = {}
+                threads = [
+                    threading.Thread(
+                        target=_tab_worker,
+                        args=([reqs[(i * per_conn + j) % len(reqs)]
+                               for j in range(per_conn)], barrier, errs, i),
+                        daemon=True)
+                    for i in range(conns)
+                ]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.join()
+                elapsed = time.perf_counter() - t0
+                if errs:
+                    raise next(iter(errs.values()))
+                out["serving_native_topk_tab_c64_qps"] = round(
+                    conns * per_conn / elapsed, 1)
+                _log(f"[bench:native] TOPK tab c64 (64 conns): "
+                     f"{out['serving_native_topk_tab_c64_qps']} qps")
+
+                # tab-pipelined context arm + the B2 arm: one connection,
+                # window 64 (B2 ships the window as one frame each way and
+                # hands the microbatcher all 64 queries atomically)
+                for mode, key in (("tab", "tabpipe"), ("b2", "b2")):
+                    with QueryClient("127.0.0.1", srv.port, timeout_s=600,
+                                     proto=mode) as c:
+                        c.ping()
+                        c.pipeline(reqs[:64], window=64)  # warm
+                        t0 = time.perf_counter()
+                        replies = c.pipeline(reqs, window=64)
+                        elapsed = time.perf_counter() - t0
+                    bad = [r for r in replies if not r or r[0] not in "VN"]
+                    if bad:
+                        raise RuntimeError(f"bad topk: {bad[:3]!r}")
+                    out[f"serving_native_topk_{key}_c64_qps"] = round(
+                        len(replies) / elapsed, 1)
+                    _log(f"[bench:native] TOPK {key} c64: "
+                         f"{out[f'serving_native_topk_{key}_c64_qps']} qps")
+            finally:
+                srv.stop()
+                if handler.batcher is not None:
+                    handler.batcher.close()
+            tab = out.get("serving_native_topk_tab_c64_qps")
+            b2 = out.get("serving_native_topk_b2_c64_qps")
+            if tab and b2:
+                out["serving_native_topk_b2_speedup_c64"] = round(
+                    b2 / tab, 2)
+        except Exception:
+            _log(traceback.format_exc())
+            out["serving_native_topk_error"] = traceback.format_exc(limit=3)
+
+        # -- native fleet elastic cutover smoke ---------------------------
+        try:
+            from flink_ms_tpu.serve.elastic import (ElasticClient,
+                                                    ScaleController)
+            from flink_ms_tpu.serve.journal import Journal
+
+            os.environ["TPUMS_HEARTBEAT_S"] = "0.2"
+            os.environ["TPUMS_REPLICA_TTL_S"] = "30"
+            os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+            journal = Journal(os.path.join(tmp, "bus"), "models")
+            n_rows = 64
+            journal.append([F.format_als_row(u, "U", rng.normal(size=4))
+                            for u in range(n_rows)])
+            jkeys = [f"{u}-U" for u in range(n_rows)]
+            ctl = ScaleController(
+                "bench-nat", os.path.join(tmp, "bus"), "models",
+                port_dir=os.path.join(tmp, "ports"),
+                state_backend="rocksdb",
+                checkpoint_uri=os.path.join(tmp, "ckpt"),
+                extra_args=["--nativeServer", "true"],
+                ready_timeout_s=120,
+            )
+            try:
+                ctl.scale_to(2)
+                errors = []
+                stop = threading.Event()
+
+                def stream():
+                    c = ElasticClient(
+                        "bench-nat",
+                        retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                          max_backoff_s=0.5),
+                        timeout_s=10)
+                    with c:
+                        while not stop.is_set():
+                            for kk in jkeys:
+                                try:
+                                    if c.query_state(ALS_STATE, kk) is None:
+                                        errors.append((kk, "missing"))
+                                except Exception as e:
+                                    errors.append((kk, repr(e)))
+
+                t = threading.Thread(target=stream, daemon=True)
+                t.start()
+                time.sleep(0.5)
+                t0 = time.perf_counter()
+                ctl.scale_to(4)
+                cutover_s = time.perf_counter() - t0
+                time.sleep(0.5)
+                stop.set()
+                t.join(timeout=30)
+                out["serving_native_cutover_s"] = round(cutover_s, 2)
+                out["serving_native_cutover_errors"] = len(errors)
+                _log(f"[bench:native] elastic 2->4 native cutover "
+                     f"{cutover_s:.2f}s, {len(errors)} errors")
+            finally:
+                ctl.stop(drop_topology=True)
+        except Exception:
+            _log(traceback.format_exc())
+            out["serving_native_cutover_error"] = \
+                traceback.format_exc(limit=3)
+        return out
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        shutil.rmtree(tmp, ignore_errors=True)

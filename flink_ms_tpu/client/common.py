@@ -9,6 +9,18 @@ from ..serve.client import QueryClient
 
 
 def repl_client_from_argv(argv: Sequence[str], usage: str) -> QueryClient:
+    # --proto tab|b2|auto rides along anywhere in argv (None defers to
+    # TPUMS_PROTO, then "tab" — serve/proto.py); positional parsing below
+    # stays byte-compatible with the Java clients' arg order
+    argv = list(argv)
+    proto: Optional[str] = None
+    if "--proto" in argv:
+        i = argv.index("--proto")
+        try:
+            proto = argv[i + 1]
+        except IndexError:
+            raise ValueError("--proto needs a value (tab|b2|auto)")
+        del argv[i:i + 2]
     if len(argv) == 0:
         raise ValueError(
             "Missing required job ID argument. Usage: " + usage
@@ -26,7 +38,8 @@ def repl_client_from_argv(argv: Sequence[str], usage: str) -> QueryClient:
 
         host, port = merge_endpoint(resolve(job_id), explicit_host)
     print(f"Using JobManager {host}:{port}")
-    return QueryClient(host=host, port=port, timeout_s=5.0, job_id=job_id)
+    return QueryClient(host=host, port=port, timeout_s=5.0, job_id=job_id,
+                       proto=proto)
 
 
 def parse_factors(payload: str) -> List[float]:

@@ -26,7 +26,8 @@ import sys
 from typing import Dict, List, Optional
 
 from ..serve import registry
-from .metrics import merge_snapshots, render_prometheus, snapshot_quantile
+from .metrics import (LATENCY_BUCKETS_S, merge_snapshots, render_prometheus,
+                      snapshot_quantile)
 
 __all__ = ["scrape_endpoint", "scrape_fleet", "fleet_signals",
            "snapshot_quantile", "main"]
@@ -35,8 +36,9 @@ __all__ = ["scrape_endpoint", "scrape_fleet", "fleet_signals",
 def scrape_endpoint(host: str, port: int, timeout_s: float = 2.0
                     ) -> Optional[dict]:
     """One METRICS round-trip -> parsed snapshot dict, or None when the
-    endpoint is unreachable or doesn't speak the verb (e.g. the C++ native
-    server answers ``E``)."""
+    endpoint is unreachable or doesn't speak the verb.  Both planes speak
+    it: the C++ native server (round 8) exports per-verb series on the
+    same bucket ladder, tagged ``meta.plane = "native"``."""
     host = host or "localhost"
     if host == "0.0.0.0":
         host = "localhost"
@@ -75,13 +77,31 @@ def scrape_fleet(timeout_s: float = 2.0) -> dict:
 
     ``shard_group`` falls back to the job_id for unsharded jobs, so a
     single standalone worker still aggregates sanely.
+
+    Native-plane snapshots (``meta.plane == "native"``) are REQUIRED to
+    carry the shared latency ladder: ``merge_snapshots`` silently skips a
+    histogram whose bounds disagree, which for a native worker would mean
+    the autoscaler's p99 quietly loses a whole plane's traffic — that is a
+    build-skew bug, so it raises here instead of degrading.
     """
     replicas: List[dict] = []
     per_group: Dict[str, List[dict]] = {}
     unreachable = 0
+    expected_le = list(LATENCY_BUCKETS_S)
     for entry in registry.list_jobs():
         snap = scrape_endpoint(entry.get("host", "localhost"),
                                entry["port"], timeout_s=timeout_s)
+        if snap is not None and (
+                snap.get("meta", {}).get("plane") == "native"):
+            for h in snap.get("histograms", []):
+                if (h.get("name") == "tpums_server_latency_seconds"
+                        and list(h.get("le", [])) != expected_le):
+                    raise ValueError(
+                        f"native worker {entry.get('job_id')!r} "
+                        f"({entry.get('host')}:{entry.get('port')}) exports "
+                        "tpums_server_latency_seconds with foreign bucket "
+                        "bounds — native/Python build skew; rebuild "
+                        "libtpums.so against this obs/metrics.py")
         group = entry.get("replica_of") or entry.get("job_id", "?")
         replicas.append({
             "job_id": entry.get("job_id"),

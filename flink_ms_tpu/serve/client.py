@@ -12,6 +12,7 @@ and continue — SGD.java:221-227).
 
 from __future__ import annotations
 
+import os
 import random
 import socket
 import time
@@ -19,6 +20,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..obs import tracing as obs_tracing
+from . import proto as wire_proto
 
 
 @dataclass(frozen=True)
@@ -70,6 +72,7 @@ class QueryClient:
         timeout_s: float = 5.0,
         job_id: Optional[str] = None,
         retry: Optional[RetryPolicy] = None,
+        proto: Optional[str] = None,
     ):
         self.host = host
         self.port = port
@@ -77,14 +80,43 @@ class QueryClient:
         self.job_id = job_id  # accepted for reference-CLI parity; the local
         # lookup server serves a single job, so the id is informational
         self.retry = retry or RetryPolicy()
+        # wire framing (serve/proto.py): "tab" = the frozen v1 line protocol
+        # (default — byte-identical to the seed client), "b2" = negotiate
+        # the binary batch framing and FAIL if the server refuses, "auto" =
+        # try B2, fall back to tab against an old server (which answers the
+        # HELLO with E\tbad request).  TPUMS_PROTO sets the default.
+        mode = (proto or os.environ.get("TPUMS_PROTO") or "tab").lower()
+        if mode not in ("tab", "b2", "auto"):
+            raise ValueError(f"proto must be tab|b2|auto, got {mode!r}")
+        self.proto = mode
         self._sock: Optional[socket.socket] = None
         self._rfile = None
+        self._binary = False  # per-connection: set by the HELLO exchange
+        self._frame_reader = None
 
     def _connect(self):
         sock = socket.create_connection((self.host, self.port), self.timeout_s)
         sock.settimeout(self.timeout_s)
         self._sock = sock
         self._rfile = sock.makefile("rb")
+        self._binary = False
+        self._frame_reader = None
+        if self.proto in ("b2", "auto"):
+            sock.sendall(wire_proto.HELLO_LINE.encode("utf-8") + b"\n")
+            line = self._rfile.readline()
+            if not line:
+                raise ConnectionError(
+                    "lookup server closed the connection during HELLO")
+            reply = line.decode("utf-8").rstrip("\n")
+            if reply == wire_proto.HELLO_REPLY:
+                self._binary = True
+                self._frame_reader = wire_proto.FrameReader(self._rfile)
+            elif self.proto == "b2":
+                self.close()
+                raise RuntimeError(
+                    f"server refused B2 negotiation: {reply}")
+            # auto: the refusal consumed the HELLO; the connection stays a
+            # perfectly good tab-protocol connection
 
     def _roundtrip(self, request: str) -> str:
         """One request/reply exchange, retried per ``self.retry`` on
@@ -98,10 +130,12 @@ class QueryClient:
         like MGET stay intact), and a ``client_rpc`` span event records
         the round-trip — including retries, which is how a failover shows
         up in a request's event chain.  With no context active the wire
-        bytes are identical to the seed protocol."""
+        bytes are identical to the seed protocol.  On a B2-negotiated
+        connection the request rides a one-record binary frame instead,
+        with no tid stamping (tracing targets the tab plane; the record
+        layout has no room for extra fields)."""
         tid = obs_tracing.current_trace()
         if tid is not None:
-            request = f"{request}\t{obs_tracing.TID_FIELD}{tid}"
             t0 = time.perf_counter()
         data = request.encode("utf-8") + b"\n"
         failures = 0
@@ -109,7 +143,19 @@ class QueryClient:
             try:
                 if self._sock is None:
                     self._connect()
-                self._sock.sendall(data)
+                if self._binary:
+                    self._sock.sendall(
+                        wire_proto.encode_request_frame([request]))
+                    texts = self._frame_reader.read_frame()
+                    if len(texts) != 1:
+                        raise ConnectionError(
+                            f"reply frame carried {len(texts)} records "
+                            "for a 1-record request")
+                    return texts[0]
+                wire = data if tid is None else (
+                    f"{request}\t{obs_tracing.TID_FIELD}{tid}\n"
+                    .encode("utf-8"))
+                self._sock.sendall(wire)
                 line = self._rfile.readline()
                 if not line:
                     raise ConnectionError(
@@ -203,13 +249,43 @@ class QueryClient:
 
         No transparent reconnect here (unlike ``_roundtrip``): a broken
         pipe mid-window leaves an unknown number of requests processed,
-        so the error propagates to the caller."""
+        so the error propagates to the caller.
+
+        On a B2-negotiated connection the window becomes the frame size:
+        each batch of up to ``window`` requests ships as ONE binary frame,
+        with up to two frames in flight (double buffering — the server
+        answers frame N while frame N+1 is on the wire), and the server
+        hands the whole frame to the top-k microbatcher at once.  Tab mode
+        only APPROXIMATES that batch via a racy socket drain; the frame
+        makes it structural."""
         requests = list(requests)
         for req in requests:
             if "\n" in req:
                 raise ValueError("requests must be single lines")
         if window < 1:
             raise ValueError("window must be >= 1")
+        if self._sock is None:
+            self._connect()
+        if self._binary:
+            chunks = [requests[i:i + window]
+                      for i in range(0, len(requests), window)]
+            replies: list = []
+            inflight: list = []  # record count per unanswered frame
+            next_send = 0
+            while len(replies) < len(requests):
+                while next_send < len(chunks) and len(inflight) < 2:
+                    self._sock.sendall(
+                        wire_proto.encode_request_frame(chunks[next_send]))
+                    inflight.append(len(chunks[next_send]))
+                    next_send += 1
+                texts = self._frame_reader.read_frame()
+                expect = inflight.pop(0)
+                if len(texts) != expect:
+                    raise ConnectionError(
+                        f"reply frame carried {len(texts)} records, "
+                        f"expected {expect}")
+                replies.extend(texts)
+            return replies
         tid = obs_tracing.current_trace()
         if tid is not None:
             # one tid for the whole window: the server's per-request span
@@ -351,7 +427,9 @@ class QueryClient:
     def metrics(self) -> dict:
         """The server process's full metrics snapshot (the METRICS verb):
         counters/gauges/histograms as the ``obs.metrics`` snapshot schema.
-        The C++ native plane doesn't speak the verb (answers ``E``)."""
+        The C++ native plane speaks it too (round 8): per-verb
+        request/latency/error series on the same bucket ladder, with
+        ``meta.plane`` distinguishing ``native`` from ``python``."""
         reply = self._roundtrip("METRICS")
         if not reply.startswith("J\t"):
             raise RuntimeError(f"metrics failed: {reply}")
@@ -363,6 +441,8 @@ class QueryClient:
         return self._roundtrip("PING")
 
     def close(self) -> None:
+        self._binary = False
+        self._frame_reader = None
         if self._rfile is not None:
             try:
                 self._rfile.close()

@@ -629,6 +629,19 @@ class ServingJob:
                     self._observed_topology_gen = int(topo["gen"])
             except Exception:
                 pass
+        set_health = getattr(self.server, "set_health", None)
+        if set_health is not None:
+            # native plane: the C++ server has no callback into this job,
+            # so the HEALTH report is PUSHED on the heartbeat cadence (the
+            # ready flip triggers an immediate heartbeat, so readiness
+            # reaches the wire without waiting out an interval); the server
+            # splices in the live key count and metrics_uri itself
+            try:
+                import json as _json
+
+                set_health(_json.dumps(self.health()))
+            except Exception:
+                pass
 
     def _heartbeat_loop(self) -> None:
         from . import registry

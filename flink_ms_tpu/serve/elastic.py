@@ -631,6 +631,16 @@ class Autoscaler:
 # ---------------------------------------------------------------------------
 
 def run_elastic(params: Params) -> ScaleController:
+    # worker-arg passthrough (mirrors ha.run_supervisor): every generation's
+    # ReplicaSupervisor spawns workers with these, so a --nativeServer true
+    # deployment rescales between NATIVE fleets — the warming generation's
+    # C++ servers answer the readiness gate's HEALTH probes themselves
+    extra: List[str] = []
+    for passthrough in ("svm", "checkPointInterval", "nativeServer",
+                        "ingestMode", "snapshots", "snapshotMinBytes",
+                        "compact"):
+        if params.has(passthrough):
+            extra += [f"--{passthrough}", params.get(passthrough)]
     ctl = ScaleController(
         params.get("group", "elastic"),
         params.get_required("journalDir"), params.get_required("topic"),
@@ -638,6 +648,7 @@ def run_elastic(params: Params) -> ScaleController:
         state_backend=params.get("stateBackend", "memory"),
         host=params.get("host", "127.0.0.1"),
         replication=params.get_int("replication", 1),
+        extra_args=extra,
         checkpoint_uri=params.get("checkpointDataUri"),
     )
     record = ctl.scale_to(params.get_int("shards", 2))

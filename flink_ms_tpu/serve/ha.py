@@ -152,6 +152,7 @@ class HAShardedClient:
         refresh_s: Optional[float] = None,
         cooldown_s: Optional[float] = None,
         seq_fanout_keys: int = 8,
+        proto: Optional[str] = None,
     ):
         if num_workers < 1:
             raise ValueError("need at least one shard")
@@ -164,6 +165,11 @@ class HAShardedClient:
             lambda shard: resolve_shard_endpoints(job_group, shard)
         )
         self.timeout_s = timeout_s
+        # wire framing for every per-replica QueryClient (serve/proto.py:
+        # tab|b2|auto; None defers to TPUMS_PROTO).  "auto" is the natural
+        # fleet setting — mixed old/new replicas each negotiate what they
+        # speak, and a failover reconnect renegotiates per endpoint.
+        self.proto = proto
         # failover budget: enough attempts to visit every replica of a
         # small set twice, with fast bounded backoff — a lone kill at R=2
         # must be absorbed inside one client call
@@ -238,7 +244,8 @@ class HAShardedClient:
             # in-client reconnect to a dead replica would just double the
             # time spent discovering it's dead
             c = QueryClient(ep[0], ep[1], timeout_s=self.timeout_s,
-                            retry=RetryPolicy(attempts=1))
+                            retry=RetryPolicy(attempts=1),
+                            proto=self.proto)
             ss.clients[ep] = c
         return c
 

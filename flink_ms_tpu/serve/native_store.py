@@ -127,6 +127,13 @@ def _declare_abi(lib):
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
     ]
+    lib.tpums_server_start3.restype = ctypes.c_void_p
+    lib.tpums_server_start3.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+    ]
+    lib.tpums_server_set_health.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tpums_server_port.restype = ctypes.c_int
     lib.tpums_server_port.argtypes = [ctypes.c_void_p]
     lib.tpums_server_requests.restype = ctypes.c_uint64
@@ -391,20 +398,30 @@ class NativeModelTable:
 class NativeLookupServer:
     """C++ epoll lookup server (native/lookup_server.cpp) serving point GETs
     straight from an open NativeStore — the Netty-KvState-parity data plane
-    with no Python on the hot path.  Same line protocol as
-    ``serve.server.LookupServer``.  ``topk_suffixes=(item, user)`` (e.g.
-    ``("-I", "-U")`` for ALS planes) enables catalog-scored TOPK/TOPKV in
-    the C++ server; left None, those verbs answer E like a Python server
-    with no registered handler.
+    with no Python on the hot path.  Speaks the full verb surface of
+    ``serve.server.LookupServer`` (tab protocol plus the HELLO-negotiated
+    B2 binary frames of ``serve.proto``).  ``topk_suffixes=(item, user)``
+    (e.g. ``("-I", "-U")`` for ALS planes) enables catalog-scored
+    TOPK/TOPKV in the C++ server; left None, those verbs answer E like a
+    Python server with no registered handler.  HEALTH/METRICS are always
+    served: the C++ plane keeps per-verb request/latency/error counters on
+    the shared ``obs.metrics.LATENCY_BUCKETS_S`` ladder, so the fleet
+    scrape merges native and Python snapshots with identical bounds.
     """
 
     def __init__(self, store: NativeStore, state_name: str,
                  job_id: str = "local", host: str = "0.0.0.0", port: int = 0,
                  topk_suffixes: Optional[Tuple[str, str]] = None):
+        from ..obs import metrics as obs_metrics
+
         self._lib = store._lib
         self._store = store  # keep the store alive while the server reads it
         item_suf, user_suf = topk_suffixes or (None, None)
-        self._h = self._lib.tpums_server_start2(
+        bounds = list(obs_metrics.LATENCY_BUCKETS_S)
+        # the ladder crosses the FFI as exact doubles (never re-derived in
+        # C++), so merge_snapshots' bounds equality check holds by identity
+        bounds_arr = (ctypes.c_double * len(bounds))(*bounds)
+        self._h = self._lib.tpums_server_start3(
             store._h,
             state_name.encode("utf-8"),
             job_id.encode("utf-8"),
@@ -412,6 +429,8 @@ class NativeLookupServer:
             port,
             item_suf.encode("utf-8") if item_suf else None,
             user_suf.encode("utf-8") if user_suf else None,
+            bounds_arr,
+            len(bounds),
         )
         if not self._h:
             raise OSError(
@@ -420,6 +439,17 @@ class NativeLookupServer:
         self.state_name = state_name
         self.job_id = job_id
         self.port = int(self._lib.tpums_server_port(self._h))
+
+    def set_health(self, health_json: Optional[str]) -> None:
+        """Push the owning job's health dict (one-line JSON) into the C++
+        HEALTH verb; the server splices in the live key count and
+        metrics_uri.  ``None`` reverts to the synthesized always-ready
+        report."""
+        if self._h:
+            self._lib.tpums_server_set_health(
+                self._h,
+                health_json.encode("utf-8") if health_json else None,
+            )
 
     @property
     def requests(self) -> int:

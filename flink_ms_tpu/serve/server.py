@@ -55,9 +55,17 @@ seed protocol's exact field counts), records a ``server_reply`` span
 event (verb, latency, and — for microbatched top-k — queue wait, batch
 size, device seconds) and echoes ``tid=<id>`` back on the reply line.
 Untraced traffic is byte-identical to the seed protocol in both
-directions; the C++ native plane answers ``E`` to traced requests and
-METRICS (documented, not parity-tested — tracing targets the Python
-plane).
+directions; the C++ native plane answers ``E`` to traced requests
+(documented, not parity-tested — tracing targets the Python plane).
+
+Wire protocol v2 (``serve/proto.py``): a client may send the text line
+``HELLO\\tB2`` to switch the connection to length-prefixed binary batch
+frames — one frame of packed verb records in, one frame of reply records
+out, records answered in order and a whole frame submitted to the top-k
+microbatcher before any reply is resolved.  Old clients never send HELLO
+and stay byte-identical on the wire (pinned by
+``tests/test_native_protocol.py``); the C++ native plane speaks the same
+negotiation and framing.
 
 The batched verb exists to beat the reference's serving hot spot: its online
 SGD pays two Netty round trips per rating (SGD.java:172-173) and its MSE job
@@ -96,6 +104,7 @@ from typing import Dict, Optional
 from ..core.formats import RangePayloadCache, gather_sorted, sort_dedup_last
 from ..obs import metrics as obs_metrics
 from ..obs import tracing as obs_tracing
+from . import proto
 from .table import ModelTable
 
 
@@ -218,13 +227,21 @@ class LookupServer:
                                 break
                             buf += chunk
                         lines = []
+                        hello = False
                         while True:
                             nl = buf.find(b"\n")
                             if nl < 0:
                                 break
-                            lines.append(buf[:nl].decode("utf-8"))
+                            raw = bytes(buf[:nl])
                             del buf[:nl + 1]
-                        if eof and buf:
+                            lines.append(raw.decode("utf-8"))
+                            if raw == proto.HELLO_LINE.encode("utf-8"):
+                                # protocol switch: whatever follows the
+                                # HELLO line is already B2 frames — stop
+                                # line-splitting and leave it buffered
+                                hello = True
+                                break
+                        if eof and buf and not hello:
                             # trailing request without a newline is still
                             # answered (readline()-at-EOF parity, pinned by
                             # the native plane's protocol tests)
@@ -258,6 +275,9 @@ class LookupServer:
                         try:
                             self.wfile.write(out)
                         except (BrokenPipeError, OSError):
+                            return
+                        if hello:
+                            outer._serve_binary(sock, self.wfile, buf, eof)
                             return
                         if eof:
                             return
@@ -367,18 +387,26 @@ class LookupServer:
         ``burst`` is the number of lines in the read burst this line
         belongs to — burst members must enqueue rather than take the
         batcher's idle inline path, or the burst serializes back into
-        singles.
+        singles."""
+        return self._dispatch_parts(line.split("\t"), burst)
+
+    def _dispatch_parts(self, parts, burst: int = 1, traced: bool = True):
+        """Dispatch over already-split fields — the shared core of the tab
+        line loop and the B2 frame loop (binary records arrive pre-split,
+        and their fields may legally contain tabs, so they must never take
+        a join-then-resplit detour).
 
         Also the observability choke point: pops an optional trailing
         ``tid=`` trace field FIRST (so every verb handler below sees the
         seed protocol's exact field counts — untraced traffic is
-        byte-identical in both directions), times the dispatch, feeds the
-        per-verb counter/latency instruments, and echoes the tid on the
-        reply.  Deferred top-k replies do all of that at resolve time via
-        the post hook, when their true latency is known."""
+        byte-identical in both directions; binary mode passes
+        ``traced=False``, tracing targets the tab plane), times the
+        dispatch, feeds the per-verb counter/latency instruments, and
+        echoes the tid on the reply.  Deferred top-k replies do all of
+        that at resolve time via the post hook, when their true latency
+        is known."""
         self.requests += 1
-        parts = line.split("\t")
-        tid = obs_tracing.pop_tid(parts)
+        tid = obs_tracing.pop_tid(parts) if traced else None
         verb = parts[0] if parts and parts[0] else "?"
         t0 = time.perf_counter()
         if verb == "METRICS" and len(parts) == 1:
@@ -389,6 +417,58 @@ class LookupServer:
                 verb, tid, t0, rendered, resolver)
             return reply
         return self._finish(verb, tid, t0, reply)
+
+    def _serve_binary(self, sock, wfile, buf: bytearray, eof: bool) -> None:
+        """B2 frame loop, entered after an accepted HELLO (``serve.proto``).
+
+        One request frame in -> one reply frame out, records answered in
+        order; a whole frame is submitted to the microbatcher before any
+        reply is resolved, so a client batch coalesces into one device
+        dispatch exactly like a tab-mode pipelined burst.  Structural
+        corruption answers a single-record ``E\\tbad frame: <reason>``
+        frame and closes; a partial frame at EOF is dropped silently (the
+        tab plane's unterminated-line parity does not apply — a frame is
+        atomic or absent)."""
+        while True:
+            try:
+                res = proto.decode_request_frame(buf)
+            except proto.ProtoError as e:
+                try:
+                    wfile.write(proto.error_frame(str(e)))
+                except (BrokenPipeError, OSError):
+                    pass
+                return
+            if res is None:
+                if eof:
+                    return
+                try:
+                    chunk = sock.recv(65536)
+                except (ConnectionResetError, OSError):
+                    return
+                if not chunk:
+                    eof = True
+                    continue
+                buf += chunk
+                continue
+            records, consumed = res
+            del buf[:consumed]
+            if len(records) > 1:
+                self._obs_burst.observe(len(records))
+            replies = [
+                self._dispatch_parts(parts, burst=len(records),
+                                     traced=False)
+                for parts in records
+            ]
+            if len(records) > 1:
+                self._flush_batchers()
+            texts = [
+                r.resolve() if isinstance(r, _DeferredReply) else r
+                for r in replies
+            ]
+            try:
+                wfile.write(proto.encode_reply_frame(texts))
+            except (BrokenPipeError, OSError):
+                return
 
     def _verb_obs(self, verb: str) -> tuple:
         inst = self._obs_verbs.get(verb)
@@ -441,7 +521,8 @@ class LookupServer:
         try:
             snap = obs_metrics.synthesize_requests(
                 obs_metrics.get_registry().snapshot(
-                    meta={"job_id": self.job_id, "port": self.port}))
+                    meta={"job_id": self.job_id, "port": self.port,
+                          "plane": "python"}))
             return "J\t" + obs_metrics.snapshot_to_json_line(snap)
         except Exception as e:
             return f"E\tmetrics failed: {e}"
@@ -450,6 +531,13 @@ class LookupServer:
         """Verb dispatch over already-split fields (tid removed)."""
         if parts[0] == "PING":
             return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
+        if parts[0] == proto.HELLO_VERB and len(parts) == 2:
+            # protocol negotiation: the handler loop flips the connection
+            # to B2 on the exact accept line (an old server answers
+            # E\tbad request here, which clients read as "tab only")
+            if parts[1] == "B2":
+                return proto.HELLO_REPLY
+            return f"E\tunsupported proto: {parts[1]}"
         if parts[0] == "COUNT" and len(parts) == 2:
             # key count of a state — the ops/metrics surface (Flink exposes
             # state sizes the same way) and the ingest barrier multi-process

@@ -100,6 +100,7 @@ class ShardedQueryClient:
         timeout_s: float = 5.0,
         job_id: Optional[str] = None,
         seq_fanout_keys: int = 8,
+        proto: Optional[str] = None,
     ):
         if not endpoints:
             raise ValueError("need at least one endpoint")
@@ -108,8 +109,11 @@ class ShardedQueryClient:
         self.seq_fanout_keys = seq_fanout_keys
         from concurrent.futures import ThreadPoolExecutor
 
+        # proto (serve/proto.py: tab|b2|auto; None defers to TPUMS_PROTO)
+        # applies to every per-worker connection uniformly
         self._clients = [
-            QueryClient(host, port, timeout_s=timeout_s, job_id=job_id)
+            QueryClient(host, port, timeout_s=timeout_s, job_id=job_id,
+                        proto=proto)
             for host, port in endpoints
         ]
         # persistent pool: spinning an executor up per query costs more

@@ -41,6 +41,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <locale.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string.h>
@@ -51,12 +52,15 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <cmath>
 #include <memory>
 #include <mutex>
-#include <charconv>
 #include <condition_variable>
+#include <cstdio>
 #include <deque>
 #include <cstdlib>
+#include <map>
 #include <numeric>
 #include <set>
 #include <string>
@@ -93,14 +97,47 @@ struct PendingReply {
 // without reading responses is a slow reader by another name.
 constexpr size_t kMaxPendingReplies = 4096;
 
+// --------------------------------------------------------------------------
+// B2 binary batch framing (serve/proto.py is the spec; this decoder is
+// byte-parity-tested against it).  A connection starts in tab mode and
+// switches after a successful "HELLO\tB2" line; frames then flow both ways:
+//   b"B2" varint(body_len) body;  body = varint(count) records...
+// Request record: opcode byte + per-verb fields, each varint(len)+utf8.
+// Reply record: varint(len) + the tab reply line without its newline.
+constexpr size_t kMaxFrameBody = 8u << 20;  // matches proto.MAX_REQUEST_BODY
+constexpr int kMaxVarintBytes = 10;
+
+// Opcode table — must stay in lockstep with proto.OPCODES/FIELD_COUNTS.
+struct VerbSpec {
+  const char* verb;
+  int fields;
+};
+const VerbSpec kVerbByOp[] = {
+    {nullptr, 0},   {"GET", 2},   {"MGET", 2},  {"TOPK", 3},  {"TOPKV", 3},
+    {"DOT", 3},     {"COUNT", 1}, {"HEALTH", 1}, {"METRICS", 0}, {"PING", 0},
+};
+constexpr int kMaxOpcode = 9;
+
+// One in-order output unit: either a single tab reply line (count == 1) or
+// a whole B2 reply frame spanning `count` pending slots.  A frame is only
+// serialized once ALL its slots are ready — the frame header carries the
+// total length, so it cannot stream record by record.
+struct OutUnit {
+  bool frame = false;
+  uint32_t count = 1;
+};
+
 struct Conn {
   int fd = -1;
   std::string in;   // bytes read, not yet parsed into complete lines
   std::string out;  // response bytes not yet written
   std::deque<std::shared_ptr<PendingReply>> pending;  // in-order reply slots
+  std::deque<OutUnit> units;  // groups pending slots into lines/frames
   size_t pending_req_bytes = 0;  // queued TOPK request payload bytes
   bool writable_armed = false;
   bool eof = false;  // client half-closed: answer what's buffered, then close
+  bool binary = false;  // negotiated B2: c->in holds frames, not lines
+  bool fatal = false;   // corrupt frame: error frame queued, close after flush
 };
 
 // Cached catalog index for TOPK/TOPKV: an immutable row-major (n, width)
@@ -138,6 +175,19 @@ struct DotIndex {
 struct TopkTask {
   std::shared_ptr<PendingReply> reply;
   std::string verb, state, query_arg, k_s;
+  double t0 = 0.0;  // submit time: worker observes latency incl. queue wait
+};
+
+// Per-verb serving stats on the shared log-bucket ladder (obs/metrics.py
+// LATENCY_BUCKETS_S, passed in through tpums_server_start3 so the bounds
+// are equal by construction, never re-derived in float math here).  The
+// METRICS verb renders these as the same one-line JSON snapshot the Python
+// registry emits, so obs/scrape.py merges native and Python workers alike.
+struct VerbStat {
+  std::vector<uint64_t> counts;  // len(bounds) + 1 (+Inf slot)
+  double sum = 0.0;
+  uint64_t count = 0;
+  uint64_t errors = 0;
 };
 
 struct ServerState {
@@ -168,15 +218,186 @@ struct ServerState {
   int epoll_fd = -1;
   int wake_fd = -1;  // eventfd: poked by tpums_server_stop
   int port = 0;
+  std::string host_str;  // bind host, echoed in HEALTH's metrics_uri
   std::atomic<bool> stop{false};
   std::atomic<uint64_t> requests{0};
   std::thread loop;
   std::unordered_map<int, Conn> conns;
+  // METRICS/HEALTH surface (empty lat_bounds = start2 compat: METRICS
+  // answers E\tbad request exactly like the pre-round-8 server)
+  std::vector<double> lat_bounds;
+  std::mutex metrics_mu;  // guards verb_stats (epoll + worker threads)
+  std::map<std::string, VerbStat> verb_stats;  // ordered => stable JSON
+  std::mutex health_mu;
+  std::string health_json;  // last report pushed via tpums_server_set_health
 };
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void observe_verb(ServerState* s, const std::string& verb, double dt,
+                  bool is_err) {
+  if (s->lat_bounds.empty()) return;
+  std::lock_guard<std::mutex> g(s->metrics_mu);
+  VerbStat& st = s->verb_stats[verb.empty() ? "?" : verb];
+  if (st.counts.empty()) st.counts.assign(s->lat_bounds.size() + 1, 0);
+  // bucket index: first bound >= dt (std::lower_bound == bisect_left —
+  // the Python Histogram.observe rule, so cross-plane merges line up)
+  size_t i = std::lower_bound(s->lat_bounds.begin(), s->lat_bounds.end(),
+                              dt) -
+             s->lat_bounds.begin();
+  st.counts[i] += 1;
+  st.sum += dt;
+  st.count += 1;
+  if (is_err) st.errors += 1;
+}
 
 bool set_nonblocking(int fd) {
   int flags = fcntl(fd, F_GETFL, 0);
   return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+// Unsigned LEB128, appended in place (frame headers and reply records).
+void append_varint(std::string& out, uint64_t v) {
+  while (true) {
+    uint8_t b = v & 0x7F;
+    v >>= 7;
+    if (v) {
+      out.push_back(static_cast<char>(b | 0x80));
+    } else {
+      out.push_back(static_cast<char>(b));
+      return;
+    }
+  }
+}
+
+// 0 = ok (value/pos updated), 1 = need more bytes, 2 = malformed (>10 bytes).
+int parse_varint(const char* data, size_t size, size_t* pos, uint64_t* out) {
+  uint64_t value = 0;
+  int shift = 0;
+  for (int i = 0; i < kMaxVarintBytes; ++i) {
+    if (*pos + i >= size) return 1;
+    uint8_t b = static_cast<uint8_t>(data[*pos + i]);
+    value |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *pos += i + 1;
+      *out = value;
+      return 0;
+    }
+    shift += 7;
+  }
+  return 2;
+}
+
+// Strict UTF-8 validation with Python codec semantics (rejects overlongs,
+// surrogates, > U+10FFFF): binary record fields must decode on the Python
+// plane too, so a field Python would refuse is a malformed frame here.
+bool utf8_valid(const char* p, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    unsigned char c = static_cast<unsigned char>(p[i]);
+    if (c < 0x80) {
+      ++i;
+      continue;
+    }
+    int len;
+    uint32_t cp, min_cp;
+    if ((c & 0xE0) == 0xC0) {
+      len = 2; cp = c & 0x1F; min_cp = 0x80;
+    } else if ((c & 0xF0) == 0xE0) {
+      len = 3; cp = c & 0x0F; min_cp = 0x800;
+    } else if ((c & 0xF8) == 0xF0) {
+      len = 4; cp = c & 0x07; min_cp = 0x10000;
+    } else {
+      return false;
+    }
+    if (i + static_cast<size_t>(len) > n) return false;
+    for (int j = 1; j < len; ++j) {
+      unsigned char cc = static_cast<unsigned char>(p[i + j]);
+      if ((cc & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    if (cp < min_cp || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
+      return false;
+    i += len;
+  }
+  return true;
+}
+
+// json.dumps(..., ensure_ascii=True) string escaping: ASCII passes, the two
+// JSON metas escape, controls and non-ASCII become \uXXXX (surrogate pairs
+// past the BMP).  Invalid UTF-8 degrades to U+FFFD rather than emitting
+// bytes that would break the one-line-JSON contract.
+void escape_json_into(std::string& out, const std::string& in) {
+  size_t i = 0, n = in.size();
+  char tmp[16];
+  while (i < n) {
+    unsigned char c = static_cast<unsigned char>(in[i]);
+    if (c == '"') {
+      out += "\\\"";
+      ++i;
+    } else if (c == '\\') {
+      out += "\\\\";
+      ++i;
+    } else if (c < 0x20) {
+      switch (c) {
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        default:
+          snprintf(tmp, sizeof(tmp), "\\u%04x", c);
+          out += tmp;
+      }
+      ++i;
+    } else if (c < 0x80) {
+      out.push_back(static_cast<char>(c));
+      ++i;
+    } else {
+      int len;
+      uint32_t cp;
+      if ((c & 0xE0) == 0xC0) {
+        len = 2; cp = c & 0x1F;
+      } else if ((c & 0xF0) == 0xE0) {
+        len = 3; cp = c & 0x0F;
+      } else if ((c & 0xF8) == 0xF0) {
+        len = 4; cp = c & 0x07;
+      } else {
+        len = 0; cp = 0;
+      }
+      bool ok = len > 0 && i + static_cast<size_t>(len) <= n;
+      for (int j = 1; ok && j < len; ++j) {
+        unsigned char cc = static_cast<unsigned char>(in[i + j]);
+        if ((cc & 0xC0) != 0x80) ok = false;
+        cp = (cp << 6) | (cc & 0x3F);
+      }
+      if (!ok) {
+        out += "\\ufffd";
+        ++i;
+        continue;
+      }
+      if (cp >= 0x10000) {
+        uint32_t v = cp - 0x10000;
+        snprintf(tmp, sizeof(tmp), "\\u%04x\\u%04x",
+                 0xD800 + (v >> 10), 0xDC00 + (v & 0x3FF));
+      } else {
+        snprintf(tmp, sizeof(tmp), "\\u%04x", cp);
+      }
+      out += tmp;
+      i += len;
+    }
+  }
+}
+
+std::string escape_json(const std::string& in) {
+  std::string out;
+  out.reserve(in.size() + 2);
+  escape_json_into(out, in);
+  return out;
 }
 
 // Split `line` on '\t' into at most `max_parts` pieces (last piece keeps any
@@ -200,23 +421,76 @@ bool ends_with(const std::string& str, const std::string& suf) {
          str.compare(str.size() - suf.size(), suf.size(), suf) == 0;
 }
 
+// "C"-locale handle for float parse/format: the embedding process may set
+// LC_NUMERIC, which would flip printf/strtod's decimal separator and break
+// the wire format; uselocale() scopes the classic locale to this thread
+// for the duration of one call.
+locale_t c_locale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  return loc;
+}
+
 // Shortest round-trip float formatting matching Python's repr(float(f32)):
-// the f32 score widens to double exactly, std::to_chars emits the unique
-// shortest decimal, and an integral result gains Python's trailing ".0".
-// Python picks scientific notation only when |x| >= 1e16 or 0 < |x| < 1e-4;
-// bare to_chars picks whichever is SHORTER (100000.0 -> "1e+05"), so the
-// notation is forced explicitly to keep replies byte-identical.
+// the f32 score widens to double exactly; the shortest decimal is found by
+// trying %.*e at increasing precision until strtod round-trips (the
+// standard pre-<charconv> idiom — this toolchain's libstdc++ lacks
+// floating-point to_chars), then the digits are laid out with Python's
+// notation rule: scientific only when |x| >= 1e16 or 0 < |x| < 1e-4, an
+// integral fixed result gains the trailing ".0", exponents keep printf's
+// sign and >= 2 digits ("1e-06"), exactly like float.__repr__.
 std::string format_score_d(double d) {
-  if (d != d) return "nan";  // Python repr never signs NaN; to_chars
-  // would emit "-nan" for the sign-bit-set QNaN that 0*inf produces
-  char buf[48];
-  double a = d < 0 ? -d : d;
-  bool scientific = d != 0.0 && (a >= 1e16 || a < 1e-4);
-  auto res = std::to_chars(buf, buf + sizeof(buf), d,
-                           scientific ? std::chars_format::scientific
-                                      : std::chars_format::fixed);
-  std::string out(buf, res.ptr);
-  if (out.find_first_of(".enai") == std::string::npos) out += ".0";
+  if (d != d) return "nan";  // Python repr never signs NaN ("-nan" would
+  // leak for the sign-bit-set QNaN that 0*inf produces)
+  if (d == HUGE_VAL) return "inf";
+  if (d == -HUGE_VAL) return "-inf";
+  if (d == 0.0) return std::signbit(d) ? "-0.0" : "0.0";
+  char buf[64];
+  locale_t old = uselocale(c_locale());
+  int p = 0;
+  for (; p < 17; ++p) {  // p=16 (17 significant digits) always round-trips
+    snprintf(buf, sizeof(buf), "%.*e", p, d);
+    if (strtod(buf, nullptr) == d) break;
+  }
+  uselocale(old);
+  // buf is "[-]d[.ddd]e±XX": minimal-precision digits can't end in '0'
+  // (the shorter string denotes the same decimal and would have won)
+  std::string sci(buf);
+  bool neg = sci[0] == '-';
+  size_t ms = neg ? 1 : 0;
+  size_t epos = sci.find('e');
+  std::string digits;
+  digits += sci[ms];
+  if (sci[ms + 1] == '.') digits += sci.substr(ms + 2, epos - ms - 2);
+  int exp10 = atoi(sci.c_str() + epos + 1);
+  double a = neg ? -d : d;
+  std::string out = neg ? "-" : "";
+  if (a >= 1e16 || a < 1e-4) {  // Python's scientific-notation rule
+    out += digits.substr(0, 1);
+    if (digits.size() > 1) {
+      out += ".";
+      out += digits.substr(1);
+    }
+    out += "e";
+    out += (exp10 < 0) ? "-" : "+";
+    int ae = exp10 < 0 ? -exp10 : exp10;
+    snprintf(buf, sizeof(buf), "%02d", ae);
+    out += buf;
+  } else {
+    int len = static_cast<int>(digits.size());
+    if (exp10 >= len - 1) {  // integral: pad zeros, add ".0"
+      out += digits;
+      out.append(static_cast<size_t>(exp10 - (len - 1)), '0');
+      out += ".0";
+    } else if (exp10 >= 0) {  // decimal point inside the digit run
+      out += digits.substr(0, exp10 + 1);
+      out += ".";
+      out += digits.substr(exp10 + 1);
+    } else {  // leading "0.000..." zeros
+      out += "0.";
+      out.append(static_cast<size_t>(-exp10 - 1), '0');
+      out += digits;
+    }
+  }
   return out;
 }
 
@@ -226,10 +500,11 @@ std::string format_score(float f) {
 }
 
 // Parse one float token with Python float() semantics: outer ASCII
-// whitespace stripped, one optional sign, then a locale-INDEPENDENT
-// general/inf/nan parse via std::from_chars (strtod would read a non-C
-// LC_NUMERIC set by the embedding process and silently reject '.'
-// decimals; from_chars also rejects hex floats, matching Python).
+// whitespace stripped, one optional sign, then a general/inf/nan parse via
+// strtod under the scoped "C" locale (a non-C LC_NUMERIC set by the
+// embedding process would otherwise silently reject '.' decimals).  Hex
+// floats and strtod's "nan(char-seq)" payload form are rejected explicitly,
+// matching Python.
 bool parse_float_token(const char* b, const char* e, double* out) {
   while (b < e && (*b == ' ' || *b == '\t' || *b == '\r' || *b == '\n'))
     ++b;
@@ -238,14 +513,23 @@ bool parse_float_token(const char* b, const char* e, double* out) {
     --e;
   if (b >= e) return false;
   bool neg = false;
-  if (*b == '+' || *b == '-') {  // from_chars accepts '-' but not '+'
+  if (*b == '+' || *b == '-') {
     neg = (*b == '-');
     ++b;
     if (b < e && (*b == '+' || *b == '-')) return false;  // "+-1"
   }
-  double v = 0.0;
-  auto res = std::from_chars(b, e, v);
-  if (res.ec != std::errc() || res.ptr != e) return false;
+  if (b >= e) return false;
+  if (e - b >= 2 && b[0] == '0' && (b[1] == 'x' || b[1] == 'X')) return false;
+  for (const char* p = b; p < e; ++p) {
+    if (*p == '(') return false;  // strtod "nan(...)" that Python refuses
+    if (*p == '\0') return false;  // NUL would truncate the C-string parse
+  }
+  std::string tok(b, e);
+  locale_t old = uselocale(c_locale());
+  char* endp = nullptr;
+  double v = strtod(tok.c_str(), &endp);
+  uselocale(old);
+  if (endp != tok.c_str() + tok.size()) return false;
   *out = neg ? -v : v;
   return true;
 }
@@ -595,12 +879,121 @@ std::string handle_topk(ServerState* s, const std::string& verb,
   return topk_payload(s, payload, k);
 }
 
+// METRICS verb: the per-verb stats as the exact one-line JSON snapshot
+// schema obs/metrics.py emits (snapshot + synthesize_requests +
+// snapshot_to_json_line — compact separators, meta last), so scrape_fleet
+// merges native and Python workers through the same merge_snapshots path.
+// The requests counter series is synthesized from the histogram count, the
+// errors counter is materialized per verb (value 0 included, matching the
+// Python plane's lazily-created-but-always-exported counter).
+std::string metrics_reply(ServerState* s) {
+  std::map<std::string, VerbStat> stats;
+  {
+    std::lock_guard<std::mutex> g(s->metrics_mu);
+    stats = s->verb_stats;
+  }
+  double ts = std::chrono::duration<double>(
+                  std::chrono::system_clock::now().time_since_epoch())
+                  .count();
+  std::string j = "J\t{\"ts\":";
+  j += format_score_d(ts);
+  j += ",\"enabled\":true,\"counters\":[";
+  bool first = true;
+  for (const auto& kv : stats) {
+    if (!first) j.push_back(',');
+    first = false;
+    j += "{\"name\":\"tpums_server_errors_total\",\"labels\":{\"verb\":\"";
+    escape_json_into(j, kv.first);
+    j += "\"},\"value\":" + std::to_string(kv.second.errors) + "}";
+  }
+  for (const auto& kv : stats) {
+    if (!first) j.push_back(',');
+    first = false;
+    j += "{\"name\":\"tpums_server_requests_total\",\"labels\":{\"verb\":\"";
+    escape_json_into(j, kv.first);
+    j += "\"},\"value\":" + std::to_string(kv.second.count) + "}";
+  }
+  j += "],\"gauges\":[],\"histograms\":[";
+  std::string le;
+  for (double b : s->lat_bounds) {
+    if (!le.empty()) le.push_back(',');
+    le += format_score_d(b);
+  }
+  first = true;
+  for (const auto& kv : stats) {
+    if (!first) j.push_back(',');
+    first = false;
+    j += "{\"name\":\"tpums_server_latency_seconds\",\"labels\":{\"verb\":\"";
+    escape_json_into(j, kv.first);
+    j += "\"},\"le\":[" + le + "],\"counts\":[";
+    for (size_t i = 0; i < kv.second.counts.size(); ++i) {
+      if (i) j.push_back(',');
+      j += std::to_string(kv.second.counts[i]);
+    }
+    j += "],\"sum\":" + format_score_d(kv.second.sum);
+    j += ",\"count\":" + std::to_string(kv.second.count) + "}";
+  }
+  j += "],\"meta\":{\"job_id\":\"";
+  escape_json_into(j, s->job_id);
+  j += "\",\"port\":" + std::to_string(s->port) +
+       ",\"plane\":\"native\"}}\n";
+  return j;
+}
+
+// HEALTH verb: the owning job pushes its liveness report (ServingJob.health
+// as a JSON object) through tpums_server_set_health on every heartbeat;
+// the reply splices in the two server-owned fields — live key count and
+// the metrics_uri — exactly where the Python plane appends them.  With no
+// pushed report (bare server, tests) the reply is byte-identical to a bare
+// Python LookupServer's always-ready report.
+std::string health_reply(ServerState* s) {
+  std::string pushed;
+  {
+    std::lock_guard<std::mutex> g(s->health_mu);
+    pushed = s->health_json;
+  }
+  std::string keys = std::to_string(tpums_count(s->store));
+  std::string uri =
+      "tpums://" + s->host_str + ":" + std::to_string(s->port) + "/METRICS";
+  if (pushed.size() >= 2 && pushed.front() == '{' && pushed.back() == '}') {
+    std::string inner = pushed.substr(1, pushed.size() - 2);
+    std::string body = "{" + inner + (inner.empty() ? "" : ", ") +
+                       "\"keys\": " + keys + ", \"metrics_uri\": \"" +
+                       escape_json(uri) + "\"}";
+    return "H\t" + body + "\n";
+  }
+  return "H\t{\"state\": \"" + escape_json(s->state_name) +
+         "\", \"ready\": true, \"status\": \"ready\", \"backlog_bytes\": 0, "
+         "\"keys\": " + keys + ", \"job_id\": \"" + escape_json(s->job_id) +
+         "\", \"topology_group\": null, \"generation\": null, "
+         "\"topology_gen\": null, \"metrics_uri\": \"" + escape_json(uri) +
+         "\"}\n";
+}
+
 // Answer a non-TOPK request from its pre-split parts (submit_line owns the
 // single split_tabs pass — the point-lookup hot path is parsed once).
 std::string handle_line(ServerState* s, const std::string* parts, int n) {
   s->requests.fetch_add(1, std::memory_order_relaxed);
   if (parts[0] == "PING") {  // Python matches on parts[0] alone
     return "PONG\t" + s->job_id + "\t" + s->state_name + "\n";
+  }
+  if (parts[0] == "HELLO" && n == 2) {
+    // protocol negotiation (serve/proto.py HELLO_LINE): the caller flips
+    // the connection to binary iff this answers the accept line
+    if (parts[1] == "B2") return "HELLO\tB2\n";
+    return "E\tunsupported proto: " + parts[1] + "\n";
+  }
+  if (parts[0] == "HEALTH" && n == 2) {
+    if (parts[1] != s->state_name) {
+      return "E\tunknown state: " + parts[1] + "\n";
+    }
+    return health_reply(s);
+  }
+  if (parts[0] == "METRICS" && n == 1) {
+    // start2-compat servers (no bucket ladder) keep the historical
+    // E\tbad request so their byte-parity pins hold
+    if (s->lat_bounds.empty()) return "E\tbad request\n";
+    return metrics_reply(s);
   }
   if (parts[0] == "COUNT" && n == 2) {
     if (parts[1] != s->state_name) {
@@ -883,6 +1276,12 @@ void topk_worker_loop(ServerState* s) {
               ? handle_dot(s, task.state, task.k_s, task.query_arg)
               : handle_topk(s, task.verb, task.state, task.query_arg,
                             task.k_s);
+      // latency includes queue wait (t0 is submit time), mirroring the
+      // Python plane's deferred-reply observation at resolve time; an
+      // orphaned task is never observed — its Python twin (handler thread
+      // gone mid-request) never reaches _finish either
+      observe_verb(s, task.verb, now_s() - task.t0,
+                   !task.reply->text.empty() && task.reply->text[0] == 'E');
     }
     task.reply->ready.store(true, std::memory_order_release);
     ssize_t wr = write(s->wake_fd, &one, 8);
@@ -890,27 +1289,53 @@ void topk_worker_loop(ServerState* s) {
   }
 }
 
-// Move every completed reply at the FRONT of the pending queue into the
+// Move every completed output unit at the FRONT of the queue into the
 // connection's out buffer (strict FIFO: an unfinished TOPK blocks only
-// replies behind it on ITS connection).
+// replies behind it on ITS connection).  A tab-mode unit is one reply
+// line; a B2 unit is a whole reply frame and emits only when every record
+// in it is ready, because the frame header carries the total length.
 void drain_ready_replies(Conn* c) {
-  while (!c->pending.empty() &&
-         c->pending.front()->ready.load(std::memory_order_acquire)) {
-    c->out += c->pending.front()->text;
-    c->pending_req_bytes -= c->pending.front()->req_bytes;
-    c->pending.pop_front();
+  while (!c->units.empty()) {
+    const OutUnit& u = c->units.front();
+    if (c->pending.size() < u.count) break;  // defensive: never expected
+    bool all_ready = true;
+    for (uint32_t i = 0; i < u.count && all_ready; ++i) {
+      all_ready = c->pending[i]->ready.load(std::memory_order_acquire);
+    }
+    if (!all_ready) break;
+    if (!u.frame) {
+      c->out += c->pending.front()->text;
+    } else {
+      std::string body;
+      append_varint(body, u.count);
+      for (uint32_t i = 0; i < u.count; ++i) {
+        const std::string& t = c->pending[i]->text;
+        size_t len = t.size();
+        if (len && t[len - 1] == '\n') --len;  // reply record = line sans \n
+        append_varint(body, len);
+        body.append(t.data(), len);
+      }
+      c->out += "B2";
+      append_varint(c->out, body.size());
+      c->out += body;
+    }
+    for (uint32_t i = 0; i < u.count; ++i) {
+      c->pending_req_bytes -= c->pending.front()->req_bytes;
+      c->pending.pop_front();
+    }
+    c->units.pop_front();
   }
 }
 
-// Answer one request line: TOPK verbs are enqueued for the worker thread
-// (reply slot keeps pipelined order); everything else answers inline.
-// Returns false when the connection must close (pending-flood protection).
-bool submit_line(ServerState* s, Conn* c, const std::string& line) {
-  // 5 slots: one more than the widest verb, so an over-long request is
-  // distinguishable from an exact TOPK (Python splits unbounded; parity
-  // demands "TOPK\ta\tb\tc\td" be a bad request, not a TOPK)
-  std::string parts[5];
-  int n = split_tabs(line, parts, 5);
+// Route one request's pre-split parts: TOPK verbs are enqueued for the
+// worker thread (reply slot keeps pipelined order); everything else
+// answers inline.  `src_bytes` is the wire size of the request (line or
+// binary record) for the pending-byte cap; `always_slot` (binary records)
+// forces even inline replies through the pending queue so the enclosing
+// frame unit can group them.  Returns false when the connection must
+// close (pending-flood protection).
+bool route_parts(ServerState* s, Conn* c, std::string* parts, int n,
+                 size_t src_bytes, bool always_slot) {
   if ((parts[0] == "TOPK" || parts[0] == "TOPKV" || parts[0] == "DOT") &&
       n == 4) {
     s->requests.fetch_add(1, std::memory_order_relaxed);
@@ -918,18 +1343,19 @@ bool submit_line(ServerState* s, Conn* c, const std::string& line) {
     // a flood of max-size TOPKV lines must trip the same slow-reader
     // policy as buffered responses, not grow the heap unboundedly
     if (c->pending.size() >= kMaxPendingReplies ||
-        c->pending_req_bytes + line.size() > kMaxOutBuffer) {
+        c->pending_req_bytes + src_bytes > kMaxOutBuffer) {
       return false;
     }
     auto reply = std::make_shared<PendingReply>();
-    reply->req_bytes = line.size();
-    c->pending_req_bytes += line.size();
+    reply->req_bytes = src_bytes;
+    c->pending_req_bytes += src_bytes;
     c->pending.push_back(reply);
+    if (!always_slot) c->units.push_back(OutUnit{false, 1});
     // TOPK operands: state, id, k; TOPKV operands: state, k, payload;
     // DOT operands: state, range, payload (range rides the k_s slot)
     TopkTask task{std::move(reply), parts[0], parts[1],
                   parts[0] == "TOPK" ? parts[2] : parts[3],
-                  parts[0] == "TOPK" ? parts[3] : parts[2]};
+                  parts[0] == "TOPK" ? parts[3] : parts[2], now_s()};
     {
       std::lock_guard<std::mutex> lk(s->task_mu);
       s->tasks.push_back(std::move(task));
@@ -937,15 +1363,23 @@ bool submit_line(ServerState* s, Conn* c, const std::string& line) {
     s->task_cv.notify_one();
     return true;
   }
+  double t0 = now_s();
   std::string text = handle_line(s, parts, n);
-  if (c->pending.empty()) {
+  observe_verb(s, parts[0], now_s() - t0,
+               !text.empty() && text[0] == 'E');
+  if (parts[0] == "HELLO" && !c->binary && text[0] == 'H') {
+    // negotiation accepted: every byte after this line is a B2 frame and
+    // every reply after this line's is a B2 frame
+    c->binary = true;
+  }
+  if (!always_slot && c->pending.empty()) {
     c->out += text;
   } else {
-    // an async reply is still in flight ahead of us: preserve reply order.
-    // Parked reply text counts against the same byte cap as queued TOPK
-    // payloads — the slow-reader check only sees c->out, and a client
-    // pipelining GETs behind a slow TOPK without reading must not grow
-    // the pending queue unboundedly.
+    // an async reply is still in flight ahead of us (or a frame needs the
+    // slot): preserve reply order.  Parked reply text counts against the
+    // same byte cap as queued TOPK payloads — the slow-reader check only
+    // sees c->out, and a client pipelining GETs behind a slow TOPK
+    // without reading must not grow the pending queue unboundedly.
     if (c->pending.size() >= kMaxPendingReplies ||
         c->pending_req_bytes + text.size() > kMaxOutBuffer) {
       return false;
@@ -956,8 +1390,126 @@ bool submit_line(ServerState* s, Conn* c, const std::string& line) {
     slot->text = std::move(text);
     slot->ready.store(true, std::memory_order_release);
     c->pending.push_back(std::move(slot));
+    if (!always_slot) c->units.push_back(OutUnit{false, 1});
   }
   return true;
+}
+
+bool submit_line(ServerState* s, Conn* c, const std::string& line) {
+  // 5 slots: one more than the widest verb, so an over-long request is
+  // distinguishable from an exact TOPK (Python splits unbounded; parity
+  // demands "TOPK\ta\tb\tc\td" be a bad request, not a TOPK)
+  std::string parts[5];
+  int n = split_tabs(line, parts, 5);
+  return route_parts(s, c, parts, n, line.size(), false);
+}
+
+// Queue the structural-corruption reply (one-record error frame, matching
+// serve/proto.error_frame) and poison the connection: it serves what is
+// already in flight, flushes, then closes.  Never called for per-verb
+// semantic errors — those stay in-slot as ordinary E records.
+int fatal_frame(Conn* c, const char* reason) {
+  auto slot = std::make_shared<PendingReply>();
+  slot->text = std::string("E\tbad frame: ") + reason + "\n";
+  slot->ready.store(true, std::memory_order_release);
+  c->pending.push_back(std::move(slot));
+  c->units.push_back(OutUnit{true, 1});
+  c->fatal = true;
+  c->in.clear();
+  return -1;
+}
+
+// Parse ONE complete B2 request frame off c->in and dispatch its records
+// as a single burst (one reply frame).  Returns 0 = need more bytes,
+// 1 = consumed a frame, -1 = poisoned (error frame queued), -2 = hard
+// close (pending-flood caps).  Structural corruption poisons the whole
+// connection — record boundaries inside a frame depend on every earlier
+// record decoding, so there is no trustworthy resync point.
+int parse_one_frame(ServerState* s, Conn* c) {
+  const std::string& in = c->in;
+  if (in.empty()) return 0;
+  if (in[0] != 'B') return fatal_frame(c, "bad magic");
+  if (in.size() < 2) return 0;
+  if (in[1] != '2') return fatal_frame(c, "bad magic");
+  size_t pos = 2;
+  uint64_t body_len = 0;
+  int vr = parse_varint(in.data(), in.size(), &pos, &body_len);
+  if (vr == 1) return 0;
+  if (vr == 2) return fatal_frame(c, "bad varint");
+  if (body_len > kMaxFrameBody) return fatal_frame(c, "frame too large");
+  if (in.size() - pos < body_len) return 0;
+  size_t end = pos + body_len;
+  uint64_t count = 0;
+  vr = parse_varint(in.data(), end, &pos, &count);
+  if (vr != 0) return fatal_frame(c, "bad body");
+  // decode ALL records before dispatching any: a frame either fully
+  // parses or is rejected whole (serve/proto.decode_request_frame parity)
+  std::vector<std::vector<std::string>> records;
+  std::vector<size_t> rec_bytes;
+  records.reserve(count);
+  for (uint64_t r = 0; r < count; ++r) {
+    size_t rec_start = pos;
+    if (pos >= end) return fatal_frame(c, "bad body");
+    int op = static_cast<uint8_t>(in[pos++]);
+    if (op < 1 || op > kMaxOpcode) return fatal_frame(c, "bad body");
+    const VerbSpec& spec = kVerbByOp[op];
+    std::vector<std::string> parts;
+    parts.reserve(spec.fields + 1);
+    parts.emplace_back(spec.verb);
+    for (int f = 0; f < spec.fields; ++f) {
+      uint64_t flen = 0;
+      vr = parse_varint(in.data(), end, &pos, &flen);
+      if (vr != 0 || pos + flen > end) return fatal_frame(c, "bad body");
+      if (!utf8_valid(in.data() + pos, flen))
+        return fatal_frame(c, "bad body");
+      parts.emplace_back(in.data() + pos, flen);
+      pos += flen;
+    }
+    rec_bytes.push_back(pos - rec_start);
+    records.push_back(std::move(parts));
+  }
+  if (pos != end) return fatal_frame(c, "bad body");
+  for (size_t r = 0; r < records.size(); ++r) {
+    std::string parts[5];
+    int n = static_cast<int>(records[r].size());
+    for (int i = 0; i < n; ++i) parts[i] = std::move(records[r][i]);
+    if (!route_parts(s, c, parts, n, rec_bytes[r], true)) return -2;
+  }
+  c->units.push_back(
+      OutUnit{true, static_cast<uint32_t>(records.size())});
+  c->in.erase(0, end);
+  return 1;
+}
+
+// Answer every complete request buffered in c->in — lines until the
+// connection negotiates B2, frames after.  false = close the conn
+// (pending-flood protection tripped); a poisoned conn (corrupt frame)
+// returns true so its queued error frame still flushes before close.
+bool drain_lines(ServerState* s, Conn* c) {
+  while (true) {
+    if (c->fatal) {
+      c->in.clear();
+      return true;
+    }
+    if (!c->binary) {
+      size_t start = 0;
+      bool ok = true;
+      while (ok && !c->binary) {
+        size_t nl = c->in.find('\n', start);
+        if (nl == std::string::npos) break;
+        ok = submit_line(s, c, c->in.substr(start, nl - start));
+        start = nl + 1;
+      }
+      c->in.erase(0, start);
+      if (!ok) return false;
+      if (!c->binary) return true;  // no more complete lines buffered
+      continue;  // HELLO flipped the mode: the remainder is frames
+    }
+    int r = parse_one_frame(s, c);
+    if (r == 0) return true;
+    if (r == -1) return true;  // poisoned: error frame queued
+    if (r == -2) return false;
+  }
 }
 
 void arm_writable(ServerState* s, Conn* c, bool want) {
@@ -994,32 +1546,19 @@ bool flush_out(ServerState* s, Conn* c) {
   return true;
 }
 
-// Answer every complete line buffered in c->in, leaving the partial tail.
-// false = close the conn (pending-flood protection tripped).
-bool drain_lines(ServerState* s, Conn* c) {
-  size_t start = 0;
-  bool ok = true;
-  while (ok) {
-    size_t nl = c->in.find('\n', start);
-    if (nl == std::string::npos) break;
-    ok = submit_line(s, c, c->in.substr(start, nl - start));
-    start = nl + 1;
-  }
-  c->in.erase(0, start);
-  return ok;
-}
-
-// Read available bytes, answer every complete line; false = close the conn.
+// Read available bytes, answer every complete request; false = close.
 bool on_readable(ServerState* s, Conn* c) {
   char chunk[kReadChunk];
   for (int chunks = 0; chunks < kMaxChunksPerEvent; ++chunks) {
     ssize_t r = recv(c->fd, chunk, sizeof(chunk), 0);
     if (r > 0) {
       c->in.append(chunk, static_cast<size_t>(r));
-      // parse as we go so the cap bounds ONE request line, not a burst of
-      // pipelined small requests
+      // parse as we go so the cap bounds ONE request line/frame, not a
+      // burst of pipelined small requests (binary frames get the bigger
+      // frame-body cap; an over-declared length already poisoned the conn)
       if (!drain_lines(s, c)) return false;
-      if (c->in.size() > kMaxLine) return false;   // oversized request line
+      size_t in_cap = c->binary ? kMaxFrameBody + 16 : kMaxLine;
+      if (c->in.size() > in_cap) return false;   // oversized request
       if (c->out.size() > kMaxOutBuffer) return false;  // slow reader
       continue;
     }
@@ -1031,8 +1570,10 @@ bool on_readable(ServerState* s, Conn* c) {
     return false;
   }
   if (!drain_lines(s, c)) return false;
-  if (c->eof && !c->in.empty()) {
+  if (c->eof && !c->in.empty() && !c->binary && !c->fatal) {
     // final line without '\n': readline()-at-EOF answers it, so we do too
+    // (tab mode only — a partial binary frame at EOF is dropped silently,
+    // matching the Python plane's frame loop)
     bool ok = submit_line(s, c, c->in);
     c->in.clear();
     if (!ok) return false;
@@ -1074,8 +1615,9 @@ void event_loop(ServerState* s) {
           drain_ready_replies(cc);
           bool cok = flush_out(s, cc);
           if (cok && cc->out.size() > kMaxOutBuffer) cok = false;
-          if (cok && cc->eof && cc->out.empty() && cc->pending.empty())
-            cok = false;  // half-closed and fully answered
+          if (cok && (cc->eof || cc->fatal) && cc->out.empty() &&
+              cc->pending.empty())
+            cok = false;  // half-closed/poisoned and fully answered
           if (!cok) doomed.push_back(kv.first);
         }
         continue;  // stop flag is checked at the top of the loop
@@ -1111,8 +1653,12 @@ void event_loop(ServerState* s) {
       if (ok && (ev & EPOLLOUT)) ok = flush_out(s, c);
       // half-closed and fully answered (EPOLLHUP arrives with EPOLLIN on a
       // shutdown(WR) peer — the buffered requests must still be served,
-      // including in-flight top-k replies)
-      if (ok && c->eof && c->out.empty() && c->pending.empty()) ok = false;
+      // including in-flight top-k replies); a poisoned conn closes the
+      // same way once its error frame has flushed
+      if (ok && (c->eof || c->fatal) && c->out.empty() &&
+          c->pending.empty()) {
+        ok = false;
+      }
       if (!ok) doomed.push_back(fd);
     }
     for (int fd : doomed) close_conn(s, fd);
@@ -1132,10 +1678,11 @@ void destroy(ServerState* s) {
 
 extern "C" {
 
-void* tpums_server_start2(void* store, const char* state_name,
+void* tpums_server_start3(void* store, const char* state_name,
                           const char* job_id, const char* host, int port,
                           const char* topk_item_suffix,
-                          const char* topk_user_suffix) {
+                          const char* topk_user_suffix,
+                          const double* latency_bounds, int n_bounds) {
   if (!store || !state_name) return nullptr;
   auto* s = new ServerState();
   s->store = store;
@@ -1143,6 +1690,13 @@ void* tpums_server_start2(void* store, const char* state_name,
   s->job_id = job_id ? job_id : "local";
   s->topk_item_suffix = topk_item_suffix ? topk_item_suffix : "";
   s->topk_user_suffix = topk_user_suffix ? topk_user_suffix : "";
+  s->host_str = (host && *host) ? host : "0.0.0.0";
+  // latency bucket ladder: handed over as the exact doubles of
+  // obs/metrics.LATENCY_BUCKETS_S — re-deriving the log ladder here would
+  // risk float-math drift and merge_snapshots silently skipping the series
+  if (latency_bounds && n_bounds > 0) {
+    s->lat_bounds.assign(latency_bounds, latency_bounds + n_bounds);
+  }
 
   s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -1197,10 +1751,25 @@ void* tpums_server_start2(void* store, const char* state_name,
   return s;
 }
 
+void* tpums_server_start2(void* store, const char* state_name,
+                          const char* job_id, const char* host, int port,
+                          const char* topk_item_suffix,
+                          const char* topk_user_suffix) {
+  return tpums_server_start3(store, state_name, job_id, host, port,
+                             topk_item_suffix, topk_user_suffix, nullptr, 0);
+}
+
 void* tpums_server_start(void* store, const char* state_name,
                          const char* job_id, const char* host, int port) {
-  return tpums_server_start2(store, state_name, job_id, host, port, nullptr,
-                             nullptr);
+  return tpums_server_start3(store, state_name, job_id, host, port, nullptr,
+                             nullptr, nullptr, 0);
+}
+
+void tpums_server_set_health(void* srv, const char* health_json) {
+  if (!srv) return;
+  auto* s = static_cast<ServerState*>(srv);
+  std::lock_guard<std::mutex> g(s->health_mu);
+  s->health_json = health_json ? health_json : "";
 }
 
 int tpums_server_port(void* srv) {

@@ -59,6 +59,22 @@ void* tpums_server_start2(void* store, const char* state_name,
                           const char* job_id, const char* host, int port,
                           const char* topk_item_suffix,
                           const char* topk_user_suffix);
+// start3 additionally enables HEALTH/METRICS: `latency_bounds` is the shared
+// log-bucket ladder (obs/metrics.LATENCY_BUCKETS_S, handed over as exact
+// doubles so cross-plane merge_snapshots bounds compare equal) and turns on
+// per-verb request/latency/error accounting; with nullptr/0 the server
+// behaves like start2 (METRICS answers E).  All variants speak both the tab
+// protocol and the HELLO-negotiated B2 binary batch framing (serve/proto.py).
+void* tpums_server_start3(void* store, const char* state_name,
+                          const char* job_id, const char* host, int port,
+                          const char* topk_item_suffix,
+                          const char* topk_user_suffix,
+                          const double* latency_bounds, int n_bounds);
+// Replace the HEALTH verb's base report with a one-line JSON object (the
+// owning job's health dict, pushed on every heartbeat); the server splices
+// in the live key count and metrics_uri.  NULL or "" reverts to the
+// synthesized always-ready report.
+void tpums_server_set_health(void* srv, const char* health_json);
 int tpums_server_port(void* srv);
 uint64_t tpums_server_requests(void* srv);
 // Stops the loop, closes all connections, joins the thread, frees the handle.

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Round-trip fuzz of the B2 wire protocol (serve/proto.py) against BOTH
+decoders: the pure-Python one and the C++ server's (native/lookup_server.cpp).
+
+Three properties, over seeded random verb batches whose fields carry hostile
+unicode (``\\x85`` / ``\\u2028`` line separators, emoji, quotes, backslashes,
+long runs — everything the line-framed v1 protocol could never carry safely):
+
+1. **encode/decode round trip** — ``decode_request_frame(encode(lines))``
+   reproduces the exact parts lists, batch boundaries included.
+2. **cross-plane reply parity** — the same batch sent as one B2 frame to the
+   C++ server and to the Python server yields identical reply records, and
+   each record equals the tab-protocol reply for that line where the line is
+   tab-transportable at all.
+3. **decoder robustness** — random mutations (bit flips, truncations,
+   splices) of valid frames either decode cleanly or raise ``ProtoError`` /
+   produce a single ``E\\tbad frame`` reply and a closed connection on the
+   wire; never a hang, crash, or torn reply.
+
+    python scripts/proto_fuzz.py [--n 200] [--seed 0] [--no-native]
+"""
+
+import argparse
+import os
+import random
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flink_ms_tpu.serve import proto  # noqa: E402
+
+# code points chosen to stress line-framing assumptions: ASCII controls the
+# tab protocol reserves, unicode line separators, surrogate-adjacent BMP
+# chars, astral plane, and plain text
+_HOSTILE = ["\x85", "\u2028", "\u2029", "\x1f", "\x00", "\x7f",
+            "\ufeff", "\U0001f600", "\xe9", "\"", "\\", "'", " ", "k",
+            "0", ";", ":", ",", "."]
+
+
+def _rand_field(rng, allow_tabs_newlines):
+    bits = []
+    for _ in range(rng.randrange(0, 24)):
+        r = rng.random()
+        if r < 0.5:
+            bits.append(rng.choice(_HOSTILE))
+        elif r < 0.9:
+            bits.append(chr(rng.randrange(0x20, 0x7f)))
+        else:
+            bits.append(chr(rng.randrange(0xa0, 0x2100)))
+    s = "".join(bits)
+    if not allow_tabs_newlines:
+        s = s.replace("\t", " ").replace("\n", " ").replace("\r", " ")
+    return s
+
+
+def _rand_line(rng, allow_tabs_newlines=True):
+    verb = rng.choice(list(proto.OPCODES))
+    fields = [_rand_field(rng, allow_tabs_newlines)
+              for _ in range(proto.FIELD_COUNTS[verb])]
+    return "\t".join([verb] + fields)
+
+
+def fuzz_roundtrip(rng, iterations):
+    """Property 1: pure encode/decode identity, including multi-frame
+    streams decoded from one buffer."""
+    for _ in range(iterations):
+        batches = [[_rand_line(rng) for _ in range(rng.randrange(0, 9))]
+                   for _ in range(rng.randrange(1, 4))]
+        stream = b"".join(proto.encode_request_frame(b) for b in batches)
+        pos = 0
+        for batch in batches:
+            res = proto.decode_request_frame(stream, pos)
+            assert res is not None, "complete frame decoded as incomplete"
+            records, pos = res
+            want = [line.split("\t") for line in batch]
+            assert records == want, (records, want)
+        assert pos == len(stream)
+        # reply framing round-trips the same payloads as opaque text
+        texts = [line for batch in batches for line in batch]
+        res = proto.decode_reply_frame(proto.encode_reply_frame(texts))
+        assert res is not None and res[0] == texts
+    print(f"[proto_fuzz] roundtrip: {iterations} batches OK")
+
+
+def _recv_all(sock):
+    out = b""
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return out
+        out += chunk
+
+
+def _binary_exchange(port, frames):
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as sock:
+        sock.sendall(b"HELLO\tB2\n" + frames)
+        sock.shutdown(socket.SHUT_WR)
+        out = _recv_all(sock)
+    assert out.startswith(b"HELLO\tB2\n"), out[:64]
+    return out[len(b"HELLO\tB2\n"):]
+
+
+def _decode_replies(buf):
+    texts, pos = [], 0
+    while pos < len(buf):
+        res = proto.decode_reply_frame(buf, pos)
+        assert res is not None, "torn reply frame"
+        frame, pos = res
+        texts.extend(frame)
+    return texts
+
+
+def _tab_replies(port, lines):
+    payload = "".join(line + "\n" for line in lines).encode("utf-8")
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as sock:
+        sock.sendall(payload)
+        sock.shutdown(socket.SHUT_WR)
+        out = _recv_all(sock)
+    return out.decode("utf-8").split("\n")[:-1]
+
+
+def _tab_transportable(line):
+    # a line the v1 framing can carry without splitting: no newline-ish
+    # bytes inside any field (the B2 plane has no such restriction)
+    return not any(ch in line for ch in "\n\r")
+
+
+def fuzz_live_parity(rng, iterations, ports):
+    """Property 2: identical reply records across planes, and tab parity
+    for the transportable subset."""
+    checked = tab_checked = 0
+    for _ in range(iterations):
+        lines = [_rand_line(rng) for _ in range(rng.randrange(1, 17))]
+        frame = proto.encode_request_frame(lines)
+        replies = {name: _decode_replies(_binary_exchange(port, frame))
+                   for name, port in ports.items()}
+        for name, rep in replies.items():
+            assert len(rep) == len(lines), (name, len(rep), len(lines))
+        if len(replies) == 2:
+            a, b = replies.values()
+            # METRICS bodies differ across planes by construction
+            for line, ra, rb in zip(lines, a, b):
+                if line.split("\t")[0] not in ("METRICS", "HEALTH"):
+                    assert ra == rb, (line, ra, rb)
+            checked += len(lines)
+        # tab parity where v1 can even carry the line
+        name, port = next(iter(ports.items()))
+        tab_lines = [l for l in lines
+                     if _tab_transportable(l)
+                     and l.split("\t")[0] not in ("METRICS", "HEALTH",
+                                                  "HELLO")]
+        if tab_lines:
+            want = _tab_replies(port, tab_lines)
+            got = _decode_replies(_binary_exchange(
+                port, proto.encode_request_frame(tab_lines)))
+            assert got == want, (tab_lines, got, want)
+            tab_checked += len(tab_lines)
+    print(f"[proto_fuzz] live parity: {checked} cross-plane + "
+          f"{tab_checked} tab-parity records OK")
+
+
+def fuzz_mutations(rng, iterations, ports):
+    """Property 3: mutated frames never crash or hang a decoder."""
+    wire_checked = 0
+    for i in range(iterations):
+        lines = [_rand_line(rng) for _ in range(rng.randrange(1, 6))]
+        frame = bytearray(proto.encode_request_frame(lines))
+        mode = rng.randrange(3)
+        if mode == 0 and frame:  # bit flip
+            pos = rng.randrange(len(frame))
+            frame[pos] ^= 1 << rng.randrange(8)
+        elif mode == 1:  # truncate
+            frame = frame[:rng.randrange(len(frame))]
+        else:  # splice random junk
+            pos = rng.randrange(len(frame) + 1)
+            junk = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(1, 8)))
+            frame = frame[:pos] + junk + frame[pos:]
+        blob = bytes(frame)
+        # the pure decoder: clean decode, incomplete, or ProtoError only
+        try:
+            proto.decode_request_frame(blob)
+        except proto.ProtoError:
+            pass
+        # every 8th mutant also goes over the wire: the server must answer
+        # with frames and/or one error frame, then close — never hang
+        if i % 8 == 0:
+            for port in ports.values():
+                out = _binary_exchange(port, blob)
+                while out:
+                    res = proto.decode_reply_frame(out)
+                    if res is None:
+                        break  # torn tail after an error frame: closed mid-write is fine
+                    texts, consumed = res
+                    out = out[consumed:]
+                    del texts
+                wire_checked += 1
+    print(f"[proto_fuzz] mutations: {iterations} mutants, "
+          f"{wire_checked} wire exchanges OK")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=200,
+                    help="iterations per property")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-native", action="store_true",
+                    help="skip the C++ server (pure-Python parity only)")
+    args = ap.parse_args()
+    rng = random.Random(args.seed)
+
+    fuzz_roundtrip(rng, args.n)
+
+    import tempfile
+
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve.server import LookupServer
+    from flink_ms_tpu.serve.table import ModelTable
+    from flink_ms_tpu.serve.topk import make_als_topk_handler
+
+    rows = [("10-I", "1.0;0.5;-2.0;0.25"), ("11-I", "0.5;0.5;0.5;0.5"),
+            ("7-U", "1.0;2.0;0.5;-1.0")]
+    table = ModelTable(2)
+    for k, v in rows:
+        table.put(k, v)
+    pysrv = LookupServer(
+        {ALS_STATE: table}, host="127.0.0.1", port=0, job_id="fuzz",
+        topk_handlers={ALS_STATE: make_als_topk_handler(table)},
+    ).start()
+    ports = {"python": pysrv.port}
+    nsrv = store = None
+    if not args.no_native:
+        try:
+            from flink_ms_tpu.serve.native_store import (NativeLookupServer,
+                                                         NativeStore)
+
+            tmp = tempfile.mkdtemp(prefix="proto_fuzz_")
+            store = NativeStore(os.path.join(tmp, "store"))
+            for k, v in rows:
+                store.put(k, v)
+            nsrv = NativeLookupServer(store, ALS_STATE, job_id="fuzz",
+                                      port=0, topk_suffixes=("-I", "-U"))
+            ports["native"] = nsrv.port
+        except Exception as e:
+            print(f"[proto_fuzz] native plane unavailable ({e}); "
+                  "python-only", file=sys.stderr)
+    try:
+        fuzz_live_parity(rng, args.n, ports)
+        fuzz_mutations(rng, args.n, ports)
+    finally:
+        pysrv.stop()
+        if nsrv is not None:
+            nsrv.stop()
+        if store is not None:
+            store.close()
+    print(f"[proto_fuzz] PASS (n={args.n}, seed={args.seed}, "
+          f"planes={sorted(ports)})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
