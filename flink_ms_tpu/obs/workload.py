@@ -362,7 +362,8 @@ class ServingOps:
 
     def __init__(self, client_factory: Callable[[], object], keys: ZipfKeys,
                  state: str, journal=None, dim: int = 4,
-                 mget_size: int = 4, topk_k: int = 8, topkv_users: int = 2):
+                 mget_size: int = 4, topk_k: int = 8, topkv_users: int = 2,
+                 update_plane=None):
         self.client_factory = client_factory
         self.keys = keys
         self.state = state
@@ -371,6 +372,11 @@ class ServingOps:
         self.mget_size = mget_size
         self.topk_k = topk_k
         self.topkv_users = topkv_users
+        # serve/update_plane.UpdatePlaneClient: when set, UPDATE submits a
+        # real rating into the sharded update plane (the co-located SGD
+        # workers do the factor math) instead of appending a synthetic
+        # factor row straight to the journal
+        self.update_plane = update_plane
         self._tl = threading.local()
         self._journal_lock = threading.Lock()
 
@@ -400,8 +406,17 @@ class ServingOps:
             return all(r is not None for r in
                        c.topk_many(self.state, users, self.topk_k))
         if verb == "UPDATE":
+            if self.update_plane is not None:
+                # the closed loop for real: a rating routed through the
+                # sharded update plane — co-located SGD does the math and
+                # publishes the resulting factor rows
+                uid = self.keys.sample(rng)
+                iid = self.keys.sample(rng)
+                self.update_plane.submit(uid, iid, rng.uniform(0.5, 5.0))
+                return True
             if self.journal is None:
-                raise RuntimeError("UPDATE verb needs a journal")
+                raise RuntimeError("UPDATE verb needs a journal or an "
+                                   "update plane")
             from ..core import formats as F
             uid = self.keys.sample(rng)
             row = F.format_als_row(
@@ -607,6 +622,7 @@ def run_rehearsal(
     group: str = "rehearsal",
     attach_group: Optional[str] = None,
     zipf_exponent: float = 1.1,
+    update_plane: bool = True,
 ) -> dict:
     """The closed loop: elastic sharded group + open-loop zipfian mixed-verb
     engine + autoscaler + one chaos kill, all acting on the same fleet,
@@ -662,9 +678,16 @@ def run_rehearsal(
                                          ScaleController)
 
             journal = _seed_journal(base, "models", users, dim, seed)
+            # real sharded updates: the workers co-host the update plane
+            # (serve/update_plane.py) and the UPDATE verb submits ratings
+            # into it instead of appending synthetic factor rows
+            extra_args = (["--updatePlane", "true",
+                           "--pollInterval", "0.02"]
+                          if update_plane else [])
             ctl = ScaleController(group, journal.dir, "models",
                                   port_dir=os.path.join(base, "ports"),
-                                  ready_timeout_s=180)
+                                  ready_timeout_s=180,
+                                  extra_args=extra_args)
             ctl.scale_to(shards, replicas=replication)
             live_group = group
             if autoscale != "off":
@@ -694,8 +717,13 @@ def run_rehearsal(
                 retry=RetryPolicy(attempts=6, backoff_s=0.02,
                                   max_backoff_s=0.5))
 
+        upd_client = None
+        if update_plane and journal is not None:
+            from ..serve.update_plane import UpdatePlaneClient
+            upd_client = UpdatePlaneClient(journal.dir, "models")
         ops = ServingOps(client_factory, ZipfKeys(users, zipf_exponent, seed),
-                         ALS_STATE, journal=journal, dim=dim)
+                         ALS_STATE, journal=journal, dim=dim,
+                         update_plane=upd_client)
         recorder = WorkloadRecorder()
         engine = WorkloadEngine(ops, schedule, mix, recorder=recorder,
                                 threads=threads, seed=seed,

@@ -216,65 +216,93 @@ class SGDStep:
             id_, suffix = key[:-2], key[-2:]
             cache[key] = self._vec(id_, suffix, payload, mean)
 
-        if len(set(ukeys)) == len(ukeys) and len(set(ikeys)) == len(ikeys):
-            # duplicate-free chunk: every rating's update is independent,
-            # so the whole chunk runs as a handful of (B, k) matrix ops
-            # instead of ~10 tiny numpy calls per rating (the measured
-            # cost after MGET batching); ragged factor widths fall back
-            try:
-                U = np.stack([cache[k] for k in ukeys])
-                V = np.stack([cache[k] for k in ikeys])
-            except ValueError:
-                U = None
-            if U is not None:
-                r = np.asarray([rr for _, _, rr in ratings], np.float64)
-                # per-row BLAS dots, not one einsum: the last-ulp of the
-                # reduction must match the per-rating path exactly so
-                # --batchSize N and --batchSize 1 emit byte-identical
-                # rows (the broadcast update arithmetic below is
-                # elementwise and therefore already bitwise-identical)
-                if self.update_bias:
-                    Uf, bu = U[:, :-1], U[:, -1]
-                    Vf, bi = V[:, :-1], V[:, -1]
-                    err = r - (np.fromiter(
-                        (float(u @ v) for u, v in zip(Uf, Vf)),
-                        np.float64, len(ratings),
-                    ) + bu + bi)
-                    Uf_new = Uf + self.lr * (
-                        err[:, None] * Vf - self.user_reg * Uf)
-                    bu_new = bu + self.lr * (err - self.user_reg * bu)
-                    base = Uf if self.version == "v1" else Uf_new
-                    Vf_new = Vf + self.lr * (
-                        err[:, None] * base - self.item_reg * Vf)
-                    bi_new = bi + self.lr * (err - self.item_reg * bi)
-                    U_new = np.concatenate([Uf_new, bu_new[:, None]], axis=1)
-                    V_new = np.concatenate([Vf_new, bi_new[:, None]], axis=1)
-                else:
-                    err = r - np.fromiter(
-                        (float(u @ v) for u, v in zip(U, V)),
-                        np.float64, len(ratings),
-                    )
-                    U_new = U + self.lr * (
-                        err[:, None] * V - self.user_reg * U)
-                    base = U if self.version == "v1" else U_new
-                    V_new = V + self.lr * (
-                        err[:, None] * base - self.item_reg * V)
-                self.vectorized_chunks += 1
-                out = []
-                for (user, item, _), un, vn in zip(ratings, U_new, V_new):
-                    rows, _ = self._emit(user, item, un, vn)
-                    out.extend(rows)
-                return out
+        # greedy duplicate-free runs: the chunk splits wherever a user or
+        # item repeats; within a run every rating's update is independent,
+        # so it computes as a handful of (B, k) matrix ops instead of ~10
+        # tiny numpy calls per rating (the measured cost after MGET
+        # batching).  Carry-forward across run boundaries goes through the
+        # cache, exactly where the sequential path would have written it,
+        # so the split points never change the emitted bytes.
+        out: List[str] = []
+        n = len(ratings)
+        start = 0
+        while start < n:
+            seen_u: set = set()
+            seen_i: set = set()
+            end = start
+            while end < n:
+                user, item, _ = ratings[end]
+                if user in seen_u or item in seen_i:
+                    break
+                seen_u.add(user)
+                seen_i.add(item)
+                end += 1
+            run = ratings[start:end]
+            start = end
+            if len(run) >= 2 and self._apply_run_vectorized(run, cache, out):
+                continue
+            for user, item, rating in run:
+                u_new, v_new = self._update(
+                    cache[f"{user}-U"], cache[f"{item}-I"], rating
+                )
+                rows, visible = self._emit(user, item, u_new, v_new)
+                out.extend(rows)
+                cache.update(visible)
+        return out
 
-        out = []
-        for user, item, rating in ratings:
-            u_new, v_new = self._update(
-                cache[f"{user}-U"], cache[f"{item}-I"], rating
+    def _apply_run_vectorized(
+        self,
+        run: List[Tuple[int, int, float]],
+        cache: Dict[str, np.ndarray],
+        out: List[str],
+    ) -> bool:
+        """Apply one duplicate-free run as (B, k) matrix ops, emitting
+        into ``out`` and folding the new vectors back into ``cache`` for
+        the following runs.  Returns False on ragged factor widths (the
+        caller then takes the scalar path for the run)."""
+        try:
+            U = np.stack([cache[f"{u}-U"] for u, _, _ in run])
+            V = np.stack([cache[f"{i}-I"] for _, i, _ in run])
+        except ValueError:
+            return False
+        r = np.asarray([rr for _, _, rr in run], np.float64)
+        # per-row BLAS dots, not one einsum: the last-ulp of the
+        # reduction must match the per-rating path exactly so
+        # --batchSize N and --batchSize 1 emit byte-identical
+        # rows (the broadcast update arithmetic below is
+        # elementwise and therefore already bitwise-identical)
+        if self.update_bias:
+            Uf, bu = U[:, :-1], U[:, -1]
+            Vf, bi = V[:, :-1], V[:, -1]
+            err = r - (np.fromiter(
+                (float(u @ v) for u, v in zip(Uf, Vf)),
+                np.float64, len(run),
+            ) + bu + bi)
+            Uf_new = Uf + self.lr * (
+                err[:, None] * Vf - self.user_reg * Uf)
+            bu_new = bu + self.lr * (err - self.user_reg * bu)
+            base = Uf if self.version == "v1" else Uf_new
+            Vf_new = Vf + self.lr * (
+                err[:, None] * base - self.item_reg * Vf)
+            bi_new = bi + self.lr * (err - self.item_reg * bi)
+            U_new = np.concatenate([Uf_new, bu_new[:, None]], axis=1)
+            V_new = np.concatenate([Vf_new, bi_new[:, None]], axis=1)
+        else:
+            err = r - np.fromiter(
+                (float(u @ v) for u, v in zip(U, V)),
+                np.float64, len(run),
             )
-            rows, visible = self._emit(user, item, u_new, v_new)
+            U_new = U + self.lr * (
+                err[:, None] * V - self.user_reg * U)
+            base = U if self.version == "v1" else U_new
+            V_new = V + self.lr * (
+                err[:, None] * base - self.item_reg * V)
+        self.vectorized_chunks += 1
+        for (user, item, _), un, vn in zip(run, U_new, V_new):
+            rows, visible = self._emit(user, item, un, vn)
             out.extend(rows)
             cache.update(visible)
-        return out
+        return True
 
 
 # ---------------------------------------------------------------------------
@@ -361,13 +389,25 @@ def run(params: Params, stop: Optional[Callable[[], bool]] = None) -> int:
     output_mode = params.get_required("outputMode")
     delimiter = field_delimiter_from(params, default="tab")
 
-    sgd_host, sgd_port = resolve_endpoint(params)  # jobId -> registry
-    client = QueryClient(
-        host=sgd_host,
-        port=sgd_port,
-        timeout_s=params.get_int("queryTimeout", 5),
-        job_id=params.get_required("jobId"),
-    )
+    # --group names an elastic topology group: the consumer then rides
+    # ElasticClient's replica failover + generation swap, so a lone legacy
+    # SGD job survives fleet rescale/failover instead of dying with the
+    # one endpoint a one-shot resolve pinned it to.  --jobId keeps the
+    # original single-endpoint path.
+    group = params.get("group")
+    if group:
+        from ..serve.elastic import ElasticClient
+        client = ElasticClient(
+            group, timeout_s=params.get_int("queryTimeout", 5)
+        )
+    else:
+        sgd_host, sgd_port = resolve_endpoint(params)  # jobId -> registry
+        client = QueryClient(
+            host=sgd_host,
+            port=sgd_port,
+            timeout_s=params.get_int("queryTimeout", 5),
+            job_id=params.get_required("jobId"),
+        )
     out_f = None
     try:
         def lookup(key: str) -> Optional[str]:

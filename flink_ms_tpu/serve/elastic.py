@@ -638,7 +638,8 @@ def run_elastic(params: Params) -> ScaleController:
     extra: List[str] = []
     for passthrough in ("svm", "checkPointInterval", "nativeServer",
                         "ingestMode", "snapshots", "snapshotMinBytes",
-                        "compact"):
+                        "compact", "updatePlane", "updatePartitions",
+                        "updateBatch", "pollInterval"):
         if params.has(passthrough):
             extra += [f"--{passthrough}", params.get(passthrough)]
     ctl = ScaleController(

@@ -692,7 +692,9 @@ def run_supervisor(params: Params) -> ReplicaSupervisor:
     for passthrough in ("svm", "shards", "checkPointInterval",
                         "checkpointDataUri", "nativeServer", "ingestMode",
                         "topologyGroup", "topologyGen",
-                        "snapshots", "snapshotMinBytes", "compact"):
+                        "snapshots", "snapshotMinBytes", "compact",
+                        "updatePlane", "updatePartitions", "updateBatch",
+                        "pollInterval"):
         if params.has(passthrough):
             extra += [f"--{passthrough}", params.get(passthrough)]
     sup = ReplicaSupervisor(

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Iterable, List, NamedTuple, Optional, Tuple
 
 
@@ -113,6 +114,8 @@ class Journal:
         self.torn_bytes_skipped = 0     # newline-less tails of sealed segments
         self.compacted_rereads = 0      # reset-mode restarts into a fold
         self._seg_cache: Optional[List[_Seg]] = None
+        # producer-side (dir_mtime_ns, base, path) — see _active_segment
+        self._active_cache: Optional[Tuple[int, int, str]] = None
 
     # -- segment layout ------------------------------------------------------
 
@@ -190,7 +193,33 @@ class Journal:
 
     def _active_segment(self) -> Tuple[int, str]:
         """The append target: the highest-base plain segment, or a fresh
-        plain segment at ``logical_end`` when the whole log is one fold."""
+        plain segment at ``logical_end`` when the whole log is one fold.
+
+        One os.stat of the directory validates a cached answer: a roll,
+        fold or truncation by ANY process creates or removes a directory
+        entry and therefore bumps the dir mtime, while plain appends do
+        not — so a matching mtime proves the cached layout is current
+        (the per-append os.listdir was a measured hot spot once the
+        update plane put 30+ producer topics in one journal dir).  A
+        fresh mtime is never cached: filesystem timestamps tick coarsely,
+        and a concurrent roll inside the same tick would otherwise stay
+        invisible forever."""
+        try:
+            dir_mtime = os.stat(self.dir).st_mtime_ns
+        except OSError:
+            dir_mtime = None
+        cached = self._active_cache
+        if cached is not None and dir_mtime is not None \
+                and cached[0] == dir_mtime:
+            return cached[1], cached[2]
+        self._active_cache = None
+        base, path = self._active_segment_scan()
+        if dir_mtime is not None and \
+                time.time_ns() - dir_mtime > 50_000_000:
+            self._active_cache = (dir_mtime, base, path)
+        return base, path
+
+    def _active_segment_scan(self) -> Tuple[int, str]:
         view = self._view()
         if not view:
             return 0, self.path
@@ -411,6 +440,41 @@ class Journal:
         except FileNotFoundError:
             pass
         return base
+
+    def tail_line(self) -> Optional[str]:
+        """The last COMPLETE record of the topic, or None when empty.
+
+        The O(tail) watermark read of the update plane
+        (``serve/update_plane.py``): recovery and progress polling need
+        only the newest committed record, not a full replay.  Same
+        reverse-scan idiom as ``aligned_end_offset``; a newline-less torn
+        tail (producer mid-append / SIGKILLed) is skipped — by
+        construction it was never committed."""
+        for seg in reversed(self._view()):
+            try:
+                with open(seg.path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    pos = f.tell()
+                    if pos == 0:
+                        continue
+                    buf = b""
+                    while pos > 0:
+                        step = min(1 << 16, pos)
+                        f.seek(pos - step)
+                        buf = f.read(step) + buf
+                        pos -= step
+                        # need the terminator of the last complete line AND
+                        # the newline (or BOF) that precedes it
+                        if buf.count(b"\n") >= 2:
+                            break
+            except (FileNotFoundError, OSError):
+                continue
+            last_nl = buf.rfind(b"\n")
+            if last_nl < 0:
+                continue  # only a torn tail in this segment: look earlier
+            start = buf.rfind(b"\n", 0, last_nl) + 1
+            return buf[start:last_nl].decode("utf-8")
+        return None
 
     def read_bytes_from(
         self, offset: int, max_bytes: int = 1 << 24,

@@ -414,6 +414,11 @@ def run_worker(params: Params) -> ServingJob:
         backend,
         n_shards=params.get_int("shards", 8),
         checkpoint_interval_ms=params.get_int("checkPointInterval", 60_000),
+        # --pollInterval: journal poll cadence in seconds.  The update
+        # plane's read-your-writes latency rides on this (publish →
+        # ingest → queryable), so update-heavy fleets run it much tighter
+        # than the 100ms default
+        poll_interval_s=params.get_float("pollInterval", 0.1),
         host=params.get("host", "0.0.0.0"),
         port=params.get_int("port", 0),
         job_id=params.get("jobId", default_job_id),
@@ -440,6 +445,14 @@ def run_worker(params: Params) -> ServingJob:
         + f" ({state_name}) on port {job.port}",
         file=sys.stderr,
     )
+    # --updatePlane: co-locate the sharded online-SGD update worker with
+    # this serving shard (serve/update_plane.py).  Lazy import — the plane
+    # pulls in the SGD/metrics stack the plain serving path doesn't need.
+    if params.get_bool("updatePlane", False):
+        from . import update_plane
+        job._update_worker = update_plane.attach_update_worker(
+            job, params, worker_index, num_workers
+        )
     port_file = params.get("portFile")
     if port_file:
         # atomic publish: launchers poll on file size, a plain write lets

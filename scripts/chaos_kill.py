@@ -19,9 +19,16 @@ Knobs (env):
                            SIGKILL replicas mid-publish / mid-fold: every
                            surviving snapshot must still pass its checksum
                            gate, respawns must bootstrap from a snapshot,
-                           and clients see zero errors at R >= 2)
+                           and clients see zero errors at R >= 2),
+                           or "update" (run the sharded online-update
+                           plane under a sustained rating stream and
+                           SIGKILL co-located UpdateWorkers mid-batch:
+                           the sequence audit must show zero lost and
+                           zero double-applied ratings, and recovery goes
+                           through the standard replay-then-ready path)
     CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
                            history over few keys so the fold has work)
+    CHAOS_UPDATE_BATCH=200 ratings per producer tick (update mode)
     CHAOS_WORKERS=2        shards
     CHAOS_REPLICATION=2    replicas per shard (1 reproduces the reference's
                            single-owner outage behavior)
@@ -554,6 +561,142 @@ def snapshot_main() -> int:
     return 1 if failed else 0
 
 
+def update_main() -> int:
+    """SIGKILL co-located UpdateWorkers mid-stream under a sustained
+    rating load.  The cluster runs with the sharded update plane enabled
+    (--updatePlane) while a producer keeps routing ratings into the
+    per-partition logs; kills land while batches are in flight.
+    Contracts under test (serve/update_plane.py): flock leases hand the
+    dead worker's partitions to its sibling replica (or its respawned
+    self) at the committed watermarks, the sequence audit shows zero lost
+    and zero double-applied ratings, and recovery goes through the
+    standard supervisor replay-then-ready path."""
+    from flink_ms_tpu.serve import update_plane as up
+
+    rate_batch = int(os.environ.get("CHAOS_UPDATE_BATCH", 200))
+    base = tempfile.mkdtemp(prefix="tpums_chaos_update_")
+    journal, _keys = seed_journal(base)
+
+    sup = ReplicaSupervisor(
+        W, R, journal.dir, "models", os.path.join(base, "ports"),
+        state_backend="memory",
+        check_interval_s=registry.heartbeat_interval_s(),
+        respawn_delay_s=0.1,
+        extra_args=["--updatePlane", "true", "--pollInterval", "0.02"],
+    )
+    event("chaos_update_start", workers=W, replication=R,
+          group=sup.job_group, duration_s=DURATION_S,
+          kill_every_s=KILL_EVERY_S)
+    cli = up.UpdatePlaneClient(journal.dir, "models")
+    stop = threading.Event()
+    kills = []        # (t_kill, shard, replica, old_pid)
+    recoveries = []
+
+    def produce():
+        r = random.Random(9)
+        while not stop.is_set():
+            cli.submit_many(
+                [(r.randrange(N_USERS), r.randrange(N_USERS),
+                  round(r.uniform(0.5, 5.0), 3)) for _ in range(rate_batch)])
+            time.sleep(0.05)
+
+    def other_replicas_ready(shard, replica):
+        members = registry.resolve_replicas(sup.group_of(shard))
+        return any(e.get("replica") != replica and e.get("ready")
+                   for e in members)
+
+    def wait_recovered(shard, replica, old_pid, timeout_s=60.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            members = registry.resolve_replicas(sup.group_of(shard))
+            if any(e.get("replica") == replica and e.get("ready")
+                   and e.get("pid") not in (None, old_pid)
+                   for e in members):
+                return True
+            time.sleep(0.05)
+        return False
+
+    drained = False
+    with sup.start():
+        if not sup.wait_all_ready(120):
+            event("chaos_abort", reason="cluster never became ready")
+            return 2
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        t_end = time.time() + DURATION_S
+        next_kill = time.time() + (KILL_EVERY_S or float("inf"))
+        r = random.Random(42)
+        victim_cycle = 0
+        while time.time() < t_end:
+            time.sleep(0.05)
+            if not (KILL_EVERY_S and time.time() >= next_kill):
+                continue
+            # alternate replicas across shards; only kill when a sibling
+            # is ready to take the partitions over (R=1 exercises the
+            # respawn-resumes-own-watermark path instead)
+            shard = r.randrange(W)
+            replica = victim_cycle % R
+            victim_cycle += 1
+            proc = sup.procs.get((shard, replica))
+            if (proc is None or proc.poll() is not None
+                    or (R >= 2 and not other_replicas_ready(shard, replica))):
+                next_kill = time.time() + 0.25
+                continue
+            event("chaos_kill", shard=shard, replica=replica,
+                  pid=proc.pid, group=sup.group_of(shard))
+            proc.send_signal(signal.SIGKILL)
+            t_kill = time.time()
+            kills.append((t_kill, shard, replica, proc.pid))
+            if wait_recovered(shard, replica, proc.pid):
+                rec = round(time.time() - t_kill, 2)
+                event("chaos_recovery", shard=shard, replica=replica,
+                      recovery_s=rec)
+                recoveries.append(rec)
+            else:
+                event("chaos_recovery", shard=shard, replica=replica,
+                      recovery_s=None)
+                recoveries.append(None)
+            next_kill = time.time() + KILL_EVERY_S * (0.5 + r.random())
+        stop.set()
+        producer.join(timeout=30)
+        cli.sync()
+        submitted = sum(cli.totals().values())
+        # drain: every submitted rating must reach a committed apply
+        # record while the (respawned) fleet is still up
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            wm = up.applied_watermarks(journal.dir, "models")
+            if sum(wm.values()) >= submitted:
+                drained = True
+                break
+            time.sleep(0.1)
+
+    audit = up.audit_partitions(journal.dir, "models")
+    recovered = [rec for rec in recoveries if rec is not None]
+    summary = {
+        "mode": "update", "workers": W, "replication": R,
+        "duration_s": DURATION_S,
+        "submitted": audit["submitted"], "applied": audit["applied"],
+        "lost": audit["lost"], "duplicates": audit["duplicates"],
+        "audit_clean": audit["clean"],
+        "drained": drained,
+        "kills": len(kills), "respawns": sup.respawns,
+        "recovery_s": recoveries,
+        "timeline": [e for e in recent_events()
+                     if e["kind"].startswith(("chaos_", "replica_"))],
+    }
+    print(json.dumps(summary, indent=1))
+    failed = (
+        audit["lost"] > 0                      # a rating vanished
+        or audit["duplicates"] > 0             # a rating applied twice
+        or not drained                         # the plane wedged
+        or not kills                           # the chaos never happened
+        or len(recovered) < len(kills)         # a respawn never came back
+    )
+    return 1 if failed else 0
+
+
 if __name__ == "__main__":
     sys.exit({"elastic": elastic_main,
-              "snapshot": snapshot_main}.get(MODE, main)())
+              "snapshot": snapshot_main,
+              "update": update_main}.get(MODE, main)())
