@@ -39,7 +39,7 @@ BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
-serving_native,serving_update_plane; default all),
+serving_native,serving_update_plane,serving_rollout; default all),
 BENCH_UPDATE_USERS / BENCH_UPDATE_FLEET_RATINGS / BENCH_UPDATE_BATCH /
 BENCH_UPDATE_PROBES (online update plane: fleet updates/s vs the
 single-consumer baseline, 2->4 reshard audit, submit->queryable p99),
@@ -1120,7 +1120,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "BENCH_SECTIONS",
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
-        "serving_native,serving_update_plane"
+        "serving_native,serving_update_plane,serving_rollout"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1199,6 +1199,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_native", "run_serving_native_section",
          lambda f: f(small)),
         ("serving_update_plane", "run_serving_update_plane_section",
+         lambda f: f(small)),
+        ("serving_rollout", "run_serving_rollout_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

@@ -2502,3 +2502,183 @@ def run_serving_update_plane_section(small: bool) -> dict:
             else:
                 os.environ[key] = val
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_serving_rollout_section(small: bool) -> dict:
+    """Multi-tenant rollout plane (serve/rollout.py + serve/admission.py),
+    two arms.  Arm 1 — blue/green model swap under sustained in-flight
+    load: cutover and rollback wall time, client-visible errors (the
+    contract pinned by tests/test_rollout.py is ZERO), and whether
+    rollback restored the previous model's answers.  Arm 2 — goodput
+    under shed: an abusive tenant offers well over its admission quota
+    against the same live group while in-quota traffic keeps flowing;
+    reports in-quota availability (target >= 99.9%), the abusive
+    tenant's served/shed split, and the fleet scrape's shed_per_s /
+    admission_pressure autoscaler signals (obs/scrape.fleet_signals)."""
+    import threading
+
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.obs.scrape import fleet_signals, scrape_fleet
+    from flink_ms_tpu.serve.admission import SHED_MARKER
+    from flink_ms_tpu.serve.client import RetryPolicy
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve.elastic import ElasticClient
+    from flink_ms_tpu.serve.journal import Journal
+    from flink_ms_tpu.serve.rollout import RolloutController
+
+    n_users = int(
+        os.environ.get("BENCH_ROLLOUT_USERS", 300 if small else 2_000))
+    window_s = float(
+        os.environ.get("BENCH_ROLLOUT_WINDOW_S", 2 if small else 6))
+    abuse_qps = float(os.environ.get("BENCH_ROLLOUT_ABUSE_QPS", 50))
+
+    tmp = tempfile.mkdtemp(prefix="bench_rollout_")
+    saved = {key: os.environ.get(key) for key in
+             ("TPUMS_HEARTBEAT_S", "TPUMS_REPLICA_TTL_S",
+              "TPUMS_REGISTRY_DIR", "TPUMS_ADMIT_TENANT_QPS")}
+    os.environ["TPUMS_HEARTBEAT_S"] = "0.2"
+    os.environ["TPUMS_REPLICA_TTL_S"] = "1.2"
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    # the abusive tenant's quota, baked into every worker's admission
+    # controller at spawn time; untenanted (in-quota) traffic stays
+    # unlimited, so arm 2's availability split is purely the shedder's
+    os.environ["TPUMS_ADMIT_TENANT_QPS"] = f"abuse={abuse_qps:g}"
+    out = {}
+    try:
+        dim = 8
+
+        def _seed_model(name: str, seed_val: int) -> Journal:
+            j = Journal(os.path.join(tmp, f"bus-{name}"), "models")
+            rng = np.random.default_rng(seed_val)
+            j.append(
+                [F.format_als_row(u, "U", rng.normal(size=dim))
+                 for u in range(n_users)]
+                + [F.format_als_row(i, "I", rng.normal(size=dim))
+                   for i in range(n_users)])
+            return j
+
+        j1, j2 = _seed_model("v1", 0), _seed_model("v2", 1)
+        keys = [f"{u}-U" for u in range(n_users)]
+
+        ctl = RolloutController(
+            "bench-rollout", port_dir=os.path.join(tmp, "ports"),
+            journal_dir=j1.dir, topic="models", ready_timeout_s=180)
+        counts = {"ok": 0, "err": 0}
+        stop = threading.Event()
+
+        def load():
+            rnd = np.random.default_rng(2)
+            with ElasticClient(
+                    "bench-rollout", timeout_s=10,
+                    retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                      max_backoff_s=0.5)) as c:
+                while not stop.is_set():
+                    key = keys[int(rnd.integers(len(keys)))]
+                    try:
+                        if c.query_state(ALS_STATE, key) is None:
+                            counts["err"] += 1
+                        else:
+                            counts["ok"] += 1
+                    except Exception:
+                        counts["err"] += 1
+
+        abuse = {"served": 0, "shed": 0, "err": 0}
+
+        def abuse_load():
+            rnd = np.random.default_rng(3)
+            with ElasticClient(
+                    "bench-rollout", timeout_s=10, tenant="abuse",
+                    retry=RetryPolicy(attempts=2, backoff_s=0.01,
+                                      max_backoff_s=0.1)) as c:
+                while not stop.is_set():
+                    key = keys[int(rnd.integers(len(keys)))]
+                    try:
+                        c.query_state(ALS_STATE, key)
+                        abuse["served"] += 1
+                    except Exception as e:
+                        if SHED_MARKER in repr(e):
+                            abuse["shed"] += 1
+                        else:
+                            abuse["err"] += 1
+
+        try:
+            rec = ctl.rollout(j1.dir, "models", model_id="v1", shards=2)
+            assert rec["gen"] == 1, "bootstrap rollout failed"
+            probe_key = keys[0]
+            with ElasticClient("bench-rollout", timeout_s=10) as probe:
+                v1_answer = probe.query_state(ALS_STATE, probe_key)
+            th = threading.Thread(target=load, daemon=True)
+            th.start()
+            time.sleep(window_s / 2)
+
+            # -- arm 1: blue/green swap + rollback under live traffic
+            t0 = time.time()
+            ctl.rollout(j2.dir, "models", model_id="v2",
+                        verify_min_rows=2 * n_users)
+            cutover_s = time.time() - t0
+            time.sleep(window_s / 2)
+            t0 = time.time()
+            ctl.rollback()
+            rollback_s = time.time() - t0
+            with ElasticClient("bench-rollout", timeout_s=10) as probe:
+                restored = probe.query_state(ALS_STATE, probe_key)
+
+            # -- arm 2: overload the abusive tenant, watch goodput
+            before_fleet = scrape_fleet()["fleet"]
+            t_before = time.time()
+            ath = threading.Thread(target=abuse_load, daemon=True)
+            ath.start()
+            mark = (counts["ok"], counts["err"])
+            time.sleep(window_s)
+            inq_ok = counts["ok"] - mark[0]
+            inq_err = counts["err"] - mark[1]
+            stop.set()
+            th.join(timeout=30)
+            ath.join(timeout=30)
+            after_fleet = scrape_fleet()["fleet"]
+            sig = fleet_signals(before_fleet, after_fleet,
+                                dt_s=time.time() - t_before)
+        finally:
+            stop.set()
+            ctl.stop(drop_topology=True)
+
+        total = counts["ok"] + counts["err"]
+        out["serving_rollout_queries"] = total
+        out["serving_rollout_errors"] = counts["err"]
+        out["serving_rollout_availability"] = (
+            round(counts["ok"] / total, 6) if total else None)
+        out["serving_rollout_cutover_s"] = round(cutover_s, 2)
+        out["serving_rollout_rollback_s"] = round(rollback_s, 2)
+        out["serving_rollout_rollback_restored"] = restored == v1_answer
+        inq_total = inq_ok + inq_err
+        out["serving_rollout_inquota_queries"] = inq_total
+        out["serving_rollout_inquota_errors"] = inq_err
+        out["serving_rollout_inquota_availability"] = (
+            round(inq_ok / inq_total, 6) if inq_total else None)
+        out["serving_rollout_abuse_quota_qps"] = abuse_qps
+        out["serving_rollout_abuse_served"] = abuse["served"]
+        out["serving_rollout_abuse_shed"] = abuse["shed"]
+        out["serving_rollout_abuse_other_errors"] = abuse["err"]
+        out["serving_rollout_shed_per_s"] = round(sig["shed_per_s"], 2)
+        out["serving_rollout_admission_pressure"] = round(
+            sig["admission_pressure"], 4)
+        _log(f"[bench:rollout] {total} queries, {counts['err']} errors, "
+             f"cutover {out['serving_rollout_cutover_s']}s, rollback "
+             f"{out['serving_rollout_rollback_s']}s (restored="
+             f"{out['serving_rollout_rollback_restored']}); shed arm: "
+             f"in-quota avail {out['serving_rollout_inquota_availability']}"
+             f", abuse served/shed {abuse['served']}/{abuse['shed']}, "
+             f"shed_per_s {out['serving_rollout_shed_per_s']}, pressure "
+             f"{out['serving_rollout_admission_pressure']}")
+        return out
+    except Exception:
+        _log(traceback.format_exc())
+        out["serving_rollout_error"] = traceback.format_exc(limit=3)
+        return out
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        shutil.rmtree(tmp, ignore_errors=True)

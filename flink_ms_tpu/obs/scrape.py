@@ -148,7 +148,18 @@ def fleet_signals(before: dict, after: dict,
          "p99_s":          interpolated p99 of the window's query-verb
                            latency observations (None with no traffic),
          "backlog_bytes":  fleet ingest backlog at AFTER (gauge level),
+         "shed_per_s":     admission-shed requests/s over the window
+                           (``tpums_admission_shed_total`` delta across
+                           all tenants/verbs — serve/admission.py),
+         "admission_pressure": worst per-tenant bucket drain in [0, 1]
+                           at AFTER (max ``tpums_admission_pressure``,
+                           saturating at 1.0 — fleet merges sum the
+                           gauge across replicas of the same tenant),
          "dt_s", "requests": the window itself}
+
+    The admission fields make the shedder and the autoscaler act on the
+    same numbers: sustained shed with low pressure elsewhere means a hot
+    tenant, shed AND high qps means the fleet itself needs more shards.
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -179,10 +190,24 @@ def fleet_signals(before: dict, after: dict,
         g["value"] for g in after.get("gauges", [])
         if g["name"] == "tpums_journal_backlog_bytes"
     )
+
+    def _shed_total(snap: dict) -> float:
+        return sum(c["value"] for c in snap.get("counters", [])
+                   if c["name"] == "tpums_admission_shed_total")
+
+    shed = max(_shed_total(after) - _shed_total(before), 0.0)
+    # the fleet merge SUMS same-labeled gauges across replicas, so a
+    # tenant drained on several shards at once overshoots 1.0 — clamp:
+    # the signal saturates at "some bucket is empty somewhere"
+    pressure = min(max(
+        (g["value"] for g in after.get("gauges", [])
+         if g["name"] == "tpums_admission_pressure"), default=0.0), 1.0)
     return {
         "qps": requests / dt_s,
         "p99_s": snapshot_quantile(window, 99) if window else None,
         "backlog_bytes": backlog,
+        "shed_per_s": shed / dt_s,
+        "admission_pressure": pressure,
         "dt_s": dt_s,
         "requests": requests,
     }

@@ -61,9 +61,20 @@ DISRUPTIVE_KINDS = frozenset({
     "elastic_scale_start", "elastic_cutover", "elastic_drained",
     "elastic_scale_abort", "generation_swap", "failover",
     "replica_respawn", "autoscale_decision",
+    # model rollout protocol (serve/rollout.py — the elastic cutover
+    # kinds under the rollout controller's event prefix)
+    "rollout_scale_start", "rollout_cutover", "rollout_drained",
+    "rollout_scale_abort", "rollout_verified", "rollout_rollback",
 })
 
 DEFAULT_ATTRIBUTION_WINDOW_S = 5.0
+
+# an admission-shed request errors with this marker in the reply
+# (serve/admission.py SHED_REPLY — string-matched here rather than
+# imported so the obs layer stays importable without the serving stack).
+# Sheds attribute to a synthetic ``admission_shed`` cause: deliberate
+# policy, never an unexplained failure.
+ADMISSION_SHED_MARKER = "over quota"
 
 
 @dataclass(frozen=True)
@@ -381,8 +392,14 @@ def build_report(
     attributed = 0
     error_samples_out = []
     for s in getattr(recorder, "error_samples", []):
-        cause = _attribute_time(s.get("ts", 0.0), timeline, phases,
-                                attribution_window_s)
+        if ADMISSION_SHED_MARKER in str(s.get("error") or ""):
+            # shed by admission control: the cause is the policy itself,
+            # not any timeline event — an over-quota tenant being bounced
+            # is the system WORKING, and must never read as unattributed
+            cause = {"kind": "admission_shed"}
+        else:
+            cause = _attribute_time(s.get("ts", 0.0), timeline, phases,
+                                    attribution_window_s)
         if cause is not None:
             attributed += 1
         error_samples_out.append(dict(s, attributed_to=cause))

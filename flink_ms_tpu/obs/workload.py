@@ -35,7 +35,8 @@ CLI::
 
     python -m flink_ms_tpu.obs.workload --rehearsal [--out SLO_REPORT.json]
         [--shards 2 --replication 2 --durationS 12 --baseQps 120
-         --burstQps 480 --autoscale live|dry|off --kill 1 --seed 0]
+         --burstQps 480 --autoscale live|dry|off --kill 1 --seed 0
+         --abusiveQps 0]   # >0: add an over-quota "abuse" tenant on top
     python -m flink_ms_tpu.obs.workload --group <topology-group> ...
         # attach mode: drive load + report against an ALREADY-RUNNING
         # elastic group instead of spawning one (no kill, no autoscaler)
@@ -356,6 +357,11 @@ class ServingOps:
 
     ``execute`` returns False for a semantic miss (every seeded key must
     resolve) and raises on transport errors; both count as request errors.
+
+    Verbs may carry a tenant tag — ``"GET~abuse"`` — resolved through
+    ``client_factories[tag]`` to a per-tag (per-tenant) client, so one
+    engine drives a multi-tenant blend and the recorder's per-verb stats
+    split by tenant for free.
     """
 
     VERBS = ("GET", "MGET", "TOPK", "TOPKV", "UPDATE")
@@ -363,8 +369,12 @@ class ServingOps:
     def __init__(self, client_factory: Callable[[], object], keys: ZipfKeys,
                  state: str, journal=None, dim: int = 4,
                  mget_size: int = 4, topk_k: int = 8, topkv_users: int = 2,
-                 update_plane=None):
+                 update_plane=None,
+                 client_factories: Optional[Dict[str, Callable]] = None):
         self.client_factory = client_factory
+        # tag -> factory for tenant-tagged verbs; "" is the untagged default
+        self.client_factories = dict(client_factories or {})
+        self.client_factories.setdefault("", client_factory)
         self.keys = keys
         self.state = state
         self.journal = journal
@@ -380,15 +390,21 @@ class ServingOps:
         self._tl = threading.local()
         self._journal_lock = threading.Lock()
 
-    def _client(self):
-        c = getattr(self._tl, "client", None)
+    def _client(self, tag: str = ""):
+        clients = getattr(self._tl, "clients", None)
+        if clients is None:
+            clients = self._tl.clients = {}
+        c = clients.get(tag)
         if c is None:
-            c = self.client_factory()
-            self._tl.client = c
+            factory = self.client_factories.get(tag)
+            if factory is None:
+                raise ValueError(f"no client factory for verb tag {tag!r}")
+            c = clients[tag] = factory()
         return c
 
     def execute(self, verb: str, rng: random.Random) -> bool:
-        c = self._client()
+        verb, _, tag = verb.partition("~")
+        c = self._client(tag)
         if verb == "GET":
             return c.query_state(
                 self.state, f"{self.keys.sample(rng)}-U") is not None
@@ -427,15 +443,16 @@ class ServingOps:
         raise ValueError(f"unknown verb {verb!r}")
 
     def close_local(self) -> None:
-        """Close THIS thread's client (each engine worker calls it on the
+        """Close THIS thread's clients (each engine worker calls it on the
         way out)."""
-        c = getattr(self._tl, "client", None)
-        if c is not None:
-            self._tl.client = None
-            try:
-                c.close()
-            except Exception:
-                pass
+        clients = getattr(self._tl, "clients", None)
+        if clients:
+            self._tl.clients = {}
+            for c in clients.values():
+                try:
+                    c.close()
+                except Exception:
+                    pass
 
 
 class WorkloadEngine:
@@ -579,7 +596,14 @@ _TIMELINE_KINDS = (
     "elastic_scale_start", "elastic_cutover", "elastic_drained",
     "elastic_scale_abort", "generation_swap", "failover",
     "replica_respawn", "autoscale_decision",
+    "rollout_scale_start", "rollout_cutover", "rollout_drained",
+    "rollout_scale_abort", "rollout_verified", "rollout_rollback",
 )
+
+# query verbs an abusive tenant replays (UPDATE rides the journal/update
+# plane, not the admission-controlled query path)
+_ABUSE_VERBS = ("GET", "MGET", "TOPK", "TOPKV")
+ABUSIVE_TENANT = "abuse"
 
 
 def _seed_journal(base: str, topic: str, users: int, dim: int, seed: int):
@@ -623,6 +647,7 @@ def run_rehearsal(
     attach_group: Optional[str] = None,
     zipf_exponent: float = 1.1,
     update_plane: bool = True,
+    abusive_qps: float = 0.0,
 ) -> dict:
     """The closed loop: elastic sharded group + open-loop zipfian mixed-verb
     engine + autoscaler + one chaos kill, all acting on the same fleet,
@@ -632,6 +657,16 @@ def run_rehearsal(
     With ``attach_group`` set, drives load against an already-running
     elastic group instead (no spawn, no kill, no autoscaler) — the
     operator-facing smoke mode.
+
+    With ``abusive_qps > 0`` the blend becomes two-tenant: a second,
+    ``~abuse``-tagged replay of the query verbs is layered ON TOP of the
+    in-quota schedule (in-quota offered rates are unchanged) and the
+    ``abuse`` tenant's admission quota is set to HALF its base offered
+    rate (``TPUMS_ADMIT_TENANT_QPS``), so it runs persistently over quota
+    while the untagged tenant stays unlimited.  Abusive verbs carry
+    objective-free SLO entries — their sheds are attributed
+    (``admission_shed``), not breached — and the report's gate becomes
+    "in-quota traffic unharmed while the abuser is shed".
     """
     from . import slo as obs_slo
     from .scrape import scrape_fleet
@@ -641,15 +676,36 @@ def run_rehearsal(
     if autoscale not in ("off", "dry", "live"):
         raise ValueError("autoscale must be off|dry|live")
 
-    mix = VerbMix(dict(verb_weights or DEFAULT_VERB_WEIGHTS))
+    weights = dict(verb_weights or DEFAULT_VERB_WEIGHTS)
+    if abusive_qps > 0:
+        q_weights = {v: w for v, w in weights.items() if v in _ABUSE_VERBS}
+        if not q_weights:
+            raise ValueError("abusive tenant needs at least one query verb "
+                             "in the mix")
+        # layer the abusive replay on top: schedule rates grow by
+        # (1 + abusive/base) and the tagged share is sized so the UNTAGGED
+        # offered rates match the caller's base/peak/burst exactly while
+        # the abuser offers abusive_qps at base (scaling with the plan)
+        k = abusive_qps / base_qps
+        scale = k * sum(weights.values()) / sum(q_weights.values())
+        for v, w in q_weights.items():
+            weights[f"{v}~{ABUSIVE_TENANT}"] = w * scale
+        base_qps, peak_qps, burst_qps = (
+            base_qps * (1 + k), peak_qps * (1 + k), burst_qps * (1 + k))
+    mix = VerbMix(weights)
     schedule = PhaseSchedule.ramp_burst(
         base_qps, peak_qps, burst_qps, warm_s, ramp_s, burst_s, cool_s)
     if spec is None:
-        spec = obs_slo.SLOSpec.default(sorted(mix.weights))
+        spec = obs_slo.SLOSpec(
+            list(obs_slo.SLOSpec.default(
+                sorted(v for v in mix.weights if "~" not in v)).objectives)
+            + [obs_slo.SLOObjective(verb=v, availability=None, p99_ms=None,
+                                    burn_rate_max=None, goodput_min=None)
+               for v in sorted(mix.weights) if "~" in v])
 
     saved_env = {k: os.environ.get(k) for k in
                  ("TPUMS_REGISTRY_DIR", "TPUMS_HEARTBEAT_S",
-                  "TPUMS_REPLICA_TTL_S")}
+                  "TPUMS_REPLICA_TTL_S", "TPUMS_ADMIT_TENANT_QPS")}
     base = tempfile.mkdtemp(prefix="tpums_rehearsal_")
     ctl = None
     autoscaler = None
@@ -674,6 +730,11 @@ def run_rehearsal(
             if saved_env["TPUMS_REGISTRY_DIR"] is None:
                 os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(
                     base, "registry")
+            if abusive_qps > 0:
+                # quota = half the abuser's base offered rate: persistently
+                # 2x over quota, so the shedder works for the whole run
+                os.environ["TPUMS_ADMIT_TENANT_QPS"] = (
+                    f"{ABUSIVE_TENANT}={abusive_qps / 2:g}")
             from ..serve.elastic import (Autoscaler, AutoscalerPolicy,
                                          ScaleController)
 
@@ -717,13 +778,28 @@ def run_rehearsal(
                 retry=RetryPolicy(attempts=6, backoff_s=0.02,
                                   max_backoff_s=0.5))
 
+        client_factories = None
+        if abusive_qps > 0:
+            def abusive_factory():
+                from ..serve.elastic import ElasticClient
+                # tenant= rides the wire (tab: trailing tn= field; B2:
+                # HELLO-bound); sheds come back as "E\tover quota"
+                # RuntimeErrors, which the HA client does NOT failover on
+                return ElasticClient(
+                    live_group, timeout_s=10.0,
+                    retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                      max_backoff_s=0.5),
+                    tenant=ABUSIVE_TENANT)
+            client_factories = {ABUSIVE_TENANT: abusive_factory}
+
         upd_client = None
         if update_plane and journal is not None:
             from ..serve.update_plane import UpdatePlaneClient
             upd_client = UpdatePlaneClient(journal.dir, "models")
         ops = ServingOps(client_factory, ZipfKeys(users, zipf_exponent, seed),
                          ALS_STATE, journal=journal, dim=dim,
-                         update_plane=upd_client)
+                         update_plane=upd_client,
+                         client_factories=client_factories)
         recorder = WorkloadRecorder()
         engine = WorkloadEngine(ops, schedule, mix, recorder=recorder,
                                 threads=threads, seed=seed,
@@ -815,6 +891,7 @@ def run_rehearsal(
                 "users": users,
                 "zipf_exponent": zipf_exponent,
                 "seed": seed,
+                "abusive_qps": abusive_qps,
             },
         )
         if out_path:
@@ -874,6 +951,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         group=params.get("newGroup", "rehearsal"),
         attach_group=params.get("group", None),
         zipf_exponent=float(params.get("zipf", "1.1")),
+        abusive_qps=float(params.get("abusiveQps", "0")),
     )
     sys.stderr.write(obs_slo.human_summary(report) + "\n")
     print(json.dumps({

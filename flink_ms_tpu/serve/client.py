@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..obs import tracing as obs_tracing
+from . import admission as admission_ctl
 from . import proto as wire_proto
 
 
@@ -73,10 +74,19 @@ class QueryClient:
         job_id: Optional[str] = None,
         retry: Optional[RetryPolicy] = None,
         proto: Optional[str] = None,
+        tenant: Optional[str] = None,
     ):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        # tenancy (serve/admission.py): OFF by default.  With a tenant set
+        # (explicitly, or ambiently via TPUMS_TENANT) tab requests carry a
+        # trailing ``tn=<tenant>`` field and the B2 HELLO binds the tenant
+        # to the connection; with no tenant the wire is byte-identical to
+        # the seed protocol (the same opt-in contract as trace ids)
+        if tenant is None:
+            tenant = os.environ.get("TPUMS_TENANT", "")
+        self.tenant = tenant.strip() or None
         self.job_id = job_id  # accepted for reference-CLI parity; the local
         # lookup server serves a single job, so the id is informational
         self.retry = retry or RetryPolicy()
@@ -102,7 +112,14 @@ class QueryClient:
         self._binary = False
         self._frame_reader = None
         if self.proto in ("b2", "auto"):
-            sock.sendall(wire_proto.HELLO_LINE.encode("utf-8") + b"\n")
+            # with a tenant, the HELLO carries it (connection-scoped — B2
+            # records have fixed field counts); an old server refuses the
+            # extended line exactly like a plain HELLO, so auto mode still
+            # falls back to tab, where the tenant rides per-request
+            hello = wire_proto.HELLO_LINE if self.tenant is None else (
+                f"{wire_proto.HELLO_LINE}\t"
+                f"{admission_ctl.TENANT_FIELD}{self.tenant}")
+            sock.sendall(hello.encode("utf-8") + b"\n")
             line = self._rfile.readline()
             if not line:
                 raise ConnectionError(
@@ -137,7 +154,12 @@ class QueryClient:
         tid = obs_tracing.current_trace()
         if tid is not None:
             t0 = time.perf_counter()
-        data = request.encode("utf-8") + b"\n"
+        # tenant field first, tid last: the server pops tid, then tenant
+        # (serve/server.py _dispatch_parts).  No tenant -> ``line`` IS the
+        # request and the wire stays byte-identical to the seed protocol.
+        line = request if self.tenant is None else (
+            f"{request}\t{admission_ctl.TENANT_FIELD}{self.tenant}")
+        data = line.encode("utf-8") + b"\n"
         failures = 0
         while True:
             try:
@@ -153,7 +175,7 @@ class QueryClient:
                             "for a 1-record request")
                     return texts[0]
                 wire = data if tid is None else (
-                    f"{request}\t{obs_tracing.TID_FIELD}{tid}\n"
+                    f"{line}\t{obs_tracing.TID_FIELD}{tid}\n"
                     .encode("utf-8"))
                 self._sock.sendall(wire)
                 line = self._rfile.readline()
@@ -286,6 +308,11 @@ class QueryClient:
                         f"expected {expect}")
                 replies.extend(texts)
             return replies
+        if self.tenant is not None:
+            # tab plane: tenant per request (before the tid, same order as
+            # _roundtrip, so the server's two pops compose)
+            tsuffix = f"\t{admission_ctl.TENANT_FIELD}{self.tenant}"
+            requests = [req + tsuffix for req in requests]
         tid = obs_tracing.current_trace()
         if tid is not None:
             # one tid for the whole window: the server's per-request span

@@ -132,7 +132,10 @@ class ScaleController:
         snapshots: Optional[bool] = None,
         snapshot_min_bytes: Optional[int] = None,
     ):
-        self.group = group
+        # tenant-scope the group (registry.qualify_group is idempotent and
+        # a no-op without TPUMS_TENANT, so single-tenant callers see the
+        # exact pre-tenancy behavior)
+        self.group = registry.qualify_group(group)
         self.journal_dir = journal_dir
         self.topic = topic
         self.port_dir = port_dir or tempfile.mkdtemp(prefix="tpums_elastic_")
@@ -170,10 +173,15 @@ class ScaleController:
             return None
         return self.supervisors.get(int(topo["gen"]))
 
+    # event-kind namespace: subclasses operating a different protocol on
+    # the same machinery announce under their own prefix so the SLO layer
+    # can tell a reshape from a model rollout (serve/rollout.py)
+    _EVENT_PREFIX = "elastic"
+
     def _event(self, kind: str, **fields) -> None:
         self.events.append({"t": time.time(), "kind": kind, **fields})
-        obs_tracing.events_counter(f"elastic_{kind}", group=self.group,
-                                   **fields)
+        obs_tracing.events_counter(f"{self._EVENT_PREFIX}_{kind}",
+                                   group=self.group, **fields)
 
     # -- lease -------------------------------------------------------------
 
@@ -222,10 +230,30 @@ class ScaleController:
             extra_args=extra, env=self._env,
         )
 
-    def scale_to(self, shards: int, replicas: Optional[int] = None) -> dict:
+    def _verify_generation(self, gen: int,
+                           sup: ReplicaSupervisor) -> None:
+        """Pre-publish verification gate, called after the all-ready
+        barrier and before the CAS publish — subclass hook (the rollout
+        controller row-counts and MSE-probes the warming model here,
+        serve/rollout.py).  Raising aborts the cutover: the warming
+        generation is torn down and the active topology stays untouched."""
+
+    def _publish_topology(self, shards: int, replicas: int, *,
+                          expect_gen: int) -> dict:
+        """The CAS publish — subclass hook (the rollout controller
+        attaches the generation's model binding)."""
+        return registry.publish_topology(
+            self.group, shards, replicas, expect_gen=expect_gen)
+
+    def scale_to(self, shards: int, replicas: Optional[int] = None,
+                 force: bool = False) -> dict:
         """Rescale the group to ``shards`` x ``replicas`` -> the published
         topology record.  Also the bootstrap path: the first call on a
         fresh group publishes generation 1.
+
+        ``force`` builds generation g+1 even when the shape is unchanged —
+        the model-rollout path, where g+1 differs by WHAT it serves, not
+        by its shape (serve/rollout.py).
 
         Raises ``ControllerBusy`` (lease held), ``ScaleError`` (the new
         generation never became ready — it is torn down and the active
@@ -239,7 +267,7 @@ class ScaleController:
         try:
             topo = self.current()
             cur_gen = int(topo["gen"]) if topo else 0
-            if topo and int(topo["shards"]) == shards and \
+            if topo and not force and int(topo["shards"]) == shards and \
                     int(topo["replicas"]) == replicas:
                 return topo  # already the requested shape
             gen = cur_gen + 1
@@ -269,9 +297,13 @@ class ScaleController:
                     f"{self.ready_timeout_s:.0f}s — aborting, generation "
                     f"{cur_gen} stays active"
                 )
+            # pre-publish verification gate (no-op here; the rollout
+            # controller validates the warming MODEL before it can win)
+            self._verify_generation(gen, new_sup)
+            registry.refresh_controller_lease(self.group, token)
             # atomic cutover: from here on resolvers see the new shape
-            record = registry.publish_topology(
-                self.group, shards, replicas, expect_gen=cur_gen)
+            record = self._publish_topology(
+                shards, replicas, expect_gen=cur_gen)
             self.supervisors[gen] = new_sup
             self.warming = None
             new_sup = None  # ownership transferred; don't tear down
@@ -357,7 +389,9 @@ class ElasticClient:
         resolve_timeout_s: float = 30.0,
         **client_kw,
     ):
-        self.group = group
+        # same tenant scoping as the controller: with TPUMS_TENANT set,
+        # client and controller resolve the same qualified record
+        self.group = registry.qualify_group(group)
         self.timeout_s = timeout_s
         self.retry = retry
         self.refresh_s = (

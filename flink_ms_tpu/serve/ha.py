@@ -153,6 +153,7 @@ class HAShardedClient:
         cooldown_s: Optional[float] = None,
         seq_fanout_keys: int = 8,
         proto: Optional[str] = None,
+        tenant: Optional[str] = None,
     ):
         if num_workers < 1:
             raise ValueError("need at least one shard")
@@ -170,6 +171,9 @@ class HAShardedClient:
         # fleet setting — mixed old/new replicas each negotiate what they
         # speak, and a failover reconnect renegotiates per endpoint.
         self.proto = proto
+        # tenant identity stamped by every per-replica QueryClient
+        # (serve/admission.py); None defers to TPUMS_TENANT in the client
+        self.tenant = tenant
         # failover budget: enough attempts to visit every replica of a
         # small set twice, with fast bounded backoff — a lone kill at R=2
         # must be absorbed inside one client call
@@ -245,7 +249,7 @@ class HAShardedClient:
             # time spent discovering it's dead
             c = QueryClient(ep[0], ep[1], timeout_s=self.timeout_s,
                             retry=RetryPolicy(attempts=1),
-                            proto=self.proto)
+                            proto=self.proto, tenant=self.tenant)
             ss.clients[ep] = c
         return c
 

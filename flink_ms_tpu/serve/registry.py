@@ -261,6 +261,124 @@ def resolve_replicas(replica_of: str) -> List[dict]:
     return members
 
 
+# ---------------------------------------------------------------------------
+# tenant namespaces (the multi-tenant fleet, serve/rollout.py + admission)
+# ---------------------------------------------------------------------------
+
+# A tenant is a NAME PREFIX on group/job identifiers: ``acme::als`` is
+# tenant "acme"'s serving group "als".  Everything derived from the group
+# string — worker job ids, replica groups, generation groups, topology
+# records, controller leases, snapshot scopes — inherits the prefix, so
+# two tenants' fleets coexist in one registry directory with zero shared
+# records and per-tenant GC that provably cannot touch a neighbor.
+
+TENANT_SEP = "::"
+
+
+def default_tenant() -> Optional[str]:
+    """The ambient tenant (``TPUMS_TENANT``), or None for the shared
+    (un-prefixed) namespace — the single-tenant deployments' default."""
+    t = os.environ.get("TPUMS_TENANT", "").strip()
+    return t or None
+
+
+def qualify_group(group: str, tenant: Optional[str] = None) -> str:
+    """Tenant-scope a group name -> ``<tenant>::<group>``.
+
+    ``tenant=None`` uses the ambient ``TPUMS_TENANT``; an explicit empty
+    string pins the shared namespace regardless of environment.  Already
+    qualified names pass through unchanged (idempotent, so controllers
+    and clients can both call it on the same name)."""
+    if TENANT_SEP in group:
+        return group
+    t = default_tenant() if tenant is None else (tenant.strip() or None)
+    if not t:
+        return group
+    if TENANT_SEP in t or "/" in t or "\t" in t or "\n" in t:
+        raise ValueError(f"bad tenant name: {t!r}")
+    return f"{t}{TENANT_SEP}{group}"
+
+
+def split_tenant(name: str) -> Tuple[Optional[str], str]:
+    """``"acme::als@g3/shard-0"`` -> ("acme", "als@g3/shard-0");
+    un-prefixed names -> (None, name)."""
+    if TENANT_SEP in name:
+        t, _, base = name.partition(TENANT_SEP)
+        return (t or None), base
+    return None, name
+
+
+def tenant_of(name: str) -> Optional[str]:
+    return split_tenant(name)[0]
+
+
+def _entry_tenant(entry: dict) -> Optional[str]:
+    return tenant_of(entry.get("replica_of") or entry.get("job_id") or "")
+
+
+def list_tenants() -> List[str]:
+    """Tenants with any registry presence (live worker entries or
+    topology records), sorted.  The shared namespace is not a tenant and
+    is never listed."""
+    seen = set()
+    for e in list_jobs(gc=False):
+        t = _entry_tenant(e)
+        if t:
+            seen.add(t)
+    try:
+        names = os.listdir(registry_dir())
+    except OSError:
+        names = []
+    for name in names:
+        if not name.endswith(".topo.json"):
+            continue
+        rec = _read_record(os.path.join(registry_dir(), name), "topology")
+        if rec:
+            t = tenant_of(rec.get("group") or "")
+            if t:
+                seen.add(t)
+    return sorted(seen)
+
+
+def list_tenant_jobs(tenant: Optional[str], gc: bool = True) -> List[dict]:
+    """Live entries belonging to one tenant's namespace (``tenant=None``
+    selects the shared namespace)."""
+    return [e for e in list_jobs(gc=gc) if _entry_tenant(e) == tenant]
+
+
+def gc_tenant_entries(tenant: str) -> int:
+    """Reap DEAD worker entries of ONE tenant -> count reaped.
+
+    The isolation guarantee of the namespace scheme, stated as an
+    operation: this can only ever unlink entries whose identifiers carry
+    ``<tenant>::`` — other tenants and the shared namespace are
+    structurally out of reach.  Raw dir scan for the same reason as
+    ``gc_generation_entries``."""
+    if not tenant:
+        raise ValueError("gc_tenant_entries needs a tenant name")
+    reaped = 0
+    try:
+        names = os.listdir(registry_dir())
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(registry_dir(), name)
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(entry, dict) or "port" not in entry:
+            continue
+        if _entry_tenant(entry) != tenant:
+            continue
+        if entry_is_dead(entry) and _reap_if_unchanged(path, entry) is None:
+            reaped += 1
+    return reaped
+
+
 def _pid_is_ours_and_dead(entry: dict) -> bool:
     import socket
 
@@ -363,6 +481,7 @@ def publish_topology(
     *,
     expect_gen: Optional[int] = None,
     controller: Optional[str] = None,
+    extra: Optional[dict] = None,
 ) -> dict:
     """Atomically publish the group's next topology generation -> record.
 
@@ -372,7 +491,13 @@ def publish_topology(
     advanced the record meanwhile this raises ``TopologyConflict`` instead
     of overwriting the newer topology.  The superseded generation joins a
     bounded ``history`` (stale-generation GC: the record never grows past
-    ``TOPOLOGY_HISTORY`` entries).  NOT best-effort: I/O failures raise."""
+    ``TOPOLOGY_HISTORY`` entries).  NOT best-effort: I/O failures raise.
+
+    ``extra``: additional record fields (cannot shadow the protocol
+    fields).  The rollout controller binds the generation's MODEL here
+    (``{"model": {journal_dir, topic, model_id, ...}}``); a generation's
+    model binding follows it into ``history``, which is what makes
+    one-command rollback possible (serve/rollout.py)."""
     if shards < 1 or replicas < 1:
         raise ValueError("need shards >= 1 and replicas >= 1")
     os.makedirs(registry_dir(), exist_ok=True)
@@ -389,11 +514,14 @@ def publish_topology(
             )
         history = list(current.get("history", ())) if current else []
         if current:
-            history.append({
+            superseded = {
                 "gen": current["gen"], "shards": current["shards"],
                 "replicas": current["replicas"],
                 "published_at": current.get("published_at"),
-            })
+            }
+            if "model" in current:
+                superseded["model"] = current["model"]
+            history.append(superseded)
             history = history[-TOPOLOGY_HISTORY:]
         record = {
             "kind": "topology", "group": group, "gen": cur_gen + 1,
@@ -403,6 +531,9 @@ def publish_topology(
             or f"{socket.gethostname()}:{os.getpid()}",
             "history": history,
         }
+        if extra:
+            for k, v in extra.items():
+                record.setdefault(k, v)
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump(record, f)

@@ -104,6 +104,7 @@ from typing import Dict, Optional
 from ..core.formats import RangePayloadCache, gather_sorted, sort_dedup_last
 from ..obs import metrics as obs_metrics
 from ..obs import tracing as obs_tracing
+from . import admission as admission_ctl
 from . import proto
 from .table import ModelTable
 
@@ -146,10 +147,16 @@ class LookupServer:
         job_id: str = "local",
         topk_handlers: Optional[Dict[str, object]] = None,
         health_fn=None,
+        admission: Optional[admission_ctl.AdmissionController] = None,
     ):
         self.tables = tables
         self.job_id = job_id
         self.topk_handlers = topk_handlers or {}
+        # per-tenant admission control (serve/admission.py): None unless a
+        # TPUMS_ADMIT_* rate knob is set (or a controller is injected) —
+        # the admission-off hot path costs one attribute check
+        self.admission = (admission if admission is not None
+                          else admission_ctl.AdmissionController.from_env())
         # HEALTH verb provider: a callable -> dict describing the owning
         # job's liveness (ServingJob.health).  A bare server (tests, ad-hoc
         # tables) synthesizes a minimal always-ready report instead.
@@ -196,6 +203,11 @@ class LookupServer:
                 sock = self.connection
                 buf = bytearray()
                 eof = False
+                # tenant bound to THIS connection by an extended HELLO
+                # (``HELLO\tB2\ttn=<t>``) — the B2 record layout has no
+                # room for a per-request field, so on the binary plane
+                # tenancy is a connection property
+                conn_tenant = None
                 try:
                     while True:
                         # block for at least one complete line (or EOF)
@@ -235,10 +247,21 @@ class LookupServer:
                             raw = bytes(buf[:nl])
                             del buf[:nl + 1]
                             lines.append(raw.decode("utf-8"))
-                            if raw == proto.HELLO_LINE.encode("utf-8"):
+                            hello_b = proto.HELLO_LINE.encode("utf-8")
+                            if raw == hello_b or raw.startswith(
+                                    hello_b + b"\t"
+                                    + admission_ctl.TENANT_FIELD
+                                    .encode("utf-8")):
                                 # protocol switch: whatever follows the
                                 # HELLO line is already B2 frames — stop
-                                # line-splitting and leave it buffered
+                                # line-splitting and leave it buffered.
+                                # An extended HELLO binds its tenant to
+                                # the connection.
+                                if raw != hello_b:
+                                    conn_tenant = (
+                                        raw.decode("utf-8").split("\t")[2]
+                                        [len(admission_ctl.TENANT_FIELD):]
+                                        or None)
                                 hello = True
                                 break
                         if eof and buf and not hello:
@@ -277,7 +300,8 @@ class LookupServer:
                         except (BrokenPipeError, OSError):
                             return
                         if hello:
-                            outer._serve_binary(sock, self.wfile, buf, eof)
+                            outer._serve_binary(sock, self.wfile, buf, eof,
+                                                tenant=conn_tenant)
                             return
                         if eof:
                             return
@@ -390,7 +414,8 @@ class LookupServer:
         singles."""
         return self._dispatch_parts(line.split("\t"), burst)
 
-    def _dispatch_parts(self, parts, burst: int = 1, traced: bool = True):
+    def _dispatch_parts(self, parts, burst: int = 1, traced: bool = True,
+                        tenant: Optional[str] = None):
         """Dispatch over already-split fields — the shared core of the tab
         line loop and the B2 frame loop (binary records arrive pre-split,
         and their fields may legally contain tabs, so they must never take
@@ -404,11 +429,24 @@ class LookupServer:
         dispatch, feeds the per-verb counter/latency instruments, and
         echoes the tid on the reply.  Deferred top-k replies do all of
         that at resolve time via the post hook, when their true latency
-        is known."""
+        is known.
+
+        Tenancy + admission happen here too, before any handler work: a
+        trailing ``tn=`` field is popped the same way (tab plane only —
+        B2 passes the connection's HELLO-bound tenant via ``tenant``),
+        and the tenant's token bucket is charged.  Over quota the request
+        is answered ``E\\tover quota`` without touching a table or the
+        microbatcher — shedding must cost less than serving."""
         self.requests += 1
         tid = obs_tracing.pop_tid(parts) if traced else None
+        if tenant is None and traced:
+            tenant = admission_ctl.pop_tenant(parts)
         verb = parts[0] if parts and parts[0] else "?"
         t0 = time.perf_counter()
+        if self.admission is not None and \
+                not self.admission.admit(tenant, verb):
+            return self._finish(verb, tid, t0, admission_ctl.SHED_REPLY,
+                                shed=True)
         if verb == "METRICS" and len(parts) == 1:
             return self._finish(verb, tid, t0, self._metrics_reply())
         reply = self._handle(parts, burst)
@@ -418,7 +456,8 @@ class LookupServer:
             return reply
         return self._finish(verb, tid, t0, reply)
 
-    def _serve_binary(self, sock, wfile, buf: bytearray, eof: bool) -> None:
+    def _serve_binary(self, sock, wfile, buf: bytearray, eof: bool,
+                      tenant: Optional[str] = None) -> None:
         """B2 frame loop, entered after an accepted HELLO (``serve.proto``).
 
         One request frame in -> one reply frame out, records answered in
@@ -456,7 +495,7 @@ class LookupServer:
                 self._obs_burst.observe(len(records))
             replies = [
                 self._dispatch_parts(parts, burst=len(records),
-                                     traced=False)
+                                     traced=False, tenant=tenant)
                 for parts in records
             ]
             if len(records) > 1:
@@ -482,12 +521,17 @@ class LookupServer:
         return inst
 
     def _finish(self, verb: str, tid: Optional[str], t0: float,
-                reply: str, resolver=None) -> str:
+                reply: str, resolver=None, shed: bool = False) -> str:
         """Request epilogue: per-verb metrics, span event + tid echo for
         traced requests.  ``resolver`` (deferred top-k only) may expose a
         ``pending`` with the microbatcher's span fields — queue wait,
         batch size, device seconds — which join the event so one slow
-        traced query shows WHERE its time went."""
+        traced query shows WHERE its time went.
+
+        ``shed`` marks an admission reject: it is an E-reply on the wire
+        but NOT a server error — it rides its own counter
+        (``tpums_admission_shed_total``), so deliberate shedding never
+        reads as the fleet failing."""
         dt = time.perf_counter() - t0
         if obs_metrics.metrics_enabled():
             # ONE locked observation per request: the per-verb request
@@ -497,7 +541,7 @@ class LookupServer:
             # instead of paying a second lock on every request
             latency, errors = self._verb_obs(verb)
             latency.observe(dt)
-            if reply.startswith("E"):
+            if reply.startswith("E") and not shed:
                 errors.inc()
         if tid is not None:
             fields = {"verb": verb, "job_id": self.job_id,
@@ -531,10 +575,18 @@ class LookupServer:
         """Verb dispatch over already-split fields (tid removed)."""
         if parts[0] == "PING":
             return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
-        if parts[0] == proto.HELLO_VERB and len(parts) == 2:
+        if parts[0] == proto.HELLO_VERB and (
+                len(parts) == 2
+                or (len(parts) == 3
+                    and parts[2].startswith(admission_ctl.TENANT_FIELD))):
             # protocol negotiation: the handler loop flips the connection
             # to B2 on the exact accept line (an old server answers
-            # E\tbad request here, which clients read as "tab only")
+            # E\tbad request here, which clients read as "tab only").
+            # The ONLY accepted 3-field form carries a tenant binding
+            # (``tn=<t>``) the handler loop already captured; the reply
+            # stays the frozen 2-field accept so old and new clients
+            # parse it alike.  Any other 3-field HELLO stays the generic
+            # E\tbad request, byte-identical to the native server.
             if parts[1] == "B2":
                 return proto.HELLO_REPLY
             return f"E\tunsupported proto: {parts[1]}"

@@ -25,7 +25,14 @@ Knobs (env):
                            SIGKILL co-located UpdateWorkers mid-batch:
                            the sequence audit must show zero lost and
                            zero double-applied ratings, and recovery goes
-                           through the standard replay-then-ready path)
+                           through the standard replay-then-ready path),
+                           or "rollout" (SIGKILL a warming replica
+                           mid-bulk-load during a live blue/green model
+                           rollout while an over-quota tenant hammers the
+                           fleet through the cutover: in-quota clients
+                           must see zero errors, the abuser must be SHED
+                           rather than served, and the rollout must either
+                           complete on v2 or abort cleanly back on v1)
     CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
                            history over few keys so the fold has work)
     CHAOS_UPDATE_BATCH=200 ratings per producer tick (update mode)
@@ -561,6 +568,170 @@ def snapshot_main() -> int:
     return 1 if failed else 0
 
 
+def rollout_main() -> int:
+    """SIGKILL a warming replica mid-bulk-load during a live blue/green
+    model rollout (serve/rollout.py) while an over-quota tenant hammers
+    the fleet through the cutover window (serve/admission.py).  Contracts
+    under test: the active generation serves v1 the whole time; the
+    warming v2 generation's supervisor respawns the victim and the
+    rollout still completes (or aborts cleanly, leaving v1 published and
+    serving); in-quota clients see ZERO errors while the abusive tenant
+    is shed ("over quota") rather than served."""
+    from flink_ms_tpu.serve.admission import SHED_MARKER
+    from flink_ms_tpu.serve.elastic import ElasticClient
+    from flink_ms_tpu.serve.rollout import RolloutController
+
+    base = tempfile.mkdtemp(prefix="tpums_chaos_rollout_")
+    os.environ.setdefault(
+        "TPUMS_REGISTRY_DIR", tempfile.mkdtemp(prefix="tpums_chaos_reg_"))
+    # quota small enough that one closed-loop abuser runs persistently
+    # over it — the workers inherit this at spawn
+    os.environ.setdefault("TPUMS_ADMIT_TENANT_QPS", "abuse=25")
+
+    k = 4
+
+    def seed_model(name, seed):
+        journal = Journal(os.path.join(base, f"bus-{name}"), "models")
+        rng = np.random.default_rng(seed)
+        journal.append(
+            [F.format_als_row(u, "U", rng.normal(size=k))
+             for u in range(N_USERS)]
+            + [F.format_als_row(i, "I", rng.normal(size=k))
+               for i in range(N_USERS)])
+        return journal
+
+    j1, j2 = seed_model("v1", 0), seed_model("v2", 1)
+    keys = [f"{u}-U" for u in range(N_USERS)]
+
+    ctl = RolloutController("chaos-rollout",
+                            port_dir=os.path.join(base, "ports"),
+                            journal_dir=j1.dir, topic="models",
+                            replication=R, ready_timeout_s=180)
+    event("chaos_rollout_start", shards=W, replication=R)
+    ok = [0] * THREADS
+    errs = [0] * THREADS
+    shed = [0]
+    abuse_served = [0]
+    stop = threading.Event()
+
+    def in_quota_load(widx):
+        c = ElasticClient(
+            "chaos-rollout", retry=RetryPolicy(
+                attempts=6, backoff_s=0.02, max_backoff_s=0.5),
+            timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    if c.query_state(ALS_STATE, key) is None:
+                        errs[widx] += 1
+                    else:
+                        ok[widx] += 1
+                except Exception:
+                    errs[widx] += 1
+
+    def abusive_load():
+        # tenant rides the wire; sheds come back as "over quota" errors
+        # the HA client does NOT failover on.  TOPK is low-priority (shed
+        # at the reserve floor), GET holds on until the bucket is empty —
+        # drive both so the priority order is exercised.
+        c = ElasticClient(
+            "chaos-rollout", retry=RetryPolicy(
+                attempts=2, backoff_s=0.01, max_backoff_s=0.1),
+            timeout_s=10, tenant="abuse")
+        r = random.Random(1099)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    if r.random() < 0.5:
+                        c.topk(ALS_STATE, key[:-2], TOPK_K)
+                    else:
+                        c.query_state(ALS_STATE, key)
+                    abuse_served[0] += 1
+                except Exception as e:
+                    if SHED_MARKER in repr(e):
+                        shed[0] += 1
+
+    result = {}
+    try:
+        # initial deploy: v1 is generation 1
+        ctl.rollout(j1.dir, "models", model_id="v1", shards=W)
+        threads = [threading.Thread(target=in_quota_load, args=(i,),
+                                    daemon=True)
+                   for i in range(THREADS)]
+        threads.append(threading.Thread(target=abusive_load, daemon=True))
+        for t in threads:
+            t.start()
+
+        t0 = time.time()
+
+        def do_rollout():
+            try:
+                result["record"] = ctl.rollout(
+                    j2.dir, "models", model_id="v2",
+                    verify_min_rows=N_USERS)
+            except Exception as e:  # abort must leave v1 serving
+                result["error"] = repr(e)
+
+        st = threading.Thread(target=do_rollout)
+        st.start()
+        # kill one warming (v2) member mid-bulk-load — same window rules
+        # as the elastic arm: only members whose port is already known
+        victim = None
+        while st.is_alive() and victim is None:
+            warm = ctl.warming
+            if warm is not None:
+                launched = sorted(sr for sr in warm.procs
+                                  if sr in warm.ports)
+                if launched:
+                    sr = launched[0]
+                    proc = warm.procs.get(sr)
+                    if proc is not None and proc.poll() is None:
+                        event("chaos_kill_warming", shard=sr[0],
+                              replica=sr[1], pid=proc.pid)
+                        proc.send_signal(signal.SIGKILL)
+                        victim = sr
+            time.sleep(0.01)
+        st.join()
+        cutover_s = round(time.time() - t0, 2)
+        time.sleep(1.0)  # keep the overload on the published generation
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        status = ctl.status()
+        live_model = (status.get("model") or {}).get("model_id")
+        completed = "record" in result and live_model == "v2"
+        aborted_clean = "error" in result and live_model == "v1"
+        summary = {
+            "mode": "rollout", "shards": W, "replication": R,
+            "victim": list(victim) if victim else None,
+            "rollout_ok": completed,
+            "rollout_error": result.get("error"),
+            "aborted_clean": aborted_clean,
+            "cutover_s": cutover_s,
+            "live_model": live_model,
+            "new_gen": result.get("record", {}).get("gen"),
+            "in_quota_ok": sum(ok), "in_quota_errors": sum(errs),
+            "abuse_served": abuse_served[0], "abuse_shed": shed[0],
+            "controller_events": ctl.events,
+            "timeline": [e for e in recent_events()
+                         if e["kind"].startswith(("chaos_", "rollout_",
+                                                  "replica_"))],
+        }
+        print(json.dumps(summary, indent=1, default=str))
+        failed = (sum(errs) > 0                  # in-quota saw an error
+                  or victim is None              # the chaos never happened
+                  or not (completed or aborted_clean)
+                  or shed[0] == 0)               # the abuser never shed
+        return 1 if failed else 0
+    finally:
+        stop.set()
+        ctl.stop(drop_topology=True)
+
+
 def update_main() -> int:
     """SIGKILL co-located UpdateWorkers mid-stream under a sustained
     rating load.  The cluster runs with the sharded update plane enabled
@@ -699,4 +870,5 @@ def update_main() -> int:
 if __name__ == "__main__":
     sys.exit({"elastic": elastic_main,
               "snapshot": snapshot_main,
-              "update": update_main}.get(MODE, main)())
+              "update": update_main,
+              "rollout": rollout_main}.get(MODE, main)())

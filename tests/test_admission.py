@@ -1,0 +1,305 @@
+"""Admission control and priority-aware shedding (serve/admission.py).
+
+Unit coverage drives the token bucket and the controller with an injected
+clock (no timing races); integration coverage puts an AdmissionController
+in front of a real LookupServer and checks the wire contract: TOPK sheds
+before GET, sheds read ``E\\tover quota`` on both planes, tenancy is a
+connection property on B2, and a client with no tenant configured sends
+bytes identical to the seed protocol.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from flink_ms_tpu.serve import admission
+from flink_ms_tpu.serve.admission import AdmissionController, TokenBucket
+from flink_ms_tpu.serve.client import QueryClient
+from flink_ms_tpu.serve.consumer import ALS_STATE
+from flink_ms_tpu.serve.server import LookupServer
+from flink_ms_tpu.serve.table import ModelTable
+from flink_ms_tpu.serve.topk import make_als_topk_handler
+
+
+# ---------------------------------------------------------------------------
+# token bucket (injected clock — fully deterministic)
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_accounting():
+    b = TokenBucket(rate=10.0, burst=5.0, now=0.0)
+    # starts full: exactly burst takes succeed, the next is refused
+    for _ in range(5):
+        assert b.try_take(now=0.0)
+    assert not b.try_take(now=0.0)
+    # refill at rate: 0.2s later exactly 2 tokens came back
+    assert b.try_take(now=0.2)
+    assert b.try_take(now=0.2)
+    assert not b.try_take(now=0.2)
+    # level caps at burst no matter how long the tenant was idle
+    assert b.level(now=100.0) == pytest.approx(5.0)
+    # the clock never runs backwards inside the bucket
+    b2 = TokenBucket(rate=1.0, burst=1.0, now=10.0)
+    assert b2.try_take(now=10.0)
+    assert not b2.try_take(now=9.0)  # stale clock: no refill, no crash
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0.0, burst=1.0)
+    with pytest.raises(ValueError):
+        TokenBucket(rate=1.0, burst=0.0)
+
+
+def test_token_bucket_reserve_floor():
+    b = TokenBucket(rate=4.0, burst=4.0, now=0.0)
+    # floor=2 (a low-priority take): admitted only while 2 tokens remain
+    # AFTER the take — 4->3, 3->2, then refused
+    assert b.try_take(floor=2.0, now=0.0)
+    assert b.try_take(floor=2.0, now=0.0)
+    assert not b.try_take(floor=2.0, now=0.0)
+    # floor=0 (high priority) still drains the reserved slice
+    assert b.try_take(now=0.0)
+    assert b.try_take(now=0.0)
+    assert not b.try_take(now=0.0)
+
+
+# ---------------------------------------------------------------------------
+# config parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_tenant_rates():
+    assert admission._parse_tenant_rates("a=100,b=50") == {
+        "a": 100.0, "b": 50.0}
+    # bad pairs are skipped, names/values are stripped
+    assert admission._parse_tenant_rates(
+        "x,=5,a=abc, b = 7 ,") == {"b": 7.0}
+    assert admission._parse_tenant_rates("") == {}
+
+
+def test_from_env_off_unless_a_rate_knob_is_set():
+    assert AdmissionController.from_env(env={}) is None
+    assert AdmissionController.from_env(env={"TPUMS_ADMIT_QPS": "0"}) is None
+    assert AdmissionController.from_env(
+        env={"TPUMS_ADMIT_BURST_S": "4"}) is None  # depth alone != on
+    ctl = AdmissionController.from_env(
+        env={"TPUMS_ADMIT_TENANT_QPS": "hot=5"})
+    assert ctl is not None
+    assert ctl.rate_for("hot") == 5.0
+    assert ctl.rate_for("anyone-else") == 0.0  # unlimited
+    ctl = AdmissionController.from_env(env={
+        "TPUMS_ADMIT_QPS": "20",
+        "TPUMS_ADMIT_TENANT_QPS": "hot=5,cold=50",
+        "TPUMS_ADMIT_BURST_S": "2.5",
+        "TPUMS_ADMIT_RESERVE": "0.25",
+    })
+    assert (ctl.default_qps, ctl.burst_s, ctl.reserve_frac) == (20.0, 2.5,
+                                                                0.25)
+    assert ctl.rate_for("cold") == 50.0
+    # unparsable numbers fall back to defaults instead of crashing startup
+    ctl = AdmissionController.from_env(env={
+        "TPUMS_ADMIT_QPS": "ten", "TPUMS_ADMIT_TENANT_QPS": "a=1",
+        "TPUMS_ADMIT_BURST_S": "wide", "TPUMS_ADMIT_RESERVE": "half"})
+    assert (ctl.default_qps, ctl.burst_s, ctl.reserve_frac) == (0.0, 1.0, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# controller semantics (injected clock)
+# ---------------------------------------------------------------------------
+
+def test_admit_priority_shed_order_and_tenant_isolation():
+    ctl = AdmissionController(tenant_qps={"t": 4.0}, burst_s=1.0,
+                              reserve_frac=0.5)
+    t0 = 100.0
+    # burst 4, reserve floor 2 for TOPK/TOPKV: scoring verbs bounce once
+    # half the bucket is gone, point lookups run the bucket to zero
+    assert ctl.admit("t", "TOPK", now=t0)
+    assert ctl.admit("t", "TOPKV", now=t0)
+    assert not ctl.admit("t", "TOPK", now=t0)  # floor reached: shed first
+    assert ctl.admit("t", "GET", now=t0)
+    assert ctl.admit("t", "MGET", now=t0)
+    assert not ctl.admit("t", "GET", now=t0)   # truly empty now
+    # the ops surface survives a drained bucket
+    for verb in ("HEALTH", "METRICS", "PING", "HELLO"):
+        assert ctl.admit("t", verb, now=t0)
+    # refill: 0.5s -> 2 tokens back; GET admitted, TOPK still under floor
+    assert not ctl.admit("t", "TOPK", now=t0 + 0.5)
+    assert ctl.admit("t", "GET", now=t0 + 0.5)
+    # other tenants are untouched: no explicit rate + default 0 = unlimited
+    assert ctl.admit("other", "TOPK", now=t0)
+    assert ctl.admit(None, "GET", now=t0)  # no tenant field -> "default"
+    assert ctl.shed == 3
+    # only bucketed decisions count: unlimited tenants and ops verbs are
+    # admitted before any bookkeeping
+    assert ctl.admitted == 5
+    assert "t" in ctl.levels(now=t0 + 0.5)
+
+
+def test_admit_default_rate_applies_to_untenanted_traffic():
+    ctl = AdmissionController(default_qps=1.0, burst_s=1.0)
+    t0 = 5.0
+    assert ctl.admit(None, "GET", now=t0)
+    assert not ctl.admit(None, "GET", now=t0)
+    assert admission.DEFAULT_TENANT in ctl.levels(now=t0)
+
+
+def test_pop_tenant():
+    parts = ["GET", "S", "k", "tn=acme"]
+    assert admission.pop_tenant(parts) == "acme"
+    assert parts == ["GET", "S", "k"]
+    # no field -> untouched
+    assert admission.pop_tenant(parts) is None
+    assert parts == ["GET", "S", "k"]
+    # bare "tn=" is popped but names no tenant
+    parts = ["GET", "S", "tn="]
+    assert admission.pop_tenant(parts) is None
+    assert parts == ["GET", "S"]
+    # strictly trailing: a mid-request field is payload, not tenancy
+    parts = ["GET", "tn=a", "k"]
+    assert admission.pop_tenant(parts) is None
+    assert parts == ["GET", "tn=a", "k"]
+    # a lone field is a verb, not a header
+    parts = ["tn=a"]
+    assert admission.pop_tenant(parts) is None
+    assert parts == ["tn=a"]
+
+
+# ---------------------------------------------------------------------------
+# server integration — sheds on the wire, both planes
+# ---------------------------------------------------------------------------
+
+def _start_server(ctl):
+    table = ModelTable(2)
+    for i in range(8):
+        table.put(f"{i}-U", "1.0;2.0")
+        table.put(f"{i}-I", "0.5;0.5")
+    return LookupServer(
+        {ALS_STATE: table}, host="127.0.0.1", port=0, job_id="admit-test",
+        topk_handlers={ALS_STATE: make_als_topk_handler(table)},
+        admission=ctl,
+    ).start()
+
+
+def test_server_sheds_topk_before_get_per_tenant():
+    # rate 0.5/s, burst 3: refill is ~0.5 token/s, so the threshold
+    # crossings below can't be disturbed by wall-clock jitter
+    ctl = AdmissionController(tenant_qps={"hot": 0.5}, burst_s=6.0,
+                              reserve_frac=0.5)
+    srv = _start_server(ctl)
+    try:
+        hot = QueryClient("127.0.0.1", srv.port, timeout_s=5.0,
+                          tenant="hot")
+        free = QueryClient("127.0.0.1", srv.port, timeout_s=5.0, tenant="")
+        # burst 3, floor 1.5: one TOPK fits, the second sheds while two
+        # GETs still get through — shed TOPK before GET
+        assert hot.topk(ALS_STATE, "1", 2)
+        with pytest.raises(RuntimeError) as ei:
+            hot.topk(ALS_STATE, "1", 2)
+        assert admission.SHED_MARKER in str(ei.value)
+        assert hot.query_state(ALS_STATE, "1-U") == "1.0;2.0"
+        assert hot.query_state(ALS_STATE, "2-U") == "1.0;2.0"
+        with pytest.raises(RuntimeError) as ei:
+            hot.query_state(ALS_STATE, "3-U")
+        assert admission.SHED_MARKER in str(ei.value)
+        # a drained tenant stays observable: METRICS is never admitted
+        with socket.create_connection(("127.0.0.1", srv.port), 5.0) as s:
+            s.sendall(b"METRICS\ttn=hot\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                buf += s.recv(1 << 16)
+        assert buf.startswith(b"J\t")
+        # other tenants (and the untenanted default) are unaffected
+        for _ in range(4):
+            assert free.query_state(ALS_STATE, "1-U") == "1.0;2.0"
+        assert ctl.shed >= 2
+        hot.close()
+        free.close()
+    finally:
+        srv.stop()
+
+
+def test_server_b2_connection_bound_tenant_sheds():
+    ctl = AdmissionController(tenant_qps={"hot": 0.5}, burst_s=4.0,
+                              reserve_frac=0.0)
+    srv = _start_server(ctl)
+    try:
+        hot = QueryClient("127.0.0.1", srv.port, timeout_s=5.0, proto="b2",
+                          tenant="hot")
+        free = QueryClient("127.0.0.1", srv.port, timeout_s=5.0, proto="b2",
+                           tenant="")
+        # burst 2 on the connection-bound tenant: two queries, then shed
+        assert hot.query_state(ALS_STATE, "1-U") == "1.0;2.0"
+        assert hot.query_state(ALS_STATE, "2-U") == "1.0;2.0"
+        with pytest.raises(RuntimeError) as ei:
+            hot.query_state(ALS_STATE, "3-U")
+        assert admission.SHED_MARKER in str(ei.value)
+        # same server, same instant: an untenanted B2 connection is free
+        for _ in range(4):
+            assert free.query_state(ALS_STATE, "1-U") == "1.0;2.0"
+        hot.close()
+        free.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# wire bytes — tenancy is strictly opt-in
+# ---------------------------------------------------------------------------
+
+def _recording_server():
+    """One-line echo server that records the raw bytes of each connection's
+    first request line and answers ``V\\t1.0;2.0``."""
+    received = []
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def _run():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                data = b""
+                while b"\n" not in data:
+                    chunk = conn.recv(1 << 16)
+                    if not chunk:
+                        break
+                    data += chunk
+                received.append(data)
+                try:
+                    conn.sendall(b"V\t1.0;2.0\n")
+                except OSError:
+                    pass
+
+    threading.Thread(target=_run, daemon=True).start()
+    return srv, srv.getsockname()[1], received
+
+
+def test_wire_bytes_identical_when_tenant_unset(monkeypatch):
+    monkeypatch.delenv("TPUMS_TENANT", raising=False)
+    srv, port, received = _recording_server()
+    try:
+        c = QueryClient("127.0.0.1", port, timeout_s=5.0)
+        assert c.tenant is None  # off by default
+        assert c.query_state(ALS_STATE, "1-U") == "1.0;2.0"
+        c.close()
+        # the exact seed-protocol bytes: no tenant field, no extra framing
+        assert received[0] == f"GET\t{ALS_STATE}\t1-U\n".encode("utf-8")
+
+        c = QueryClient("127.0.0.1", port, timeout_s=5.0, tenant="acme")
+        assert c.query_state(ALS_STATE, "1-U") == "1.0;2.0"
+        c.close()
+        # with a tenant: the same line plus one trailing tn= field
+        assert received[1] == \
+            f"GET\t{ALS_STATE}\t1-U\ttn=acme\n".encode("utf-8")
+
+        # ambient opt-in via TPUMS_TENANT stamps the same field
+        monkeypatch.setenv("TPUMS_TENANT", "globex")
+        c = QueryClient("127.0.0.1", port, timeout_s=5.0)
+        assert c.tenant == "globex"
+        assert c.query_state(ALS_STATE, "1-U") == "1.0;2.0"
+        c.close()
+        assert received[2] == \
+            f"GET\t{ALS_STATE}\t1-U\ttn=globex\n".encode("utf-8")
+    finally:
+        srv.close()

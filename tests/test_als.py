@@ -320,11 +320,16 @@ def test_fused_solve_matches_unfused_implicit(rng, monkeypatch):
     plain = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
     monkeypatch.setenv("FLINK_MS_ALS_FUSED", "1")
     fused = A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0))
+    # fp32 tolerance: the fused implicit path accumulates the psum'd
+    # Gramian in a different association order, and the exact rounding
+    # depends on which compiled variants already sit in the jit cache —
+    # at 1e-4/1e-6 this comparison is order-of-tests sensitive (a few
+    # elements land near 5e-6 abs / 3e-4 rel in a full-module run)
     np.testing.assert_allclose(
-        fused.user_factors, plain.user_factors, rtol=1e-4, atol=1e-6
+        fused.user_factors, plain.user_factors, rtol=1e-3, atol=1e-5
     )
     np.testing.assert_allclose(
-        fused.item_factors, plain.item_factors, rtol=1e-4, atol=1e-6
+        fused.item_factors, plain.item_factors, rtol=1e-3, atol=1e-5
     )
 
 

@@ -1,0 +1,305 @@
+"""Multi-tenant model rollout (serve/rollout.py + registry tenancy):
+tenant-qualified namespaces with isolated records and GC, the blue/green
+model cutover with zero failed queries under in-flight traffic, the
+verification gate refusing a bad model while the old generation keeps
+serving, and one-command rollback restoring the previous model's answers.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.serve import registry
+from flink_ms_tpu.serve import rollout as rollout_mod
+from flink_ms_tpu.serve.consumer import ALS_STATE
+from flink_ms_tpu.serve.elastic import ElasticClient
+from flink_ms_tpu.serve.journal import Journal
+from flink_ms_tpu.serve.rollout import (
+    RolloutController,
+    RolloutError,
+    VerificationError,
+)
+
+# registry isolation comes from conftest.py's autouse fixture (every test
+# gets a private TPUMS_REGISTRY_DIR)
+
+
+# ---------------------------------------------------------------------------
+# tenant namespaces (registry satellite of the rollout plane)
+# ---------------------------------------------------------------------------
+
+def test_qualify_group_explicit_idempotent_and_validated():
+    assert registry.qualify_group("als", "acme") == "acme::als"
+    # idempotent: a controller and a client can both qualify the same name
+    assert registry.qualify_group("acme::als") == "acme::als"
+    assert registry.qualify_group("acme::als", "globex") == "acme::als"
+    # explicit "" pins the shared namespace; no ambient tenant -> shared
+    assert registry.qualify_group("als", "") == "als"
+    assert registry.qualify_group("als") == "als"
+    for bad in ("a::b", "a/b", "a\tb", "a\nb"):
+        with pytest.raises(ValueError):
+            registry.qualify_group("als", bad)
+
+
+def test_qualify_group_ambient_tenant_env(monkeypatch):
+    monkeypatch.setenv("TPUMS_TENANT", "acme")
+    assert registry.qualify_group("als") == "acme::als"
+    # explicit tenant wins over the environment; "" opts back out
+    assert registry.qualify_group("als", "globex") == "globex::als"
+    assert registry.qualify_group("als", "") == "als"
+
+
+def test_split_tenant_roundtrip():
+    assert registry.split_tenant("acme::als@g3/shard-0") == \
+        ("acme", "als@g3/shard-0")
+    assert registry.split_tenant("als") == (None, "als")
+    assert registry.tenant_of("acme::x") == "acme"
+    assert registry.tenant_of("x") is None
+
+
+def test_topology_records_are_tenant_isolated():
+    registry.publish_topology(registry.qualify_group("g", "acme"), 2)
+    registry.publish_topology(registry.qualify_group("g", "globex"), 4)
+    registry.publish_topology("g", 8)
+    # three independent records: same base name, disjoint namespaces,
+    # each at its own generation 1
+    for name, shards in (("acme::g", 2), ("globex::g", 4), ("g", 8)):
+        rec = registry.resolve_topology(name)
+        assert rec["gen"] == 1 and rec["shards"] == shards
+    registry.drop_topology("acme::g")
+    assert registry.resolve_topology("acme::g") is None
+    assert registry.resolve_topology("globex::g")["shards"] == 4
+    assert registry.resolve_topology("g")["shards"] == 8
+
+
+def test_tenant_listing_and_gc_isolation():
+    members = (("acme::j/s0r0", "acme::j/shard-0"),
+               ("globex::j/s0r0", "globex::j/shard-0"),
+               ("j/s0r0", "j/shard-0"))
+    for job_id, group in members:
+        registry.register(job_id, "127.0.0.1", 1, ALS_STATE,
+                          replica_of=group, replica=0)
+    assert registry.list_tenants() == ["acme", "globex"]
+    assert {e["job_id"] for e in
+            registry.list_tenant_jobs("acme")} == {"acme::j/s0r0"}
+    assert {e["job_id"] for e in
+            registry.list_tenant_jobs(None)} == {"j/s0r0"}
+    # break every entry's heartbeat contract, then GC one tenant: it may
+    # only ever reap its own entries — the other tenant and the shared
+    # namespace are structurally out of reach
+    for job_id, group in members:
+        registry.register(job_id, "127.0.0.1", 1, ALS_STATE,
+                          replica_of=group, replica=0, ttl_s=0.01)
+    time.sleep(0.05)
+    assert registry.gc_tenant_entries("acme") == 1
+    assert registry.gc_tenant_entries("acme") == 0   # already reaped
+    # globex's dead entry was untouched — its own reaper still finds it
+    assert registry.gc_tenant_entries("globex") == 1
+    # the shared entry survived both tenant GCs (only the generic
+    # list_jobs GC may reap it)
+    assert os.path.exists(registry._entry_path("j/s0r0"))
+    registry.list_jobs()
+    assert not os.path.exists(registry._entry_path("j/s0r0"))
+    with pytest.raises(ValueError):
+        registry.gc_tenant_entries("")
+
+
+def test_publish_topology_extra_binds_model_and_survives_history():
+    rec = registry.publish_topology(
+        "mdl", 2, extra={"model": {"journal_dir": "/d/v1", "topic": "m",
+                                   "model_id": "v1"}})
+    assert rec["model"]["model_id"] == "v1"
+    # extra cannot shadow protocol fields
+    rec = registry.publish_topology(
+        "mdl", 2, extra={"gen": 999, "model": {"journal_dir": "/d/v2",
+                                               "topic": "m",
+                                               "model_id": "v2"}})
+    assert rec["gen"] == 2 and rec["model"]["model_id"] == "v2"
+    # the superseded generation keeps its model binding in history —
+    # that's what rollback resolves against
+    assert rec["history"][-1]["model"]["model_id"] == "v1"
+
+
+# ---------------------------------------------------------------------------
+# verification gate units (no subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_parse_factors():
+    assert rollout_mod._parse_factors(None) is None
+    assert rollout_mod._parse_factors("1.5;-2.0;0.25") == [1.5, -2.0, 0.25]
+
+
+class _FakeModelClient:
+    """Stands in for the warming generation's HAShardedClient."""
+
+    def __init__(self, table):
+        self.table = table
+
+    def query_state(self, name, key):
+        return self.table.get(key)
+
+    def query_states(self, name, keys):
+        return [self.table.get(k) for k in keys]
+
+    def total_count(self, name):
+        return len(self.table)
+
+    def close(self):
+        pass
+
+
+def _probe(users, items, ratings, max_mse):
+    return {"users": np.asarray(users), "items": np.asarray(items),
+            "ratings": np.asarray(ratings, dtype=float),
+            "max_mse": max_mse}
+
+
+def test_mse_probe_gate_pass_fail_and_empty(tmp_path):
+    ctl = RolloutController("probe-unit", journal_dir=str(tmp_path),
+                            topic="models")
+    # orthonormal factors: rating(u, i) = 1 iff u == i
+    table = {"0-U": "1;0", "1-U": "0;1", "0-I": "1;0", "1-I": "0;1"}
+    client = _FakeModelClient(table)
+    # a perfect model passes a tight gate
+    ctl._run_probe(client, 1, _probe([0, 1], [0, 1], [1.0, 1.0], 0.01))
+    # a wrong model is refused by the same gate
+    with pytest.raises(VerificationError):
+        ctl._run_probe(client, 1, _probe([0, 1], [0, 1], [5.0, 5.0], 0.01))
+    # a probe that scores nothing (all keys missing) must refuse, not pass
+    with pytest.raises(VerificationError):
+        ctl._run_probe(client, 1, _probe([7, 8], [7, 8], [1.0, 1.0], 1e9))
+
+
+def test_rollback_without_topology_or_history_raises(tmp_path):
+    ctl = RolloutController("rb-none", journal_dir=str(tmp_path),
+                            topic="models")
+    with pytest.raises(RolloutError):
+        ctl.rollback()
+    # a topology with no previous model binding can't roll back either
+    registry.publish_topology("rb-none", 1)
+    with pytest.raises(RolloutError):
+        ctl.rollback()
+
+
+# ---------------------------------------------------------------------------
+# blue/green e2e: cutover, verification abort, rollback (subprocesses)
+# ---------------------------------------------------------------------------
+
+def _seed_model(tmp_path, name, n=24, k=3, seed=0):
+    journal = Journal(str(tmp_path / f"bus-{name}"), "models")
+    rng = np.random.default_rng(seed)
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=k)) for u in range(n)]
+        + [F.format_als_row(i, "I", rng.normal(size=k)) for i in range(n)])
+    return journal
+
+
+def test_rollout_blue_green_abort_and_rollback_zero_errors(
+        tmp_path, monkeypatch):
+    """The acceptance scenario, sized for CI: serve v1, roll out v2 under
+    a sustained query stream (zero client-visible errors, answers change),
+    refuse a too-small v3 behind the verification gate (v2 keeps
+    serving), then one-command rollback (v1's answers come back)."""
+    monkeypatch.setenv("TPUMS_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("TPUMS_REPLICA_TTL_S", "30")
+    n = 24
+    j1 = _seed_model(tmp_path, "v1", n=n, seed=1)
+    j2 = _seed_model(tmp_path, "v2", n=n, seed=2)
+    keys = [f"{u}-U" for u in range(n)]
+    ctl = RolloutController("bg", port_dir=str(tmp_path / "ports"),
+                            journal_dir=j1.dir, topic="models",
+                            ready_timeout_s=90)
+    try:
+        rec = ctl.rollout(j1.dir, "models", model_id="v1", shards=1)
+        assert rec["gen"] == 1 and rec["model"]["model_id"] == "v1"
+
+        probe = ElasticClient("bg", timeout_s=10)
+        v1_answers = probe.query_states(ALS_STATE, keys)
+        assert all(v is not None for v in v1_answers)
+
+        errors = []
+        served = [0]
+        stop = threading.Event()
+
+        def stream():
+            from flink_ms_tpu.serve.client import RetryPolicy
+            c = ElasticClient(
+                "bg", retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                        max_backoff_s=0.5), timeout_s=10)
+            with c:
+                while not stop.is_set():
+                    for key in keys:
+                        try:
+                            if c.query_state(ALS_STATE, key) is None:
+                                errors.append((key, "missing"))
+                        except Exception as e:
+                            errors.append((key, repr(e)))
+                        served[0] += 1
+
+        t = threading.Thread(target=stream, daemon=True)
+        t.start()
+        deadline = time.time() + 10
+        while served[0] < 30 and time.time() < deadline:
+            time.sleep(0.02)
+
+        # blue/green: v2 bulk-loads as gen 2, verifies, cuts over
+        rec = ctl.rollout(j2.dir, "models", model_id="v2",
+                          verify_min_rows=2 * n)
+        assert rec["gen"] == 2 and rec["model"]["model_id"] == "v2"
+
+        mark = served[0]
+        deadline = time.time() + 10
+        while served[0] < mark + 40 and time.time() < deadline:
+            time.sleep(0.02)
+
+        v2_answers = probe.query_states(ALS_STATE, keys)
+        assert all(v is not None for v in v2_answers)
+        assert v2_answers != v1_answers  # it really is a different model
+        topo = registry.resolve_topology("bg")
+        assert topo["model"]["model_id"] == "v2"
+        # the superseded generation's binding is in history (rollback fuel)
+        assert any((h.get("model") or {}).get("model_id") == "v1"
+                   for h in topo["history"])
+
+        # verification abort: v3 holds too few rows -> refused, torn
+        # down, v2 untouched, journal binding restored
+        j3 = _seed_model(tmp_path, "v3", n=4, seed=3)
+        with pytest.raises(VerificationError):
+            ctl.rollout(j3.dir, "models", model_id="v3",
+                        verify_min_rows=2 * n)
+        topo = registry.resolve_topology("bg")
+        assert topo["gen"] == 2 and topo["model"]["model_id"] == "v2"
+        assert ctl.warming is None
+        assert ctl.journal_dir == j2.dir
+
+        # one-command rollback: a NEW generation re-serves v1
+        rec = ctl.rollback()
+        assert rec["model"]["model_id"] == "v1"
+        assert rec["gen"] == 3
+
+        mark = served[0]
+        deadline = time.time() + 10
+        while served[0] < mark + 40 and time.time() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=30)
+        assert errors == [], f"client-visible errors: {errors[:5]}"
+
+        assert probe.query_states(ALS_STATE, keys) == v1_answers
+        probe.close()
+
+        st = ctl.status()
+        assert st["model"]["model_id"] == "v1"
+        assert st["rollback_to"]["model_id"] == "v2"
+
+        kinds = [e["kind"] for e in ctl.events]
+        assert kinds.count("cutover") == 3      # v1, v2, rollback-to-v1
+        assert "verified" in kinds              # the gate actually ran
+        assert "scale_abort" in kinds           # v3 was refused
+        assert "rollback" in kinds
+    finally:
+        ctl.stop(drop_topology=True)
