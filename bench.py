@@ -39,7 +39,11 @@ BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
-serving_native,serving_update_plane,serving_rollout; default all),
+serving_native,serving_update_plane,serving_rollout,serving_ann;
+default all),
+BENCH_ANN_ROWS_EXACT / BENCH_ANN_ROWS_IVF / BENCH_ANN_ARM_TIMEOUT_S
+(retrieval-plane A/B arm sizes: sharded-exact question at 1M rows,
+IVF question at 10M, recall@100 >= 0.95 gate recorded),
 BENCH_UPDATE_USERS / BENCH_UPDATE_FLEET_RATINGS / BENCH_UPDATE_BATCH /
 BENCH_UPDATE_PROBES (online update plane: fleet updates/s vs the
 single-consumer baseline, 2->4 reshard audit, submit->queryable p99),
@@ -867,6 +871,8 @@ _COMPACT_KEYS = (
     "serving_elastic_errors",
     "serving_native_get_b2_c64_p50_us", "serving_native_get_b2_speedup_c64",
     "serving_native_topk_b2_speedup_c64", "serving_native_cutover_errors",
+    "serving_ann_sharded_speedup", "serving_ann_ivf_speedup",
+    "serving_ann_recall_at_100", "serving_ann_gate_recall_ok",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1120,7 +1126,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "BENCH_SECTIONS",
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
-        "serving_native,serving_update_plane,serving_rollout"
+        "serving_native,serving_update_plane,serving_rollout,serving_ann"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1201,6 +1207,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_update_plane", "run_serving_update_plane_section",
          lambda f: f(small)),
         ("serving_rollout", "run_serving_rollout_section",
+         lambda f: f(small)),
+        ("serving_ann", "run_serving_ann_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

@@ -160,6 +160,24 @@ def fleet_signals(before: dict, after: dict,
     The admission fields make the shedder and the autoscaler act on the
     same numbers: sustained shed with low pressure elsewhere means a hot
     tenant, shed AND high qps means the fleet itself needs more shards.
+
+    Retrieval-plane health (round 11 — ``serve/topk.py``/``serve/ann.py``
+    maintenance):
+
+        {"topk_rebuilds_per_s": full index rebuilds/s over the window
+                           (``tpums_topk_rebuilds_total`` delta — a
+                           sustained rate means structural churn is
+                           outrunning the incremental scatter path),
+         "topk_dirty_depth": fleet-summed dirty backlog at AFTER
+                           (unabsorbed streaming updates),
+         "topk_staleness_s": WORST per-process index staleness at AFTER
+                           (the gauge is pid-labeled precisely so this
+                           can be a max — a fleet SUM of stalenesses
+                           means nothing),
+         "ann_recall":     worst measured IVF recall probe across the
+                           fleet at AFTER (min over pid-labeled
+                           ``tpums_ann_recall_probe`` series; None when
+                           no replica has an ANN tier built)}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -202,12 +220,32 @@ def fleet_signals(before: dict, after: dict,
     pressure = min(max(
         (g["value"] for g in after.get("gauges", [])
          if g["name"] == "tpums_admission_pressure"), default=0.0), 1.0)
+
+    def _counter_total(snap: dict, name: str) -> float:
+        return sum(c["value"] for c in snap.get("counters", [])
+                   if c["name"] == name)
+
+    rebuilds = max(
+        _counter_total(after, "tpums_topk_rebuilds_total")
+        - _counter_total(before, "tpums_topk_rebuilds_total"), 0.0)
+    dirty_depth = sum(
+        g["value"] for g in after.get("gauges", [])
+        if g["name"] == "tpums_topk_dirty_depth")
+    staleness = max(
+        (g["value"] for g in after.get("gauges", [])
+         if g["name"] == "tpums_topk_index_staleness_seconds"), default=0.0)
+    recall_series = [g["value"] for g in after.get("gauges", [])
+                     if g["name"] == "tpums_ann_recall_probe"]
     return {
         "qps": requests / dt_s,
         "p99_s": snapshot_quantile(window, 99) if window else None,
         "backlog_bytes": backlog,
         "shed_per_s": shed / dt_s,
         "admission_pressure": pressure,
+        "topk_rebuilds_per_s": rebuilds / dt_s,
+        "topk_dirty_depth": dirty_depth,
+        "topk_staleness_s": staleness,
+        "ann_recall": min(recall_series) if recall_series else None,
         "dt_s": dt_s,
         "requests": requests,
     }

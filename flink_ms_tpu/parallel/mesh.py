@@ -252,6 +252,23 @@ def block_sharding(mesh: Mesh, *, rank: int = 2) -> NamedSharding:
     return NamedSharding(mesh, P(BLOCK_AXIS, *([None] * (rank - 1))))
 
 
+def row_bucket(n: int, n_shards: int, floor: int = 8) -> int:
+    """Pad a row count to the next power-of-two PER-SHARD bucket.
+
+    The same pad-to-bucket discipline as the ALS degree buckets and the
+    top-k batch shapes (``warm_batch_shapes``): a catalog that grows row
+    by row must not recompile its sharded programs per row, so the padded
+    total is ``n_shards * 2^ceil(log2(ceil(n / n_shards)))`` — every shard
+    holds the same power-of-two row count and XLA sees a handful of
+    distinct shapes over the catalog's whole growth curve.  ``floor``
+    bounds the per-shard size from below so tiny catalogs still give each
+    shard enough rows for a local ``top_k``."""
+    if n_shards < 1:
+        raise ValueError("need n_shards >= 1")
+    per_shard = max((max(n, 1) + n_shards - 1) // n_shards, floor)
+    return n_shards * (1 << (per_shard - 1).bit_length())
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

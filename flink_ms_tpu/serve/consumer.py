@@ -1035,6 +1035,15 @@ def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
             # accepted for drop-in CLI parity; journal offsets replace
             # ZooKeeper coordination and consumer-group bookkeeping
             print(f"[serve] --{ignored} accepted and ignored", file=sys.stderr)
+    # retrieval-plane knobs ride the environment (the index reads them at
+    # construction, including inside rebuilds); CLI flags win over an
+    # inherited env so one launcher line fully describes the worker
+    for flag, env in (("topkTier", "TPUMS_TOPK_TIER"),
+                      ("topkSharded", "TPUMS_TOPK_SHARDED"),
+                      ("annNlist", "TPUMS_ANN_NLIST"),
+                      ("annNprobe", "TPUMS_ANN_NPROBE")):
+        if params.has(flag):
+            os.environ[env] = str(params.get(flag))
     journal = Journal(_resolve_journal_dir(params), params.get_required("topic"))
     backend = make_backend(
         params.get("stateBackend", "memory"), params.get("checkpointDataUri")

@@ -258,13 +258,21 @@ class TopKBatcher:
         for (k, _shape), group in groups.items():
             t_disp = time.perf_counter()
             try:
-                if len(group) == 1:
+                if len(group) == 1 and not getattr(
+                    self.index, "prefers_frames", False
+                ):
                     # a lone query runs the exact single-query program, so
                     # sequential traffic is BIT-identical to the unbatched
                     # path (the native plane's byte-parity tests replay
-                    # one-at-a-time queries through here)
+                    # one-at-a-time queries through here).  Sharded/ANN
+                    # indexes prefer whole frames: there the batched
+                    # program IS the only compiled program, so a lone
+                    # query rides it as a (1, k) frame instead.
                     results = [self.index.topk(group[0].vec, k)]
                 else:
+                    # the whole frame goes down in ONE stacked dispatch —
+                    # on the sharded tier this is the shard_map program
+                    # (per-device partial top-k + merge) over the frame
                     results = self.index.topk_many(
                         np.stack([p.vec for p in group]), k
                     )

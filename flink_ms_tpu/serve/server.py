@@ -85,6 +85,14 @@ UNCHANGED: batching never reorders a connection's replies, and a lone
 query runs the exact single-query program, so the native plane's
 byte-parity contract below is untouched.
 
+Behind the verbs sits the two-tier RETRIEVAL PLANE (round 11, see
+``topk.py``/``ann.py``): ``TPUMS_TOPK_TIER`` (``exact``/``ivf``/``auto``)
+selects brute-force vs IVF-ANN scoring, ``TPUMS_TOPK_SHARDED`` /
+``TPUMS_TOPK_SHARD_MIN_ROWS`` control the mesh-sharded exact layout, and
+``TPUMS_ANN_NLIST`` / ``TPUMS_ANN_NPROBE`` / ``TPUMS_ANN_RECALL_MIN``
+size and gate the ANN tier.  All tiers answer through the same
+TOPK/TOPKV wire surface with exact scores for every returned item.
+
 A C++ epoll implementation of the same protocol
 (``native/lookup_server.cpp``, wrapped by
 ``native_store.NativeLookupServer``, enabled with ``--nativeServer true`` on

@@ -294,7 +294,14 @@ def spawn_worker_procs(
     a worker that hangs past ``timeout_s`` raises instead of spinning, a
     partial spawn is torn down before the exception propagates, and the
     child PYTHONPATH gets this repo PREPENDED (not clobbered — the caller
-    may rely on an existing PYTHONPATH for its own deps)."""
+    may rely on an existing PYTHONPATH for its own deps).
+
+    The parent environment is inherited wholesale, which is the knob
+    path for the per-worker retrieval plane: ``TPUMS_TOPK_TIER`` /
+    ``TPUMS_TOPK_SHARDED`` / ``TPUMS_ANN_NLIST`` / ``TPUMS_ANN_NPROBE``
+    set on the launcher reach every shard worker's
+    ``DeviceFactorIndex`` (each worker holds only its catalog slice, so
+    its index sizes its own mesh/ANN tiers from its slice)."""
     import subprocess
     import time
 

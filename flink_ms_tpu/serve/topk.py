@@ -18,6 +18,36 @@ index's dirty set (``add_change_listener``), and at query time
 
 The first query after startup pays the initial build (reported by the
 serving benchmark as ``serving_topk_build_s``).
+
+RETRIEVAL TIERS (round 11).  Two levers lift the catalog ceiling from the
+~1M rows the single-array exact scan tops out at:
+
+- **Sharded exact tier** — on a multi-device host the factor matrix is
+  laid out as a permanently mesh-resident array, row-sharded over
+  ``make_mesh()``'s block axis and padded to the shared power-of-two
+  bucket discipline (``mesh.row_bucket``; pad rows carry a ``-1e30``
+  score bias so they can never surface).  A batched TOPK is then ONE
+  compiled ``shard_map`` program per batch-shape bucket: each device
+  scores and ``top_k``'s its own row slice, an ``all_gather`` of the
+  (D, B, k) partials feeds a tiny cross-shard merge, and only the final
+  (B, k) winners ever reach the host — zero host round-trips on the
+  steady path.  The dirty-row scatter and background rebuild run against
+  the sharded array unchanged (XLA routes each row's update to its
+  owning shard), so streaming SGD never forces full rebuilds here
+  either.  Engages automatically past ``TPUMS_TOPK_SHARD_MIN_ROWS`` when
+  the mesh has >1 device; ``TPUMS_TOPK_SHARDED=1|0`` forces/disables.
+
+- **IVF ANN tier** (``serve/ann.py``) — a coarse k-means quantizer over
+  the item factors (trained on-device, refreshed by the same background
+  rebuild thread) makes retrieval cost sublinear in the catalog: a query
+  probes the ``TPUMS_ANN_NPROBE`` nearest centroid lists and the
+  shortlist is re-ranked EXACTLY against the resident factor matrix, so
+  the only approximation is a missing candidate — which the build-time
+  recall probe measures and gates on (``TPUMS_ANN_RECALL_MIN``).
+  ``TPUMS_TOPK_TIER`` picks: ``exact``, ``ivf``, or ``auto`` (default —
+  IVF past ``TPUMS_ANN_MIN_ROWS`` while the measured recall holds the
+  gate, exact otherwise, so the approximation is a contract, not a
+  hope).
 """
 
 from __future__ import annotations
@@ -25,11 +55,16 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from .table import ModelTable
+
+_engine_warn_lock = threading.Lock()
+_engine_warned = False
 
 
 def _default_engine() -> str:
@@ -38,16 +73,32 @@ def _default_engine() -> str:
     host-pinned in this deployment (a tunneled chip pays ~100 ms RTT per
     dispatch), and the XLA engine already serves 1M items at ~4 ms p50 —
     the use case the kernel targeted does not exist in the architecture.
-    A stale ``pallas`` setting degrades loudly to xla."""
+    A stale ``pallas`` setting degrades loudly to xla — ONCE per process:
+    this runs on every index construction (sharded serving builds one per
+    state, rebuilds included), and repeating the same warning per call
+    buried real log lines."""
+    global _engine_warned
     engine = os.environ.get("TPUMS_TOPK_ENGINE", "xla")
     if engine != "xla":
-        print(
-            f"[topk] TPUMS_TOPK_ENGINE={engine!r} is no longer available "
-            "(Pallas scorer removed in round 3 — see PARITY.md); using xla",
-            file=sys.stderr,
-        )
+        with _engine_warn_lock:
+            if not _engine_warned:
+                _engine_warned = True
+                print(
+                    f"[topk] TPUMS_TOPK_ENGINE={engine!r} is no longer "
+                    "available (Pallas scorer removed in round 3 — see "
+                    "PARITY.md); using xla",
+                    file=sys.stderr,
+                )
         engine = "xla"
     return engine
+
+
+def _tier_mode() -> str:
+    """TPUMS_TOPK_TIER: ``exact`` | ``ivf`` | ``auto`` (default).  Unknown
+    values degrade to ``auto`` (the safe tier: exact until the catalog is
+    big enough AND the measured recall holds the gate)."""
+    tier = os.environ.get("TPUMS_TOPK_TIER", "auto").strip().lower()
+    return tier if tier in ("exact", "ivf", "auto") else "auto"
 
 
 def _index_platform() -> str:
@@ -128,6 +179,92 @@ def _target_device():
     return dev
 
 
+_index_mesh_cache: dict = {}
+
+
+def _index_mesh():
+    """Mesh over every device of the index's platform, or None when only
+    one device is visible (the sharded tier has nothing to shard over).
+    Cached per platform knob — like the target device, the decision is
+    fixed for the life of the process."""
+    platform = _index_platform()
+    if platform in _index_mesh_cache:
+        return _index_mesh_cache[platform]
+    _target_device()  # resolve platform pins before enumerating devices
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    mesh = make_mesh(devices=devices) if len(devices) > 1 else None
+    _index_mesh_cache[platform] = mesh
+    return mesh
+
+
+def _to_host(x) -> np.ndarray:
+    """The ONE funnel through which query results reach the host.  On the
+    steady sharded path exactly two (B, k) arrays pass through per
+    dispatch — the zero-host-copy test monkeypatches this to prove no
+    catalog-sized array ever does."""
+    return np.asarray(x)
+
+
+# score bias stamped on pad rows (and on masked ANN candidate slots) so
+# they can never win a top-k over any real row; float32-safe margin below
+# any realistic factor dot product
+_PAD_SCORE = np.float32(-1e30)
+
+_sharded_program_cache: dict = {}
+
+
+def _sharded_topk_program(mesh):
+    """One jitted shard_map top-k per mesh (jax re-specializes per
+    (n_pad, B, k) shape bucket): every device scores its own row slice
+    against the whole query batch, takes a LOCAL top-k, globalizes the
+    row indices by its shard offset, and an ``all_gather`` of the
+    (D, B, k_local) partials feeds the final merge ``top_k`` — O(D*k)
+    work replicated on every shard, tiny next to the O(n/D) scan.  The
+    catalog never moves: only the merged (B, k) winners leave the
+    program."""
+    fn = _sharded_program_cache.get(mesh)
+    if fn is not None:
+        return fn
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import BLOCK_AXIS, shard_map
+
+    @partial(jax.jit, static_argnums=3)
+    def sharded_topk(matrix, bias, qs, k):
+        @partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(BLOCK_AXIS, None), P(BLOCK_AXIS), P(None, None)),
+            out_specs=(P(None, None), P(None, None)),
+            check_vma=False,
+        )
+        def run(m, b, q):
+            scores = q @ m.T + b[None, :]  # (B, n/D) — one MXU pass/shard
+            k_local = min(k, m.shape[0])
+            s, i = jax.lax.top_k(scores, k_local)
+            gi = (i + jax.lax.axis_index(BLOCK_AXIS) * m.shape[0]).astype(
+                jnp.int32
+            )
+            s_all = jax.lax.all_gather(s, BLOCK_AXIS)   # (D, B, k_local)
+            g_all = jax.lax.all_gather(gi, BLOCK_AXIS)
+            s_cat = jnp.moveaxis(s_all, 0, 1).reshape(q.shape[0], -1)
+            g_cat = jnp.moveaxis(g_all, 0, 1).reshape(q.shape[0], -1)
+            ms, mi = jax.lax.top_k(s_cat, k)  # k <= D*k_local == n_pad
+            return ms, jnp.take_along_axis(g_cat, mi, axis=1)
+
+        return run(matrix, bias, qs)
+
+    _sharded_program_cache[mesh] = sharded_topk
+    return sharded_topk
+
+
 class DeviceFactorIndex:
     def __init__(self, table: ModelTable, factor_suffix: str = "-I",
                  engine: Optional[str] = None):
@@ -138,12 +275,43 @@ class DeviceFactorIndex:
         self._lock = threading.Lock()
         self._ids: List[str] = []
         self._id_pos: dict = {}   # id -> row index in the device matrix
-        self._matrix = None  # (n, k) device array
+        self._matrix = None  # (n_pad, k) device array (maybe mesh-sharded)
         self._n_real = 0
         self._k_real = 0  # real factor width
         self._topk_fn = None
         self._topk_many_fn = None
         self._built_once = False
+        # retrieval tiers (module docstring): sharded exact layout +
+        # optional IVF ANN shortlist.  Knobs are read once per index; the
+        # background rebuild re-evaluates the SIZE thresholds each swap,
+        # so a catalog growing past them upgrades tiers without restarts.
+        self.tier = _tier_mode()
+        self._shard_mode = os.environ.get("TPUMS_TOPK_SHARDED", "auto")
+        self._shard_min_rows = int(
+            os.environ.get("TPUMS_TOPK_SHARD_MIN_ROWS", 100_000))
+        self._ann_min_rows = int(
+            os.environ.get("TPUMS_ANN_MIN_ROWS", 200_000))
+        self._ann_recall_min = float(
+            os.environ.get("TPUMS_ANN_RECALL_MIN", 0.95))
+        self._is_sharded = False
+        self._mesh = None        # set when the sharded layout engages
+        self._bias = None        # (n_pad,) pad-row score bias (sharded)
+        self._n_pad = 0
+        self._ann = None         # serve.ann.IVFIndex when the tier is built
+        # retrieval-plane health (obs/scrape.fleet_signals): rebuild rate,
+        # dirty backlog depth, and how stale the serving matrix is
+        # relative to the oldest unabsorbed update
+        reg = obs_metrics.get_registry()
+        self._obs_rebuilds = reg.counter("tpums_topk_rebuilds_total")
+        self._obs_dirty_depth = reg.gauge("tpums_topk_dirty_depth")
+        # staleness is labeled per-process: the fleet merge SUMS
+        # same-labeled gauges, and a sum of stalenesses means nothing —
+        # distinct series let fleet_signals take the max
+        self._obs_staleness = reg.gauge(
+            "tpums_topk_index_staleness_seconds", pid=str(os.getpid()))
+        self._obs_ann_recall = reg.gauge(
+            "tpums_ann_recall_probe", pid=str(os.getpid()))
+        self._oldest_dirty_ts: Optional[float] = None
         # dirty-key plumbing: the table's writer thread appends, the query
         # path drains.  Tables without listener support (none in-tree) fall
         # back to counter-triggered full rebuilds.
@@ -183,6 +351,8 @@ class DeviceFactorIndex:
         if key.endswith(self.suffix) and not key.startswith("MEAN"):
             with self._dirty_lock:
                 self._dirty.add(key)
+                if self._oldest_dirty_ts is None:
+                    self._oldest_dirty_ts = time.time()
 
     def _on_put_many(self, keys) -> None:  # writer thread, table lock held
         """Batched change notification: the dirty lock is taken ONCE per
@@ -199,6 +369,8 @@ class DeviceFactorIndex:
         if len(keys) >= self.rebuild_backlog:
             with self._dirty_lock:
                 self._replay_backlog += len(keys)
+                if self._oldest_dirty_ts is None:
+                    self._oldest_dirty_ts = time.time()
             return
         suffix = self.suffix
         relevant = [
@@ -208,15 +380,22 @@ class DeviceFactorIndex:
         if relevant:
             with self._dirty_lock:
                 self._dirty.update(relevant)
+                if self._oldest_dirty_ts is None:
+                    self._oldest_dirty_ts = time.time()
 
     def _drain_dirty(self, limit: Optional[int] = None) -> set:
         with self._dirty_lock:
             if limit is None or len(self._dirty) <= limit:
                 dirty, self._dirty = self._dirty, set()
+                if not self._replay_backlog:
+                    self._oldest_dirty_ts = None
                 return dirty
             dirty = set()
             while len(dirty) < limit:
                 dirty.add(self._dirty.pop())
+            # leftovers keep the backlog timestamp: an approximation (the
+            # oldest remaining key may be newer than the drained ones) that
+            # only ever OVERSTATES staleness — the honest direction
             return dirty
 
     # -- building -----------------------------------------------------------
@@ -279,17 +458,136 @@ class DeviceFactorIndex:
             rows.append(vec)
         return out_ids, np.asarray(rows, dtype=np.float32), width
 
+    def _mesh_if_sharding(self, n_rows: int):
+        """The mesh to shard over, or None for the single-device layout.
+        ``TPUMS_TOPK_SHARDED``: ``auto`` (default — shard past the row
+        floor when >1 device is visible), ``1`` force, ``0`` off."""
+        mode = self._shard_mode
+        if mode == "0":
+            return None
+        mesh = _index_mesh()
+        if mesh is None:
+            return None
+        if mode != "1" and n_rows < self._shard_min_rows:
+            return None
+        return mesh
+
     def _pack(self, rows):
+        """Place the factor rows on device ->
+        ``(matrix, bias, n_pad, is_sharded)``.
+
+        Single-device: the exact array, no padding (unchanged from the
+        host-pinned plane).  Sharded: rows are padded to the shared
+        power-of-two per-shard bucket (``mesh.row_bucket``) and laid out
+        row-sharded over the mesh's block axis, with a same-sharded bias
+        vector stamping ``_PAD_SCORE`` on pad rows so they can never win
+        a merge — the padding keeps XLA at a handful of compiled shapes
+        over the catalog's whole growth curve."""
         import jax
 
-        return jax.device_put(
-            np.asarray(rows, dtype=np.float32), _target_device()
-        )
+        rows = np.asarray(rows, dtype=np.float32)
+        mesh = self._mesh_if_sharding(rows.shape[0])
+        if mesh is None:
+            return (
+                jax.device_put(rows, _target_device()), None,
+                rows.shape[0], False,
+            )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import BLOCK_AXIS, num_blocks, row_bucket
+
+        self._mesh = mesh
+        n = rows.shape[0]
+        n_pad = row_bucket(n, num_blocks(mesh))
+        mat = np.zeros((n_pad, rows.shape[1]), np.float32)
+        mat[:n] = rows
+        bias = np.zeros((n_pad,), np.float32)
+        bias[n:] = _PAD_SCORE
+        matrix = jax.device_put(
+            mat, NamedSharding(mesh, P(BLOCK_AXIS, None)))
+        bias = jax.device_put(bias, NamedSharding(mesh, P(BLOCK_AXIS)))
+        return matrix, bias, n_pad, True
+
+    def _maybe_build_ann(self, rows):
+        """Build the IVF tier for this catalog snapshot, or None when the
+        tier knob / size threshold says exact-only.  Runs OFF the index
+        lock on the rebuild path (k-means + list assignment is the
+        expensive half of a swap); a failed build degrades to the exact
+        tier rather than poisoning the swap."""
+        tier = self.tier
+        n = len(rows)
+        if tier == "exact" or n == 0:
+            return None
+        if tier == "auto" and n < self._ann_min_rows:
+            return None
+        try:
+            from .ann import IVFIndex
+
+            ann = IVFIndex.build(np.asarray(rows, dtype=np.float32))
+        except Exception as e:  # pragma: no cover - defensive
+            print(f"[topk] IVF build failed (serving exact): {e}",
+                  file=sys.stderr)
+            return None
+        self._obs_ann_recall.set(ann.recall_probe)
+        if tier == "auto" and ann.recall_probe < self._ann_recall_min:
+            # the recall contract failed on THIS catalog's geometry: auto
+            # degrades to exact (forced tier=ivf serves anyway — the
+            # operator asked for it — but the probe gauge shows the miss)
+            print(
+                f"[topk] IVF recall probe {ann.recall_probe:.3f} < "
+                f"{self._ann_recall_min} gate; serving exact",
+                file=sys.stderr,
+            )
+            return None
+        return ann
+
+    def _assemble(self, ids, rows, width) -> dict:
+        """The expensive half of a (re)build — device placement, ANN
+        training, scatter warm-up — safe to run OFF the index lock.  The
+        result swaps in atomically via ``_swap_locked``."""
+        matrix = bias = ann = None
+        n_pad, sharded = 0, False
+        if len(rows):
+            matrix, bias, n_pad, sharded = self._pack(rows)
+            ann = self._maybe_build_ann(rows)
+            if ann is not None and sharded:
+                # the re-rank gathers from the SHARDED matrix: the tiny
+                # quantizer arrays must live on the same mesh or jit
+                # refuses the device mix
+                ann.colocate(self._mesh)
+            if not self._counter_mode:
+                # warm the fixed-shape update scatter at the NEW matrix
+                # shape (result discarded — pure compile warm-up) so the
+                # first streaming update never pays a compile on the
+                # query path
+                pos = np.zeros((self.apply_cap,), dtype=np.int32)
+                vec = np.zeros(
+                    (self.apply_cap, matrix.shape[1]), dtype=np.float32)
+                matrix.at[pos].set(vec).block_until_ready()
+        return {
+            "ids": ids, "id_pos": {id_: i for i, id_ in enumerate(ids)},
+            "n_real": len(ids), "k_real": width, "matrix": matrix,
+            "bias": bias, "n_pad": n_pad, "sharded": sharded, "ann": ann,
+        }
+
+    def _swap_locked(self, a: dict) -> None:
+        """Install an assembled index state (under self._lock)."""
+        self._ids = a["ids"]
+        self._id_pos = a["id_pos"]
+        self._n_real = a["n_real"]
+        self._k_real = a["k_real"]
+        self._matrix = a["matrix"]
+        self._bias = a["bias"]
+        self._n_pad = a["n_pad"]
+        self._is_sharded = a["sharded"]
+        self._ann = a["ann"]
+        self._built_once = True
+        self.full_builds += 1
+        self._obs_rebuilds.inc()
+        self._peek_applied.clear()
 
     def _build_locked(self) -> None:
         """Full build, called under self._lock."""
-        import jax
-
         _target_device()  # resolve platform pins before first backend touch
 
         # keys changed while we snapshot stay dirty for the next query
@@ -297,26 +595,29 @@ class DeviceFactorIndex:
         with self._dirty_lock:
             self._replay_backlog = 0  # full build absorbs the replay rows
         ids, rows, width = self._snapshot_rows()
-        self._ids = ids
-        self._id_pos = {id_: i for i, id_ in enumerate(ids)}
-        self._n_real = len(ids)
-        self._k_real = width
-        self._matrix = self._pack(rows) if len(rows) else None
-        self._built_once = True
-        self.full_builds += 1
-        if self._matrix is not None and not self._counter_mode:
-            # warm the fixed-shape update scatter so the first streaming
-            # update doesn't pay its compile on the query path
-            self._scatter_rows_locked([0], [rows[0]])
-        if self._topk_fn is None:
-            from functools import partial
+        self._swap_locked(self._assemble(ids, rows, width))
 
-            @partial(jax.jit, static_argnums=2)
-            def topk_fn(matrix, query, k):
-                scores = matrix @ query  # (n_items,) — one MXU pass
-                return jax.lax.top_k(scores, k)
-
-            self._topk_fn = topk_fn
+    def bulk_load(self, ids, rows) -> None:
+        """Install a pre-parsed catalog directly — semantically a full
+        build whose table snapshot parsed to exactly ``(ids, rows)``.
+        The bench harness and ``scripts/ann_profile.py`` use it to stand
+        up 1M–10M-row catalogs without materializing 10M payload strings
+        through the table; later updates via the table flow through the
+        normal dirty-set maintenance (unknown ids trigger a rebuild whose
+        snapshot reads the TABLE, so a bulk-loaded catalog absent from
+        the table reverts — this is a load ramp, not a second source of
+        truth)."""
+        rows = np.asarray(rows, dtype=np.float32)
+        if rows.ndim != 2 or len(ids) != rows.shape[0]:
+            raise ValueError("bulk_load needs ids aligned with (n, k) rows")
+        with self._lock:
+            _target_device()
+            self._drain_dirty()
+            with self._dirty_lock:
+                self._replay_backlog = 0
+            self._swap_locked(
+                self._assemble(list(ids), rows,
+                               rows.shape[1] if rows.size else None))
 
     def _apply_updates_locked(self, dirty: set, allow_rebuild: bool = True) -> None:
         """In-place device update of already-indexed rows; new ids kick one
@@ -415,23 +716,12 @@ class DeviceFactorIndex:
                     replay_snap = self._replay_backlog
                     self._replay_backlog = 0
                 ids, rows, width = self._snapshot_rows()
-                matrix = self._pack(rows) if len(rows) else None
-                if matrix is not None:
-                    # warm the fixed-shape update scatter for the NEW matrix
-                    # shape here, off the query path (result discarded)
-                    pos = np.zeros((self.apply_cap,), dtype=np.int32)
-                    vec = np.zeros(
-                        (self.apply_cap, matrix.shape[1]), dtype=np.float32
-                    )
-                    matrix.at[pos].set(vec).block_until_ready()
+                # device placement, scatter warm-up, and the (potentially
+                # seconds-long) IVF k-means all run OFF the index lock —
+                # queries keep answering from the current index meanwhile
+                assembled = self._assemble(ids, rows, width)
                 with self._lock:
-                    self._ids = ids
-                    self._id_pos = {id_: i for i, id_ in enumerate(ids)}
-                    self._n_real = len(ids)
-                    self._k_real = width
-                    self._matrix = matrix
-                    self.full_builds += 1
-                    self._peek_applied.clear()
+                    self._swap_locked(assembled)
             except Exception as e:  # pragma: no cover - defensive
                 # the drained updates must not be lost: put them back so
                 # the next query re-applies them and (for the structural
@@ -451,11 +741,24 @@ class DeviceFactorIndex:
 
     # -- querying -----------------------------------------------------------
 
+    def _observe_health(self) -> None:
+        """Publish the retrieval-plane health gauges (dirty backlog depth
+        and how long the oldest unabsorbed update has been waiting) —
+        what ``obs/scrape.fleet_signals`` surfaces to the autoscaler/SLO
+        layer as ``topk_dirty_depth`` / ``topk_staleness_s``."""
+        with self._dirty_lock:
+            depth = len(self._dirty) + self._replay_backlog
+            oldest = self._oldest_dirty_ts
+        self._obs_dirty_depth.set(depth)
+        self._obs_staleness.set(
+            max(time.time() - oldest, 0.0) if oldest is not None else 0.0)
+
     def _maintain_locked(self) -> None:
         """Index maintenance shared by the single and batched query paths
         (called under self._lock): (re)build on first use / counter tick,
         then drain-or-peek the dirty set exactly as the class docstring
         describes.  A batched query pays this ONCE for the whole batch."""
+        self._observe_health()
         if self._counter_mode:
             if self.table.puts != self._built_at:
                 built_at = self.table.puts
@@ -500,6 +803,60 @@ class DeviceFactorIndex:
                 if dirty:
                     self._apply_updates_locked(dirty, allow_rebuild=True)
 
+    @property
+    def prefers_frames(self) -> bool:
+        """True when the index's fast path is the batched frame program
+        (sharded layout and/or ANN shortlist): the microbatcher then
+        routes even a lone query through ``topk_many`` instead of the
+        legacy single-query program, so there is exactly ONE compiled
+        query program per batch bucket."""
+        return self._is_sharded or self._ann is not None
+
+    def _dispatch_frame_locked(self, q: np.ndarray, k_eff: int):
+        """One device dispatch for a ``(B, n_factors)`` query frame ->
+        ``(scores, idx)`` host arrays of shape (B, k_eff) — the tier
+        router.  ANN (when built and gated in) probes centroid lists and
+        exactly re-ranks the shortlist against the SAME resident matrix;
+        the sharded exact tier runs the shard_map partial-top-k + merge;
+        otherwise the legacy single-device batched program.  Every branch
+        funnels through ``_to_host`` with (B, k)-sized arrays only — the
+        catalog never leaves the device."""
+        if self._ann is not None:
+            scores, idx = self._ann.search(self._matrix, q, k_eff)
+            return _to_host(scores), _to_host(idx)
+        if self._is_sharded:
+            fn = _sharded_topk_program(self._mesh)
+            scores, idx = fn(self._matrix, self._bias, q, k_eff)
+            return _to_host(scores), _to_host(idx)
+        if self._topk_many_fn is None:
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnums=2)
+            def topk_many_fn(matrix, qs, k):
+                scores = qs @ matrix.T  # (B, n_items) — one MXU pass
+                return jax.lax.top_k(scores, k)
+
+            self._topk_many_fn = topk_many_fn
+        scores, idx = self._topk_many_fn(self._matrix, q, k_eff)
+        return _to_host(scores), _to_host(idx)
+
+    def _format_rows(self, scores, idx, n_rows: int):
+        """(B_pad, k) score/index arrays -> B result lists of (id, score).
+        Negative indices are masked ANN slots (shortlist came up short of
+        k — only possible when nprobe lists held < k real rows); they are
+        dropped rather than surfaced."""
+        ids = self._ids
+        return [
+            [
+                (ids[int(i)], float(s))
+                for i, s in zip(idx[b], scores[b])
+                if i >= 0
+            ]
+            for b in range(n_rows)
+        ]
+
     def topk(self, user_factors: np.ndarray, k: int) -> List[Tuple[str, float]]:
         with self._lock:
             self._maintain_locked()
@@ -513,10 +870,26 @@ class DeviceFactorIndex:
                 raise ValueError(
                     f"query has {q.shape[0]} factors, index has {n_fac}"
                 )
+            if self.prefers_frames:
+                # sharded / ANN tiers only compile the frame program; a
+                # lone query rides it as a (1, k) frame
+                scores, idx = self._dispatch_frame_locked(q[None, :], k_eff)
+                return self._format_rows(scores, idx, 1)[0]
+            if self._topk_fn is None:
+                from functools import partial
+
+                import jax
+
+                @partial(jax.jit, static_argnums=2)
+                def topk_fn(matrix, query, k):
+                    scores = matrix @ query  # (n_items,) — one MXU pass
+                    return jax.lax.top_k(scores, k)
+
+                self._topk_fn = topk_fn
             scores, idx = self._topk_fn(self._matrix, q, k_eff)
             return [
                 (self._ids[int(i)], float(s))
-                for i, s in zip(np.asarray(idx), np.asarray(scores))
+                for i, s in zip(_to_host(idx), _to_host(scores))
             ]
 
     def topk_many(
@@ -556,24 +929,8 @@ class DeviceFactorIndex:
                 q = np.concatenate(
                     [q, np.broadcast_to(q[:1], (b_pad - n_queries, q.shape[1]))]
                 )
-            if self._topk_many_fn is None:
-                import jax
-                from functools import partial
-
-                @partial(jax.jit, static_argnums=2)
-                def topk_many_fn(matrix, qs, k):
-                    scores = qs @ matrix.T  # (B, n_items) — one MXU pass
-                    return jax.lax.top_k(scores, k)
-
-                self._topk_many_fn = topk_many_fn
-            scores, idx = self._topk_many_fn(self._matrix, q, k_eff)
-            scores = np.asarray(scores)
-            idx = np.asarray(idx)
-            ids = self._ids
-            return [
-                [(ids[int(i)], float(s)) for i, s in zip(idx[b], scores[b])]
-                for b in range(n_queries)
-            ]
+            scores, idx = self._dispatch_frame_locked(q, k_eff)
+            return self._format_rows(scores, idx, n_queries)
 
     def warm_batch_shapes(self, k: int, max_batch: int = 32) -> None:
         """Pre-compile every padded-bucket batched program (power-of-two
