@@ -873,6 +873,9 @@ _COMPACT_KEYS = (
     "serving_native_topk_b2_speedup_c64", "serving_native_cutover_errors",
     "serving_ann_sharded_speedup", "serving_ann_ivf_speedup",
     "serving_ann_recall_at_100", "serving_ann_gate_recall_ok",
+    "serving_watch_overhead_pct", "serving_watch_mse_abs_diff",
+    "serving_watch_drift_fired", "serving_watch_detect_s",
+    "serving_watch_unattributed_page",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1126,7 +1129,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "BENCH_SECTIONS",
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
-        "serving_native,serving_update_plane,serving_rollout,serving_ann"
+        "serving_native,serving_update_plane,serving_rollout,serving_ann,"
+        "serving_watch"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1209,6 +1213,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_rollout", "run_serving_rollout_section",
          lambda f: f(small)),
         ("serving_ann", "run_serving_ann_section",
+         lambda f: f(small)),
+        ("serving_watch", "run_serving_watch_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

@@ -1739,6 +1739,219 @@ def run_serving_rehearsal_section(small: bool) -> dict:
     return out
 
 
+def run_serving_watch_section(small: bool) -> dict:
+    """Continuous-watch plane cost and efficacy (obs/watch.py):
+
+    1. **overhead (ABAB)** — GET round trips against one in-process
+       serving job with the watch loop (0.2 s cadence: fleet scrape +
+       canary probe + rules) running vs stopped, interleaved arms; the
+       bar is the same <= 3% p50 budget as the metrics on/off harness
+       (scripts/obs_overhead_ab.py).
+    2. **canary parity** — the live ``tpums_model_live_mse`` probe vs
+       ``eval.mse.compute_mse`` over the SAME probe slice read straight
+       off the serving table: identical payload strings through identical
+       grouping must agree to float-exactness (abs diff gate).
+    3. **drift demo** — deliberately-worse factors appended through the
+       journal (the live model-publication path); the canary's MSE must
+       cross the drift rule's threshold and fire a model_drift alert.
+    4. **rehearsal with watch** — the closed-loop rehearsal (kill
+       enabled) with a live watcher: the SIGKILL must be detected (page
+       alert) within the bound, attributed on the incident timeline
+       (zero unattributed pages), and the SLO report gains its
+       ``alerts`` section.
+    """
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.eval.mse import compute_mse
+    from flink_ms_tpu.obs.metrics import bucketed_quantiles
+    from flink_ms_tpu.obs.rules import Rule, default_rules
+    from flink_ms_tpu.obs.watch import FleetWatcher, ModelQualityCanary
+    from flink_ms_tpu.obs.workload import run_rehearsal
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import (ALS_STATE, ServingJob,
+                                             make_backend,
+                                             parse_als_record)
+    from flink_ms_tpu.serve.journal import Journal
+
+    n_users = 200 if small else 1_000
+    dim = 4
+    n_ratings = 400 if small else 1_500
+    n_q = int(os.environ.get("BENCH_WATCH_QUERIES", 300 if small else 800))
+    rounds = int(os.environ.get("BENCH_WATCH_ROUNDS", 4))
+    overhead_bar_pct = float(os.environ.get("BENCH_WATCH_OVERHEAD_BAR", 3.0))
+    detect_bound_s = float(os.environ.get("BENCH_WATCH_DETECT_S", 10.0))
+
+    tmp = tempfile.mkdtemp(prefix="tpums_watch_bench_")
+    saved_reg = os.environ.get("TPUMS_REGISTRY_DIR")
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    out: dict = {}
+    job = None
+    try:
+        rng = np.random.default_rng(0)
+        uf = rng.normal(size=(n_users, dim))
+        itf = rng.normal(size=(n_users, dim))
+        journal = Journal(os.path.join(tmp, "bus"), "models")
+        journal.append(
+            [F.format_als_row(u, "U", uf[u]) for u in range(n_users)]
+            + [F.format_als_row(i, "I", itf[i]) for i in range(n_users)])
+        users = rng.integers(0, n_users, size=n_ratings)
+        items = rng.integers(0, n_users, size=n_ratings)
+        # ratings near the model's own predictions: the healthy live MSE
+        # is ~noise², leaving the drift threshold orders of magnitude of
+        # headroom below the post-drift error
+        ratings = (np.einsum("nd,nd->n", uf[users], itf[items])
+                   + rng.normal(0.0, 0.05, size=n_ratings))
+        job = ServingJob(
+            journal, ALS_STATE, parse_als_record,
+            make_backend("memory", None),
+            host="127.0.0.1", port=0, poll_interval_s=0.01,
+        ).start()
+        assert job.wait_ready(120)
+
+        def client_factory():
+            return QueryClient("127.0.0.1", job.port, timeout_s=30)
+
+        canary = ModelQualityCanary(users, items, ratings,
+                                    client_factory, max_probe=256)
+        # the overhead arm carries the scrape/retain/evaluate loop only:
+        # the bar bounds the passive watch cost; the canary is an explicit
+        # probe WORKLOAD (a 256-key MGET against the serving path) whose
+        # cost is its own line item, measured in phase 2
+        watcher = FleetWatcher(interval_s=0.2, scope="bench_watch")
+
+        # -- 1. ABAB overhead on the GET hot path ------------------------
+        lat: dict = {"on": [], "off": []}
+        qrng = np.random.default_rng(1)
+        with QueryClient("127.0.0.1", job.port, timeout_s=60) as c:
+            for _ in range(50):  # steady-state warmup, uncounted
+                c.query_state(ALS_STATE, "1-U")
+            for r in range(rounds):
+                order = ("on", "off") if r % 2 == 0 else ("off", "on")
+                for arm in order:
+                    if arm == "on":
+                        watcher.start()
+                    for _ in range(n_q):
+                        key = f"{int(qrng.integers(0, n_users))}-U"
+                        t0 = time.perf_counter()
+                        c.query_state(ALS_STATE, key)
+                        lat[arm].append(time.perf_counter() - t0)
+                    if arm == "on":
+                        watcher.stop()
+        p50_on, = bucketed_quantiles(lat["on"], (50,))
+        p50_off, = bucketed_quantiles(lat["off"], (50,))
+        overhead_pct = (p50_on / p50_off - 1.0) * 100.0
+        out["serving_watch_get_p50_on_us"] = round(p50_on * 1e6, 2)
+        out["serving_watch_get_p50_off_us"] = round(p50_off * 1e6, 2)
+        out["serving_watch_overhead_pct"] = round(overhead_pct, 3)
+        out["serving_watch_overhead_bar_pct"] = overhead_bar_pct
+        out["serving_watch_overhead_ok"] = overhead_pct <= overhead_bar_pct
+        _log(f"[bench:watch] GET p50 on/off "
+             f"{p50_on * 1e6:.1f}/{p50_off * 1e6:.1f} us "
+             f"-> overhead {overhead_pct:+.2f}% (bar {overhead_bar_pct}%)")
+
+        # -- 2. canary parity vs eval/mse on the same slice --------------
+        probe = canary.probe()
+
+        def offline_lookup(key):
+            return ModelQualityCanary._parse(job.table.get(key))
+
+        mse_off, n_off, _ = compute_mse(
+            canary.users, canary.items, canary.ratings, offline_lookup)
+        abs_diff = (abs(probe["mse"] - mse_off)
+                    if probe["mse"] is not None and mse_off is not None
+                    else None)
+        out["serving_watch_mse_live"] = probe["mse"]
+        out["serving_watch_mse_offline"] = mse_off
+        out["serving_watch_mse_abs_diff"] = abs_diff
+        out["serving_watch_mse_parity_ok"] = (
+            abs_diff is not None and abs_diff <= 1e-9
+            and probe["n_scored"] == n_off)
+        out["serving_watch_probe_coverage"] = round(probe["coverage"], 4)
+        _log(f"[bench:watch] live MSE {probe['mse']} vs offline {mse_off} "
+             f"(diff {abs_diff}, coverage {probe['coverage']:.2%})")
+
+        # -- 3. drift demo: worse model through the journal --------------
+        drift_value = float(mse_off) + 0.5
+        drift_rules = [r for r in default_rules() if r.name != "model_drift"]
+        drift_rules.append(Rule(
+            name="model_drift", kind="threshold",
+            series="tpums_model_live_mse", mode="latest",
+            op=">", value=drift_value, severity="warn",
+            description="bench drift gate"))
+        journal.append(
+            [F.format_als_row(u, "U", rng.normal(size=dim) * 3.0)
+             for u in range(n_users)]
+            + [F.format_als_row(i, "I", rng.normal(size=dim) * 3.0)
+               for i in range(n_users)])
+        deadline = time.time() + 60
+        while job.offset < journal.end_offset() and time.time() < deadline:
+            time.sleep(0.05)
+        drift_watcher = FleetWatcher(interval_s=0.1, canary=canary,
+                                     rules=drift_rules,
+                                     scope="bench_watch_drift")
+        drift_fired = False
+        ticks = 0
+        while ticks < 50 and not drift_fired:
+            trs = drift_watcher.tick()
+            ticks += 1
+            drift_fired = any(t["kind"] == "alert_firing"
+                              and t["rule"] == "model_drift" for t in trs)
+            if not drift_fired:
+                time.sleep(0.05)
+        drift_watcher.stop()
+        out["serving_watch_drift_fired"] = drift_fired
+        out["serving_watch_drift_threshold"] = round(drift_value, 4)
+        out["serving_watch_drift_mse"] = (canary.last or {}).get("mse")
+        out["serving_watch_drift_ticks"] = ticks
+        _log(f"[bench:watch] drift alert fired={drift_fired} after "
+             f"{ticks} ticks (mse {(canary.last or {}).get('mse')}, "
+             f"threshold {drift_value:.3f})")
+        job.stop()
+        job = None
+    finally:
+        if job is not None:
+            try:
+                job.stop()
+            except Exception:
+                pass
+        if saved_reg is None:
+            os.environ.pop("TPUMS_REGISTRY_DIR", None)
+        else:
+            os.environ["TPUMS_REGISTRY_DIR"] = saved_reg
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- 4. rehearsal with the watch loop + injected kill ----------------
+    report = run_rehearsal(
+        out_path=os.environ.get("BENCH_WATCH_OUT", "SLO_REPORT_WATCH.json"),
+        shards=2, replication=2,
+        users=200 if small else 1_000,
+        base_qps=60 if small else 150,
+        peak_qps=120 if small else 300,
+        burst_qps=200 if small else 600,
+        warm_s=2.0, ramp_s=3.0, burst_s=4.0, cool_s=3.0,
+        threads=4,
+        autoscale="off", kill=True, seed=0,
+        watch=True, watch_interval_s=0.25,
+    )
+    alerts = report.get("alerts", {})
+    det = alerts.get("detection", {})
+    out["serving_watch_rehearsal_ok"] = report["ok"]
+    out["serving_watch_alerts_fired"] = alerts.get("fired_total")
+    out["serving_watch_unattributed_page"] = alerts.get("unattributed_page")
+    out["serving_watch_kills"] = det.get("kills")
+    out["serving_watch_detect_s"] = det.get("max_s")
+    out["serving_watch_detect_bound_s"] = detect_bound_s
+    out["serving_watch_detect_ok"] = (
+        det.get("kills", 0) > 0 and det.get("detected", 0) > 0
+        and det.get("max_s") is not None
+        and det.get("max_s") <= detect_bound_s)
+    out["serving_watch_avg_tick_s"] = alerts.get("avg_tick_s")
+    out["serving_watch_report"] = report.get("report_path")
+    _log(f"[bench:watch] rehearsal kill detection "
+         f"{det.get('max_s')}s (bound {detect_bound_s}s), "
+         f"unattributed pages {alerts.get('unattributed_page')}")
+    return out
+
+
 def run_serving_bootstrap_section(small: bool) -> dict:
     """Recovery and resharding cost vs journal length: is bootstrap
     O(state) or O(history)?  Three arms, each run at journal lengths of

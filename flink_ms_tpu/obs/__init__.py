@@ -10,10 +10,18 @@ scrape.
   coordinated-omission-safe recording + the closed-loop rehearsal driver.
 - ``obs.slo`` — declarative per-verb objectives, error-budget burn rates,
   and the ``SLOReport`` artifact with event attribution.
+- ``obs.tsdb`` — bounded ring time-series retention for the watch loop
+  (rate/quantile/derivative queries over trailing windows).
+- ``obs.rules`` — declarative alert rules: thresholds, absence,
+  multi-window burn rate, ``for:`` hold-down, flap suppression.
+- ``obs.watch`` — the continuous fleet watch loop + model-quality canary
+  (``python -m flink_ms_tpu.obs.watch``).
 
 Knobs: ``TPUMS_METRICS=0`` disables collection (observations become one
 attribute check); ``TPUMS_TRACE=<path>`` mirrors events to a JSONL file
-(``-`` = stderr) in addition to the in-process ring buffer.
+(``-`` = stderr) in addition to the in-process ring buffer;
+``TPUMS_WATCH_*`` sizes the watch loop (see README "Fleet watch &
+alerting").
 """
 
 from .metrics import (  # noqa: F401
@@ -51,6 +59,6 @@ from .tracing import (  # noqa: F401
     unstamp_reply,
 )
 
-# workload/slo are intentionally NOT imported eagerly: they pull in the
-# serving stack when actually driven.  Import them as submodules
-# (``from flink_ms_tpu.obs import workload, slo``).
+# workload/slo/tsdb/rules/watch are intentionally NOT imported eagerly:
+# they pull in the serving stack when actually driven.  Import them as
+# submodules (``from flink_ms_tpu.obs import workload, slo, watch``).

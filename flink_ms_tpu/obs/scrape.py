@@ -23,6 +23,8 @@ from __future__ import annotations
 import json
 import socket
 import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from ..serve import registry
@@ -31,6 +33,11 @@ from .metrics import (LATENCY_BUCKETS_S, merge_snapshots, render_prometheus,
 
 __all__ = ["scrape_endpoint", "scrape_fleet", "fleet_signals",
            "snapshot_quantile", "main"]
+
+# scrape fan-out width: enough that one wedged endpoint can't stretch the
+# scrape past ~one timeout even on a wide fleet, small enough that a
+# watch tick doesn't spawn a thread herd
+_SCRAPE_POOL_MAX = 16
 
 
 def scrape_endpoint(host: str, port: int, timeout_s: float = 2.0
@@ -65,15 +72,24 @@ def scrape_endpoint(host: str, port: int, timeout_s: float = 2.0
 
 
 def scrape_fleet(timeout_s: float = 2.0) -> dict:
-    """Scrape every live registry entry and aggregate.
+    """Scrape every live registry entry CONCURRENTLY and aggregate.
 
     Returns::
 
         {"replicas":  [{"job_id", "shard_group", "replica", "ready",
-                        "host", "port", "snapshot"|None}, ...],
+                        "host", "port", "snapshot"|None,
+                        "stale", "scrape_s"}, ...],
          "per_shard": {shard_group: merged-snapshot, ...},
          "fleet":     merged-snapshot,
-         "scraped": N, "unreachable": M}
+         "scraped": N, "unreachable": M,
+         "scrape_duration_s": wall seconds for the whole fan-out}
+
+    Replica polls run on a small thread pool with the per-endpoint
+    ``timeout_s``, so one dead or wedged replica costs the scrape ONE
+    timeout instead of serially stalling the cadence behind it; a replica
+    that failed to answer carries ``stale: True`` (its last-known state
+    may still exist in a retained store) and ``scrape_s`` records its
+    individual round-trip.
 
     ``shard_group`` falls back to the job_id for unsharded jobs, so a
     single standalone worker still aggregates sanely.
@@ -84,13 +100,28 @@ def scrape_fleet(timeout_s: float = 2.0) -> dict:
     the autoscaler's p99 quietly loses a whole plane's traffic — that is a
     build-skew bug, so it raises here instead of degrading.
     """
+    t_start = time.time()
+    entries = registry.list_jobs()
+    expected_le = list(LATENCY_BUCKETS_S)
+
+    def poll(entry: dict) -> tuple:
+        t0 = time.time()
+        snap = scrape_endpoint(entry.get("host", "localhost"),
+                               entry["port"], timeout_s=timeout_s)
+        return snap, time.time() - t0
+
+    if entries:
+        with ThreadPoolExecutor(
+                max_workers=min(len(entries), _SCRAPE_POOL_MAX),
+                thread_name_prefix="tpums-scrape") as pool:
+            polled = list(pool.map(poll, entries))
+    else:
+        polled = []
+
     replicas: List[dict] = []
     per_group: Dict[str, List[dict]] = {}
     unreachable = 0
-    expected_le = list(LATENCY_BUCKETS_S)
-    for entry in registry.list_jobs():
-        snap = scrape_endpoint(entry.get("host", "localhost"),
-                               entry["port"], timeout_s=timeout_s)
+    for entry, (snap, scrape_s) in zip(entries, polled):
         if snap is not None and (
                 snap.get("meta", {}).get("plane") == "native"):
             for h in snap.get("histograms", []):
@@ -111,6 +142,8 @@ def scrape_fleet(timeout_s: float = 2.0) -> dict:
             "host": entry.get("host"),
             "port": entry.get("port"),
             "snapshot": snap,
+            "stale": snap is None,
+            "scrape_s": round(scrape_s, 6),
         })
         if snap is None:
             unreachable += 1
@@ -123,6 +156,7 @@ def scrape_fleet(timeout_s: float = 2.0) -> dict:
         "fleet": merge_snapshots(all_snaps),
         "scraped": len(all_snaps),
         "unreachable": unreachable,
+        "scrape_duration_s": round(time.time() - t_start, 6),
     }
 
 
@@ -178,6 +212,14 @@ def fleet_signals(before: dict, after: dict,
                            fleet at AFTER (min over pid-labeled
                            ``tpums_ann_recall_probe`` series; None when
                            no replica has an ANN tier built)}
+
+    Watch-plane state (round 12 — ``obs/watch.py``):
+
+        {"alerts_firing": currently-firing alert count (the watcher's
+                          ``tpums_alerts_firing`` gauge when present in
+                          AFTER, else the registry's published alert
+                          record),
+         "alerts_max_severity": "info"/"warn"/"page" or None}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -236,6 +278,28 @@ def fleet_signals(before: dict, after: dict,
          if g["name"] == "tpums_topk_index_staleness_seconds"), default=0.0)
     recall_series = [g["value"] for g in after.get("gauges", [])
                      if g["name"] == "tpums_ann_recall_probe"]
+    # alert state (round 12 — obs/watch.py): preferred source is the
+    # watcher's own gauges when the watch loop runs inside a scraped
+    # process; otherwise fall back to the registry's published alert
+    # record, which is how an out-of-process watcher reaches autoscaler
+    # callers of this function
+    firing = [g["value"] for g in after.get("gauges", [])
+              if g["name"] == "tpums_alerts_firing"]
+    sev = [g["value"] for g in after.get("gauges", [])
+           if g["name"] == "tpums_alerts_max_severity"]
+    if firing:
+        alerts_firing = sum(firing)
+        alerts_sev_level = max(sev) if sev else 0
+    else:
+        rec = registry.resolve_alerts()
+        alerts_firing = rec.get("firing", 0) if rec else 0
+        alerts_sev_level = rec.get("max_severity_level", 0) if rec else 0
+    try:
+        from .rules import severity_name
+        alerts_max_severity = (severity_name(alerts_sev_level)
+                               if alerts_sev_level else None)
+    except ImportError:  # pragma: no cover - rules is stdlib-only
+        alerts_max_severity = None
     return {
         "qps": requests / dt_s,
         "p99_s": snapshot_quantile(window, 99) if window else None,
@@ -246,6 +310,8 @@ def fleet_signals(before: dict, after: dict,
         "topk_dirty_depth": dirty_depth,
         "topk_staleness_s": staleness,
         "ann_recall": min(recall_series) if recall_series else None,
+        "alerts_firing": alerts_firing,
+        "alerts_max_severity": alerts_max_severity,
         "dt_s": dt_s,
         "requests": requests,
     }

@@ -58,6 +58,7 @@ CLIENT_TO_SERVER_VERB: Dict[str, Optional[str]] = {
 # event kinds that can legitimately explain an excursion
 DISRUPTIVE_KINDS = frozenset({
     "rehearsal_kill", "chaos_kill", "chaos_kill_warming",
+    "chaos_teardown",
     "elastic_scale_start", "elastic_cutover", "elastic_drained",
     "elastic_scale_abort", "generation_swap", "failover",
     "replica_respawn", "autoscale_decision",

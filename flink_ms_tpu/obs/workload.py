@@ -593,6 +593,7 @@ DEFAULT_VERB_WEIGHTS = {
 # attribute an excursion to, plus the phases themselves)
 _TIMELINE_KINDS = (
     "workload_phase", "rehearsal_kill", "chaos_kill", "chaos_kill_warming",
+    "chaos_teardown",
     "elastic_scale_start", "elastic_cutover", "elastic_drained",
     "elastic_scale_abort", "generation_swap", "failover",
     "replica_respawn", "autoscale_decision",
@@ -648,6 +649,10 @@ def run_rehearsal(
     zipf_exponent: float = 1.1,
     update_plane: bool = True,
     abusive_qps: float = 0.0,
+    watch: bool = False,
+    watch_rules=None,
+    watch_canary=None,
+    watch_interval_s: float = 0.5,
 ) -> dict:
     """The closed loop: elastic sharded group + open-loop zipfian mixed-verb
     engine + autoscaler + one chaos kill, all acting on the same fleet,
@@ -667,6 +672,13 @@ def run_rehearsal(
     objective-free SLO entries — their sheds are attributed
     (``admission_shed``), not breached — and the report's gate becomes
     "in-quota traffic unharmed while the abuser is shed".
+
+    With ``watch=True`` a live ``obs.watch.FleetWatcher`` runs through the
+    load window (its own cadence, ``watch_interval_s``; rules default to
+    the fleet baseline or ``watch_rules``; an optional ``watch_canary``
+    probes live model quality) and the report gains an ``"alerts"``
+    section — the live incident timeline with per-kill detection latency
+    and attribution, instead of only the terminal SLO post-mortem.
     """
     from . import slo as obs_slo
     from .scrape import scrape_fleet
@@ -709,6 +721,7 @@ def run_rehearsal(
     base = tempfile.mkdtemp(prefix="tpums_rehearsal_")
     ctl = None
     autoscaler = None
+    watcher = None
     sampler_stop = threading.Event()
     scrapes: List[Tuple[float, dict]] = []
 
@@ -828,6 +841,13 @@ def run_rehearsal(
         sampler_t = threading.Thread(target=sampler, daemon=True)
         sampler_t.start()
 
+        if watch:
+            from .watch import FleetWatcher
+            watcher = FleetWatcher(interval_s=watch_interval_s,
+                                   rules=watch_rules,
+                                   canary=watch_canary,
+                                   scope=live_group).start()
+
         killer_t = None
         if kill and ctl is not None:
             if kill_at_s is None:
@@ -862,6 +882,17 @@ def run_rehearsal(
             autoscaler.stop()
         sampler_stop.set()
         sampler_t.join(timeout=10)
+        alerts_section = None
+        if watcher is not None:
+            # one last synchronous tick so a kill in the final moments is
+            # still observed before the loop stops
+            try:
+                watcher.tick()
+            except Exception:
+                pass
+            watcher.stop()
+            alerts_section = watcher.watch_summary()
+            alerts_section["transitions"] = list(watcher.engine.history)
         fleet_after = scrape_fleet()["fleet"]
         scrapes.append((time.time(), fleet_after))
 
@@ -894,6 +925,8 @@ def run_rehearsal(
                 "abusive_qps": abusive_qps,
             },
         )
+        if alerts_section is not None:
+            report["alerts"] = alerts_section
         if out_path:
             with open(out_path, "w") as f:
                 json.dump(report, f, indent=1, default=str)
@@ -902,6 +935,11 @@ def run_rehearsal(
         return report
     finally:
         sampler_stop.set()
+        if watcher is not None:
+            try:
+                watcher.stop()
+            except Exception:
+                pass
         if autoscaler is not None:
             try:
                 autoscaler.stop()
@@ -952,9 +990,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         attach_group=params.get("group", None),
         zipf_exponent=float(params.get("zipf", "1.1")),
         abusive_qps=float(params.get("abusiveQps", "0")),
+        watch=params.get_int("watch", 0) != 0,
     )
     sys.stderr.write(obs_slo.human_summary(report) + "\n")
-    print(json.dumps({
+    out = {
         "ok": report["ok"],
         "report": report.get("report_path"),
         "verbs": {v: {"availability": s["availability"],
@@ -962,7 +1001,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   for v, s in report["verbs"].items()},
         "breaches": len(report["breaches"]),
         "unattributed_errors": report["errors"]["unattributed"],
-    }, indent=1))
+    }
+    if "alerts" in report:
+        out["alerts"] = {k: report["alerts"][k] for k in
+                         ("fired_total", "unattributed_page", "detection")}
+    print(json.dumps(out, indent=1))
     return 0 if report["ok"] else 1
 
 

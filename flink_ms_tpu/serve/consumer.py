@@ -454,7 +454,7 @@ class ServingJob:
     def health(self) -> dict:
         """The HEALTH verb's payload (key count is added server-side)."""
         ready = self.ready
-        return {
+        payload = {
             "state": self.state_name,
             "job_id": self.job_id,
             "ready": ready,
@@ -470,6 +470,36 @@ class ServingJob:
             "bootstrap_source": self.bootstrap_source,
             "bootstrap_seconds": self.bootstrap_seconds,
         }
+        alerts = self._alert_hint()
+        if alerts is not None:
+            # same opt-in discipline as tn=/tid=: the fields appear ONLY
+            # when a watcher has published a fresh alert record (and the
+            # TPUMS_WATCH_HEALTH_HINT kill switch is not thrown), so a
+            # fleet without a watch loop keeps its HEALTH bytes unchanged
+            payload["alerts_firing"] = alerts["firing"]
+            payload["alerts_max_severity"] = alerts["max_severity"]
+        return payload
+
+    # HEALTH is a hot poll path (supervisors, elastic clients): cache the
+    # registry alert-record read for ~1s rather than hitting the
+    # filesystem per reply
+    _ALERT_HINT_TTL_S = 1.0
+
+    def _alert_hint(self) -> Optional[dict]:
+        if os.environ.get("TPUMS_WATCH_HEALTH_HINT", "1") == "0":
+            return None
+        now = time.time()
+        cached = getattr(self, "_alert_hint_cache", None)
+        if cached is not None and now - cached[0] < self._ALERT_HINT_TTL_S:
+            return cached[1]
+        from . import registry
+
+        try:
+            rec = registry.resolve_alerts()
+        except Exception:  # noqa: BLE001 - hint must never break HEALTH
+            rec = None
+        self._alert_hint_cache = (now, rec)
+        return rec
 
     # -- snapshot bootstrap / publication (serve/snapshot.py) --------------
 

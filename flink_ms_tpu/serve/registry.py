@@ -591,6 +591,92 @@ def resolve_snapshot(scope: str) -> Optional[dict]:
     return record.get("manifest") if record else None
 
 
+# ---------------------------------------------------------------------------
+# alert records (obs/watch.py publishes, HEALTH hints and fleet_signals
+# read) — same best-effort file-per-record shape as snapshot manifests.
+# Records carry their own TTL so a dead watcher's last word expires
+# instead of pinning stale alerts onto every HEALTH reply forever.
+# ---------------------------------------------------------------------------
+
+def _alerts_path(scope: str) -> str:
+    return _group_path(f"alerts/{scope}", "alerts.json")
+
+
+def publish_alerts(scope: str, summary: dict, ttl_s: float = 15.0) -> None:
+    """Publish a watcher's alert summary (``RulesEngine.summary()`` shape:
+    ``{"firing", "max_severity", "max_severity_level", "alerts"}``) under
+    ``scope`` (a group name, or ``"fleet"`` for a whole-fleet watcher)."""
+    os.makedirs(registry_dir(), exist_ok=True)
+    path = _alerts_path(scope)
+    record = {"kind": "alerts", "scope": scope,
+              "published_at": time.time(), "ttl_s": float(ttl_s),
+              "summary": dict(summary)}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def resolve_alerts(scope: Optional[str] = None) -> Optional[dict]:
+    """The current alert summary: one scope's fresh record, or — with no
+    scope — every fresh record merged (firing counts sum, severities take
+    the max).  Expired records are GC'd on the way past.  None when no
+    watcher has published anything fresh."""
+    if scope is not None:
+        record = _read_record(_alerts_path(scope), "alerts")
+        if record is None:
+            return None
+        if time.time() - record.get("published_at", 0) > \
+                record.get("ttl_s", 15.0):
+            drop_alerts(scope)
+            return None
+        return record.get("summary")
+    merged: Optional[dict] = None
+    try:
+        names = os.listdir(registry_dir())
+    except OSError:
+        return None
+    now = time.time()
+    for fname in names:
+        if not fname.startswith("alerts_") or \
+                not fname.endswith(".alerts.json"):
+            continue
+        path = os.path.join(registry_dir(), fname)
+        record = _read_record(path, "alerts")
+        if record is None:
+            continue
+        if now - record.get("published_at", 0) > record.get("ttl_s", 15.0):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        s = record.get("summary", {})
+        if merged is None:
+            merged = {"firing": 0, "max_severity": None,
+                      "max_severity_level": 0, "alerts": []}
+        merged["firing"] += int(s.get("firing", 0))
+        merged["alerts"].extend(s.get("alerts", []))
+        if s.get("max_severity_level", 0) > merged["max_severity_level"]:
+            merged["max_severity_level"] = s["max_severity_level"]
+            merged["max_severity"] = s.get("max_severity")
+    return merged
+
+
+def drop_alerts(scope: str) -> None:
+    """Remove a scope's alert record (watcher teardown; best-effort)."""
+    try:
+        os.unlink(_alerts_path(scope))
+    except OSError:
+        pass
+
+
 def generation_of(entry: dict, group: str, gen_sep: str = "@g"
                   ) -> Optional[int]:
     """Parse the topology generation out of a worker entry's shard-group id
@@ -653,7 +739,13 @@ def acquire_controller_lease(group: str, ttl_s: Optional[float] = None
     within ``ttl_s`` (default: the replica TTL) or is presumed dead, and a
     dead holder's lease (pid gone, or heartbeat lapsed) is STOLEN — with
     the same read-back guard as entry reaping, so two stealers cannot both
-    win one corpse."""
+    win one corpse.
+
+    Acquisition is link-based so the lease file appears ATOMICALLY with
+    its full contents: an O_EXCL create would expose an empty file for
+    the duration of the winner's write, and a concurrent acquirer reading
+    that window judged the record a torn-write corpse and claimed it too
+    — two winners for one fresh lease."""
     import socket
     import uuid
 
@@ -667,38 +759,49 @@ def acquire_controller_lease(group: str, ttl_s: Optional[float] = None
         "ttl_s": replica_ttl_s() if ttl_s is None else float(ttl_s),
     }
     data = json.dumps(entry)
-    try:
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        with os.fdopen(fd, "w") as f:
-            f.write(data)
-        return token
-    except FileExistsError:
-        pass
-    current = _read_record(path, "controller")
-    if current is None:
-        # unreadable/foreign record: replace it (a torn write is a corpse)
-        current = {}
-    elif not entry_is_dead(current):
-        return None
-    # steal guarded against the live holder racing us: write the claim
-    # aside, re-read, and only replace while the record still shows the
-    # same dead (pid, heartbeat) we judged
     tmp = f"{path}.{os.getpid()}.{token[:8]}.tmp"
     try:
         with open(tmp, "w") as f:
             f.write(data)
+        try:
+            os.link(tmp, path)
+            return token
+        except FileExistsError:
+            pass
+        current = _read_record(path, "controller")
+        if current is None:
+            # genuinely unreadable/foreign record (atomic creation means
+            # the normal path can no longer produce one): exactly ONE
+            # claimant recovers it — the rename is the mutual exclusion
+            corpse = f"{path}.corpse.{token[:8]}"
+            try:
+                os.rename(path, corpse)
+            except OSError:
+                return None
+            os.unlink(corpse)
+            try:
+                os.link(tmp, path)
+                return token
+            except FileExistsError:
+                return None
+        if not entry_is_dead(current):
+            return None
+        # steal guarded against the live holder racing us: re-read, and
+        # only replace while the record still shows the same dead
+        # (pid, heartbeat) we judged
         check = _read_record(path, "controller")
         if (check or {}).get("pid") == current.get("pid") and \
                 (check or {}).get("heartbeat") == current.get("heartbeat"):
             os.replace(tmp, path)
             return token
-        os.unlink(tmp)
+        return None
     except OSError:
+        return None
+    finally:
         try:
             os.unlink(tmp)
         except OSError:
             pass
-    return None
 
 
 def refresh_controller_lease(group: str, token: str) -> bool:

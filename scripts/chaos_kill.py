@@ -224,6 +224,9 @@ def main() -> int:
             event("chaos_recovery", shard=shard, replica=replica,
                   recovery_s=rec, recovered=rec is not None)
             recoveries.append(rec)
+        # the fleet is about to be torn down deliberately — mark it so the
+        # watch loop attributes the replica drop instead of paging blind
+        event("chaos_teardown", mode="ha")
 
     flat = [x for lane in lat_ms for x in lane]
     total_ok, total_err = sum(ok), sum(errs)
@@ -362,6 +365,7 @@ def elastic_main() -> int:
         return 1 if failed else 0
     finally:
         stop.set()
+        event("chaos_teardown", mode="elastic")
         ctl.stop(drop_topology=True)
 
 
@@ -538,6 +542,7 @@ def snapshot_main() -> int:
                     snapshot_audit["verified"] += 1
                 except snapshot_mod.SnapshotCorruptError as e:
                     snapshot_audit["corrupt"].append(str(e))
+        event("chaos_teardown", mode="snapshot")
 
     total_ok, total_err = sum(ok), sum(errs)
     total = total_ok + total_err
@@ -729,6 +734,7 @@ def rollout_main() -> int:
         return 1 if failed else 0
     finally:
         stop.set()
+        event("chaos_teardown", mode="rollout")
         ctl.stop(drop_topology=True)
 
 
@@ -841,6 +847,7 @@ def update_main() -> int:
                 drained = True
                 break
             time.sleep(0.1)
+        event("chaos_teardown", mode="update")
 
     audit = up.audit_partitions(journal.dir, "models")
     recovered = [rec for rec in recoveries if rec is not None]
@@ -867,8 +874,54 @@ def update_main() -> int:
     return 1 if failed else 0
 
 
+def run_with_watch(mode_fn) -> int:
+    """The watch arm (CHAOS_WATCH=1, default): run the mode under a live
+    ``obs.watch.FleetWatcher`` and tighten the exit gate with the alert
+    plane's own contract —
+
+    - zero UNATTRIBUTED page-severity alerts (every page must map to a
+      kill/cutover/teardown event in the incident timeline), and
+    - the kill -> first-page detection latency bounded by
+      ``CHAOS_WATCH_DETECT_S`` (default 10 s) whenever the watcher saw a
+      kill while at least one kill was detected at all.
+
+    The watch summary is printed as one ``{"watch": ...}`` JSON line after
+    the mode's own artifact, so drivers can consume both."""
+    if os.environ.get("CHAOS_WATCH", "1") == "0":
+        return mode_fn()
+    from flink_ms_tpu.obs.watch import FleetWatcher
+
+    # every mode spawns its own fleet; a private registry dir (operator
+    # override respected) keeps the watcher's scrape — and its GC of
+    # pid-dead entries — off any unrelated fleet on this host
+    os.environ.setdefault(
+        "TPUMS_REGISTRY_DIR", tempfile.mkdtemp(prefix="tpums_chaos_reg_"))
+    detect_bound_s = float(os.environ.get("CHAOS_WATCH_DETECT_S", 10.0))
+    watcher = FleetWatcher(
+        interval_s=float(os.environ.get("CHAOS_WATCH_INTERVAL_S", 0.5)),
+        scope="chaos",
+        attribution_window_s=float(
+            os.environ.get("CHAOS_WATCH_ATTR_S", 10.0)))
+    watcher.start()
+    try:
+        rc = mode_fn()
+    finally:
+        watcher.stop()
+    summary = watcher.watch_summary()
+    det = summary["detection"]
+    watch_failed = (
+        summary["unattributed_page"] > 0       # an unexplained page
+        or (det["kills"] > 0 and det["detected"] == 0)
+        or (det["max_s"] is not None and det["max_s"] > detect_bound_s)
+    )
+    summary["detect_bound_s"] = detect_bound_s
+    summary["watch_ok"] = not watch_failed
+    print(json.dumps({"watch": summary}, indent=1, default=str))
+    return rc or (1 if watch_failed else 0)
+
+
 if __name__ == "__main__":
-    sys.exit({"elastic": elastic_main,
-              "snapshot": snapshot_main,
-              "update": update_main,
-              "rollout": rollout_main}.get(MODE, main)())
+    sys.exit(run_with_watch({"elastic": elastic_main,
+                             "snapshot": snapshot_main,
+                             "update": update_main,
+                             "rollout": rollout_main}.get(MODE, main)))

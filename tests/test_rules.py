@@ -1,0 +1,405 @@
+"""Alert-rules + watch-loop tests (obs/rules.py, obs/watch.py): the
+pending->firing->resolved machine with ``for:`` hold-down, the
+multi-window burn-rate gate, absence rules, flap suppression, incident
+attribution (the ``unattributed == 0`` chaos gate), the live
+model-quality canary's parity with the offline evaluator, and the wire
+discipline of the HEALTH alert hint (absent-unless-in-use)."""
+
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from flink_ms_tpu.core import formats as F
+from flink_ms_tpu.obs.rules import (
+    Rule,
+    RulesEngine,
+    attribute_alerts,
+    default_rules,
+    load_rules,
+)
+from flink_ms_tpu.obs.tsdb import SeriesStore
+
+
+def _engine(rules, t0=1000.0):
+    return RulesEngine(rules, now=t0)
+
+
+def _fired(transitions, kind="alert_firing"):
+    return [t for t in transitions if t["kind"] == kind]
+
+
+# -- threshold + hold-down --------------------------------------------------
+
+def test_threshold_fires_and_resolves():
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([Rule(name="hot", series="g", mode="latest",
+                        op=">", value=5.0, severity="page")])
+    s.observe("g", 3.0, ts=1001.0)
+    assert eng.evaluate(s, now=1001.0) == []
+    s.observe("g", 9.0, ts=1002.0)
+    trs = eng.evaluate(s, now=1002.0)
+    assert _fired(trs) and trs[0]["rule"] == "hot"
+    assert trs[0]["measured"] == 9.0
+    assert eng.summary()["max_severity"] == "page"
+    s.observe("g", 1.0, ts=1003.0)
+    trs = eng.evaluate(s, now=1003.0)
+    assert _fired(trs, "alert_resolved")
+    assert eng.summary()["firing"] == 0
+
+
+def test_for_s_hold_down_delays_firing():
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([Rule(name="hot", series="g", mode="latest",
+                        op=">", value=5.0, for_s=10.0)])
+    s.observe("g", 9.0, ts=1001.0)
+    assert eng.evaluate(s, now=1001.0) == []      # pending
+    assert eng.evaluate(s, now=1005.0) == []      # still held down
+    trs = eng.evaluate(s, now=1011.0)             # 10s held -> fires
+    assert _fired(trs)
+    # a blip that clears during hold-down never fires
+    eng2 = _engine([Rule(name="hot", series="g2", mode="latest",
+                         op=">", value=5.0, for_s=10.0)])
+    s.observe("g2", 9.0, ts=1001.0)
+    eng2.evaluate(s, now=1001.0)
+    s.observe("g2", 1.0, ts=1002.0)
+    assert eng2.evaluate(s, now=1002.0) == []
+    assert eng2.evaluate(s, now=1020.0) == []
+
+
+def test_drop_mode_pages_on_replica_loss():
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([r for r in default_rules() if r.name == "replica_drop"])
+    for t in (1001.0, 1002.0, 1003.0):
+        s.observe("tpums_watch_replicas_total", 3.0, ts=t)
+    assert eng.evaluate(s, now=1003.0) == []
+    s.observe("tpums_watch_replicas_total", 2.0, ts=1004.0)  # SIGKILL'd
+    trs = eng.evaluate(s, now=1004.0)
+    assert _fired(trs) and trs[0]["severity"] == "page"
+    assert trs[0]["measured"] == 1.0
+
+
+# -- burn rate --------------------------------------------------------------
+
+def _burn_rule(**kw):
+    return Rule(name="burn", kind="burn_rate",
+                requests_series="req", errors_series="err",
+                availability_target=0.999, fast_window_s=60.0,
+                slow_window_s=300.0, burn_multiple=14.4,
+                severity="page", **kw)
+
+
+def test_burn_rate_requires_both_windows():
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([_burn_rule()], t0=0.0)
+    # slow window: healthy history (1000 req, 0 err), then a fast cliff
+    s.observe("req", 0.0, ts=700.0)
+    s.observe("err", 0.0, ts=700.0)
+    s.observe("req", 1000.0, ts=940.0)
+    s.observe("err", 0.0, ts=940.0)
+    # fast window: 100 req, 50 err -> fast burn 500x but slow ~47x... both
+    # actually burn; first check the fast-only case: tiny error count that
+    # torches the fast window but not the slow one
+    s.observe("req", 1100.0, ts=990.0)
+    s.observe("err", 3.0, ts=990.0)
+    # fast: 3/100 err = 30x budget >= 14.4; slow: 3/1100 ~ 2.7x < 14.4
+    trs = eng.evaluate(s, now=1000.0)
+    assert trs == []
+    # sustained cliff: errors keep pace in the slow window too
+    s.observe("req", 1200.0, ts=1100.0)
+    s.observe("err", 60.0, ts=1100.0)
+    trs = eng.evaluate(s, now=1100.0)
+    assert _fired(trs)
+    assert trs[0]["burn_fast"] >= 14.4 and trs[0]["burn_slow"] >= 14.4
+
+
+def test_burn_rate_no_traffic_no_fire():
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([_burn_rule()], t0=0.0)
+    assert eng.evaluate(s, now=100.0) == []
+
+
+# -- absence ----------------------------------------------------------------
+
+def test_absence_counts_silence_from_engine_start():
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([Rule(name="quiet", kind="absence", series="hb",
+                        value=15.0, severity="warn")], t0=1000.0)
+    assert eng.evaluate(s, now=1010.0) == []      # silent 10s < 15s
+    trs = eng.evaluate(s, now=1020.0)             # silent 20s -> fires
+    assert _fired(trs)
+    s.observe("hb", 1.0, ts=1021.0)               # heartbeat returns
+    trs = eng.evaluate(s, now=1022.0)
+    assert _fired(trs, "alert_resolved")
+
+
+# -- flap suppression -------------------------------------------------------
+
+def test_flap_suppression_latches_and_unlatches():
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([Rule(name="flappy", series="g", mode="latest",
+                        op=">", value=5.0, flap_max=3,
+                        flap_window_s=120.0)], t0=0.0)
+    now = 0.0
+    kinds = []
+    for cycle in range(4):                        # boundary-riding signal
+        now += 5.0
+        s.observe("g", 9.0, ts=now)
+        kinds += [t["kind"] for t in eng.evaluate(s, now=now)]
+        now += 5.0
+        s.observe("g", 1.0, ts=now)
+        kinds += [t["kind"] for t in eng.evaluate(s, now=now)]
+    # the flap_max'th resolve attempt latches instead of resolving: the
+    # pager saw 3 firings + 2 resolves + ONE suppression, not a storm
+    assert kinds.count("alert_firing") == 3
+    assert kinds.count("alert_resolved") == 2
+    assert kinds.count("alert_suppressed") == 1
+    active = eng.active()
+    assert len(active) == 1 and active[0]["suppressed"]
+    assert eng.summary()["firing"] == 1           # still a real condition
+    # quiet + clear long enough for the flap window to drain -> unlatch
+    now += 200.0
+    s.observe("g", 1.0, ts=now)
+    trs = eng.evaluate(s, now=now)
+    assert _fired(trs, "alert_resolved")
+    assert eng.summary()["firing"] == 0
+
+
+# -- attribution ------------------------------------------------------------
+
+def test_attribution_nearest_event_and_unattributed_gate():
+    kill = {"ts": 100.0, "kind": "chaos_kill"}
+    firing_near = {"ts": 102.0, "kind": "alert_firing", "rule": "a",
+                   "severity": "page"}
+    firing_far = {"ts": 200.0, "kind": "alert_firing", "rule": "b",
+                  "severity": "page"}
+    resolved = {"ts": 103.0, "kind": "alert_resolved", "rule": "a",
+                "severity": "page"}
+    att = attribute_alerts([firing_near, firing_far, resolved], [kill],
+                           window_s=5.0)
+    assert len(att["alerts"]) == 2                # resolutions not counted
+    near, far = att["alerts"]
+    assert near["attributed_to"]["kind"] == "chaos_kill"
+    assert far["attributed_to"] is None
+    assert att["unattributed"] == 1
+    assert att["unattributed_page"] == 1
+
+
+# -- rules files ------------------------------------------------------------
+
+def test_load_rules_json(tmp_path):
+    doc = {"rules": [
+        {"name": "p99", "series": "lat", "mode": "quantile", "q": 99,
+         "window_s": 30, "op": ">", "value": 0.5, "severity": "page"},
+        {"name": "hb", "kind": "absence", "series": "beat", "value": 10},
+    ]}
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(doc))
+    rules = load_rules(str(path))
+    assert [r.name for r in rules] == ["p99", "hb"]
+    assert rules[0].mode == "quantile" and rules[0].severity == "page"
+    # bare-list form parses too
+    path.write_text(json.dumps(doc["rules"]))
+    assert len(load_rules(str(path))) == 2
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        Rule(name="x", kind="nope")
+    with pytest.raises(ValueError):
+        Rule(name="x", severity="critical")
+    with pytest.raises(ValueError):
+        Rule(name="x", kind="burn_rate")          # missing series pair
+    with pytest.raises(ValueError):
+        RulesEngine([Rule(name="dup"), Rule(name="dup")])
+
+
+# -- live plane: canary, watcher, scrape, HEALTH hint -----------------------
+
+@pytest.fixture
+def live_job(tmp_path):
+    from flink_ms_tpu.serve.consumer import (ALS_STATE, ServingJob,
+                                             make_backend,
+                                             parse_als_record)
+    from flink_ms_tpu.serve.journal import Journal
+
+    rng = np.random.default_rng(0)
+    n, dim = 40, 4
+    uf = rng.normal(size=(n, dim))
+    itf = rng.normal(size=(n, dim))
+    journal = Journal(str(tmp_path / "bus"), "models")
+    journal.append(
+        [F.format_als_row(u, "U", uf[u]) for u in range(n)]
+        + [F.format_als_row(i, "I", itf[i]) for i in range(n)])
+    job = ServingJob(journal, ALS_STATE, parse_als_record,
+                     make_backend("memory", None),
+                     host="127.0.0.1", port=0,
+                     poll_interval_s=0.01).start()
+    assert job.wait_ready(60)
+    yield job, journal, uf, itf
+    job.stop()
+
+
+def test_canary_matches_offline_mse_exactly(live_job):
+    from flink_ms_tpu.eval.mse import compute_mse
+    from flink_ms_tpu.obs.watch import ModelQualityCanary
+    from flink_ms_tpu.serve.client import QueryClient
+
+    job, _, uf, itf = live_job
+    rng = np.random.default_rng(1)
+    users = rng.integers(0, 40, size=60)
+    items = rng.integers(0, 40, size=60)
+    ratings = np.einsum("nd,nd->n", uf[users], itf[items]) \
+        + rng.normal(0.0, 0.1, size=60)
+    with QueryClient("127.0.0.1", job.port, timeout_s=30) as c:
+        canary = ModelQualityCanary(users, items, ratings, c)
+        probe = canary.probe(now=100.0)
+        offline, n_off, _ = compute_mse(
+            users, items, ratings,
+            lambda k: ModelQualityCanary._parse(job.table.get(k)))
+    # same payload strings through the same grouping: identical statistic
+    assert probe["mse"] == offline
+    assert probe["n_scored"] == n_off
+    assert probe["coverage"] == 1.0
+    assert probe["staleness_s"] == 0.0            # first fingerprint
+
+
+def test_canary_drift_fires_model_drift_alert(live_job):
+    from flink_ms_tpu.obs.watch import FleetWatcher, ModelQualityCanary
+    from flink_ms_tpu.serve.client import QueryClient
+
+    job, journal, uf, itf = live_job
+    rng = np.random.default_rng(2)
+    users = rng.integers(0, 40, size=60)
+    items = rng.integers(0, 40, size=60)
+    ratings = np.einsum("nd,nd->n", uf[users], itf[items])
+    with QueryClient("127.0.0.1", job.port, timeout_s=30) as c:
+        canary = ModelQualityCanary(users, items, ratings, c)
+        rules = [Rule(name="model_drift", series="tpums_model_live_mse",
+                      mode="latest", op=">", value=1.0, severity="warn")]
+        w = FleetWatcher(interval_s=0.1, rules=rules, canary=canary,
+                         scope="t_drift", publish=False)
+        assert not any(t["rule"] == "model_drift"
+                       for t in w.tick(now=time.time()))
+        # a worse model lands through the journal (the live publication
+        # path), shifting every factor fetched by the next probe
+        journal.append(
+            [F.format_als_row(u, "U", rng.normal(size=4) * 5) for u in
+             range(40)]
+            + [F.format_als_row(i, "I", rng.normal(size=4) * 5) for i in
+               range(40)])
+        deadline = time.time() + 30
+        while job.offset < journal.end_offset() and time.time() < deadline:
+            time.sleep(0.02)
+        trs = w.tick(now=time.time())
+        assert any(t["kind"] == "alert_firing"
+                   and t["rule"] == "model_drift" for t in trs)
+        # drift probe saw NEW factor bytes -> staleness reset
+        assert canary.last["staleness_s"] == 0.0
+
+
+def test_scrape_fleet_parallel_marks_stale_endpoint(live_job):
+    from flink_ms_tpu.obs.scrape import scrape_fleet
+    from flink_ms_tpu.serve import registry
+
+    job, _, _, _ = live_job
+    # a registered endpoint nobody listens on: alive by pid, dead on wire
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    dead_port = sock.getsockname()[1]
+    sock.close()
+    registry.register("dead-replica", "127.0.0.1", dead_port, "ALS_MODEL")
+    out = scrape_fleet(timeout_s=0.5)
+    assert out["scrape_duration_s"] is not None
+    by_id = {r["job_id"]: r for r in out["replicas"]}
+    assert by_id["dead-replica"]["stale"] is True
+    assert by_id[job.job_id]["stale"] is False
+    assert all(r["scrape_s"] >= 0.0 for r in out["replicas"])
+    assert out["unreachable"] >= 1
+
+
+def test_watcher_publishes_alert_record_and_fleet_signals_sees_it(live_job):
+    from flink_ms_tpu.obs.scrape import fleet_signals, scrape_fleet
+    from flink_ms_tpu.obs.watch import FleetWatcher
+    from flink_ms_tpu.serve import registry
+
+    rules = [Rule(name="always", series="tpums_watch_replicas_total",
+                  mode="latest", op=">=", value=1.0, severity="warn")]
+    w = FleetWatcher(interval_s=0.1, rules=rules, scope="t_pub")
+    w.tick()
+    try:
+        rec = registry.resolve_alerts("t_pub")
+        assert rec is not None and rec["firing"] == 1
+        assert rec["max_severity"] == "warn"
+        # an out-of-process caller (no watcher gauges in its snapshots)
+        # still sees alert state through the registry fallback
+        before = after = scrape_fleet()["fleet"]
+        sig = fleet_signals(before, after, dt_s=1.0)
+        assert sig["alerts_firing"] == 1
+        assert sig["alerts_max_severity"] == "warn"
+    finally:
+        w.stop()                                  # drops the record
+    assert registry.resolve_alerts("t_pub") is None
+
+
+def test_health_hint_absent_unless_in_use(live_job):
+    from flink_ms_tpu.serve import registry
+    from flink_ms_tpu.serve.client import QueryClient
+
+    job, _, _, _ = live_job
+    with QueryClient("127.0.0.1", job.port, timeout_s=30) as c:
+        base = c.health("ALS_MODEL")
+        assert "alerts_firing" not in base        # no watcher -> no bytes
+        registry.publish_alerts("t_hint", {
+            "firing": 2, "max_severity": "page",
+            "max_severity_level": 3, "alerts": []})
+        job._alert_hint_cache = None              # bust the 1s TTL cache
+        hinted = c.health("ALS_MODEL")
+        assert hinted["alerts_firing"] == 2
+        assert hinted["alerts_max_severity"] == "page"
+        # every pre-existing field is byte-for-byte what it was
+        assert {k: v for k, v in hinted.items()
+                if k not in ("alerts_firing", "alerts_max_severity")} == base
+        registry.drop_alerts("t_hint")
+
+
+def test_health_hint_kill_switch(live_job, monkeypatch):
+    from flink_ms_tpu.serve import registry
+    from flink_ms_tpu.serve.client import QueryClient
+
+    job, _, _, _ = live_job
+    monkeypatch.setenv("TPUMS_WATCH_HEALTH_HINT", "0")
+    registry.publish_alerts("t_kill", {
+        "firing": 1, "max_severity": "warn",
+        "max_severity_level": 2, "alerts": []})
+    job._alert_hint_cache = None
+    with QueryClient("127.0.0.1", job.port, timeout_s=30) as c:
+        assert "alerts_firing" not in c.health("ALS_MODEL")
+    registry.drop_alerts("t_kill")
+
+
+def test_watcher_detection_latency_pairs_kill_with_page(live_job):
+    from flink_ms_tpu.obs import tracing
+    from flink_ms_tpu.obs.watch import FleetWatcher
+
+    rules = [Rule(name="replica_drop", series="tpums_watch_replicas_total",
+                  mode="drop", window_s=60.0, op=">=", value=1.0,
+                  severity="page")]
+    w = FleetWatcher(interval_s=0.1, rules=rules, scope="t_det",
+                     publish=False)
+    w.tick()                                      # replicas_total = 1
+    tracing.event("chaos_kill", job_id="victim")
+    # simulate the registry reaping the killed replica: feed the store a
+    # drop directly (scrape would observe the same shape)
+    w.store.observe("tpums_watch_replicas_total", 0.0)
+    w.engine.evaluate(w.store)
+    det = w.detection_latencies()
+    assert det["kills"] == 1 and det["detected"] == 1
+    assert det["max_s"] is not None and det["max_s"] < 5.0
+    att = w.attribution()
+    assert att["unattributed_page"] == 0          # the chaos gate
+    summary = w.watch_summary()
+    assert summary["fired_total"] == 1
+    assert summary["detection"]["max_s"] == det["max_s"]

@@ -1,0 +1,211 @@
+"""Watch-store tests (obs/tsdb.py): ring retention (age + point-count
+eviction), PromQL-style counter queries (anchored increase, reset
+handling), label-subset aggregation, the windowed-histogram quantile's
+parity with ``bucketed_quantiles`` (the same statistic the bench and
+scrape paths report), and the fleet-ingest adapter's derived series."""
+
+import json
+
+import numpy as np
+
+from flink_ms_tpu.obs.metrics import (
+    LATENCY_BUCKETS_S,
+    MetricsRegistry,
+    bucketed_quantiles,
+)
+from flink_ms_tpu.obs.tsdb import SeriesStore, series_key
+
+
+# -- retention --------------------------------------------------------------
+
+def test_retention_evicts_by_age():
+    s = SeriesStore(retention_s=10.0, max_points=1000)
+    for i in range(20):
+        s.observe("g", i, ts=100.0 + i)
+    # points older than 119 - 10 are gone
+    pts = s.points("g")
+    assert pts[0][0] >= 109.0
+    assert pts[-1] == (119.0, 19.0)
+
+
+def test_retention_evicts_by_point_count():
+    s = SeriesStore(retention_s=1e6, max_points=8)
+    for i in range(50):
+        s.observe("g", i, ts=float(i))
+    pts = s.points("g")
+    assert len(pts) == 8
+    assert pts[-1] == (49.0, 49.0)
+
+
+def test_idle_series_window_query_filters():
+    s = SeriesStore(retention_s=1e6)
+    s.observe("g", 1.0, ts=0.0)
+    s.observe("g", 2.0, ts=100.0)
+    assert s.points("g", window_s=10.0, now=105.0) == [(100.0, 2.0)]
+
+
+# -- counter queries --------------------------------------------------------
+
+def test_increase_uses_pre_window_anchor():
+    s = SeriesStore(retention_s=1e6)
+    # a slow scrape cadence: the last pre-window point anchors the delta
+    s.observe("c", 100.0, ts=0.0)
+    s.observe("c", 160.0, ts=90.0)
+    assert s.increase("c", window_s=60.0, now=100.0) == 60.0
+    assert s.rate("c", window_s=60.0, now=100.0) == 1.0
+
+
+def test_increase_counter_reset_adds_post_reset_level():
+    s = SeriesStore(retention_s=1e6)
+    s.observe("c", 100.0, ts=0.0)
+    s.observe("c", 130.0, ts=10.0)   # +30
+    s.observe("c", 5.0, ts=20.0)     # restart: +5 (PromQL semantics)
+    s.observe("c", 25.0, ts=30.0)    # +20
+    assert s.increase("c", window_s=60.0, now=40.0) == 55.0
+
+
+def test_increase_single_point_is_zero():
+    s = SeriesStore(retention_s=1e6)
+    s.observe("c", 42.0, ts=0.0)
+    assert s.increase("c", window_s=60.0, now=10.0) == 0.0
+
+
+def test_derivative_and_staleness():
+    s = SeriesStore(retention_s=1e6)
+    s.observe("g", 10.0, ts=0.0)
+    s.observe("g", 40.0, ts=10.0)
+    assert s.derivative("g", window_s=60.0, now=10.0) == 3.0
+    assert s.staleness_s("g", now=25.0) == 15.0
+    assert s.staleness_s("never_seen", now=25.0) is None
+
+
+def test_window_max():
+    s = SeriesStore(retention_s=1e6)
+    for ts, v in ((0.0, 3.0), (10.0, 5.0), (20.0, 2.0)):
+        s.observe("replicas", v, ts=ts)
+    assert s.window_max("replicas", window_s=60.0, now=20.0) == 5.0
+    # drop shape: window max minus latest
+    assert s.window_max("replicas", 60.0, now=20.0) \
+        - s.latest("replicas") == 3.0
+
+
+# -- label semantics --------------------------------------------------------
+
+def test_label_subset_matching_aggregates_across_verbs():
+    s = SeriesStore(retention_s=1e6)
+    for verb, (a, b) in (("GET", (10.0, 14.0)), ("TOPK", (5.0, 6.0))):
+        s.observe("tpums_server_requests_total", a, ts=0.0, verb=verb)
+        s.observe("tpums_server_requests_total", b, ts=10.0, verb=verb)
+    # no labels -> sums across every verb series
+    assert s.increase("tpums_server_requests_total", 60.0, now=10.0) == 5.0
+    assert s.latest("tpums_server_requests_total") == 20.0
+    # exact label -> that series alone
+    assert s.increase("tpums_server_requests_total", 60.0, now=10.0,
+                      verb="GET") == 4.0
+    assert s.latest("tpums_server_requests_total", verb="TOPK") == 6.0
+
+
+def test_series_key_is_order_insensitive():
+    assert series_key("n", {"a": 1, "b": 2}) == \
+        series_key("n", {"b": "2", "a": "1"})
+
+
+# -- histogram window quantile ---------------------------------------------
+
+def test_window_quantile_matches_bucketed_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s")
+    s = SeriesStore(retention_s=1e6)
+    # empty anchor sample, then the cumulative state after observing
+    s.ingest_snapshot(reg.snapshot(), ts=0.0)
+    rng = np.random.default_rng(0)
+    values = np.abs(rng.normal(0.01, 0.005, size=500)) + 1e-5
+    for v in values:
+        h.observe(float(v))
+    s.ingest_snapshot(reg.snapshot(), ts=10.0)
+    for q in (50, 95, 99):
+        want = bucketed_quantiles(values, (q,), bounds=LATENCY_BUCKETS_S)[0]
+        got = s.quantile("lat_s", q, window_s=60.0, now=10.0)
+        assert got is not None and abs(got - want) < 1e-12
+
+
+def test_window_quantile_is_windowed_delta():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s")
+    s = SeriesStore(retention_s=1e6)
+    for v in (0.001,) * 100:
+        h.observe(v)
+    s.ingest_snapshot(reg.snapshot(), ts=0.0)   # anchor: all fast
+    for v in (1.0,) * 100:
+        h.observe(v)
+    s.ingest_snapshot(reg.snapshot(), ts=50.0)
+    # a window holding only the slow burst must not see the fast anchor's
+    # observations
+    got = s.quantile("lat_s", 50, window_s=60.0, now=50.0)
+    assert got is not None and got > 0.1
+    assert s.quantile("lat_s", 50, window_s=1.0, now=200.0) is None
+
+
+def test_hist_reset_falls_back_to_newest_cumulative():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s")
+    s = SeriesStore(retention_s=1e6)
+    for v in (0.5,) * 50:
+        h.observe(v)
+    s.ingest_snapshot(reg.snapshot(), ts=0.0)
+    reg2 = MetricsRegistry()                     # exporter restarted
+    h2 = reg2.histogram("lat_s")
+    for v in (0.25,) * 10:
+        h2.observe(v)
+    s.ingest_snapshot(reg2.snapshot(), ts=10.0)
+    hist = s.window_hist("lat_s", window_s=60.0, now=10.0)
+    assert hist["count"] == 10                   # not 10 - 50
+
+
+# -- fleet ingest + spill ---------------------------------------------------
+
+def _fake_scrape(n_replicas=3, ready=2, unreachable=1, requests=100.0):
+    return {
+        "fleet": {
+            "ts": 0.0,
+            "counters": [{"name": "tpums_server_requests_total",
+                          "labels": {"verb": "GET"}, "value": requests}],
+            "gauges": [{"name": "tpums_server_ready", "labels": {},
+                        "value": float(ready)}],
+            "histograms": [],
+        },
+        "replicas": [
+            {"job_id": f"j{i}", "ready": i < ready,
+             "snapshot": {} if i < n_replicas - unreachable else None,
+             "stale": i >= n_replicas - unreachable,
+             "scrape_s": 0.001}
+            for i in range(n_replicas)
+        ],
+        "groups": {},
+        "unreachable": unreachable,
+        "scrape_duration_s": 0.002,
+    }
+
+
+def test_ingest_fleet_derives_watch_series():
+    s = SeriesStore(retention_s=1e6)
+    s.ingest_fleet(_fake_scrape(), ts=5.0)
+    assert s.latest("tpums_watch_replicas_total") == 3.0
+    assert s.latest("tpums_watch_replicas_ready") == 2.0
+    assert s.latest("tpums_watch_unreachable_replicas") == 1.0
+    assert s.latest("tpums_watch_scrape_duration_seconds") == 0.002
+    assert s.latest("tpums_server_requests_total", verb="GET") == 100.0
+    assert s.stats()["ingests"] == 1
+
+
+def test_spill_writes_jsonl(tmp_path):
+    spill = tmp_path / "watch.jsonl"
+    s = SeriesStore(retention_s=1e6, spill_path=str(spill))
+    s.ingest_fleet(_fake_scrape(), ts=1.0)
+    s.ingest_fleet(_fake_scrape(requests=150.0), ts=2.0)
+    lines = [json.loads(ln) for ln in
+             spill.read_text().strip().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["kind"] == "watch_ingest"
+    assert lines[0]["replicas"] == 3
+    assert lines[1]["counters"]["tpums_server_requests_total"] == 150.0
