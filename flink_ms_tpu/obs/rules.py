@@ -243,7 +243,11 @@ class RulesEngine:
                         "alert_firing", rule, st, now))
             else:
                 if st.state == "firing":
-                    st.cycles.append(now)
+                    # a flap cycle is recorded only while un-latched:
+                    # once suppressed, every clear tick would otherwise
+                    # refill the window and the latch could never drain
+                    if not st.suppressed:
+                        st.cycles.append(now)
                     if self._flapping(rule, st, now):
                         # latch: stay firing, mark suppressed once
                         if not st.suppressed:
@@ -251,6 +255,8 @@ class RulesEngine:
                             transitions.append(self._transition(
                                 "alert_suppressed", rule, st, now))
                     else:
+                        # resolve — including un-latching a suppressed
+                        # flap once its window has gone quiet
                         st.state = "ok"
                         st.firing_since = None
                         st.pending_since = None
@@ -260,16 +266,6 @@ class RulesEngine:
                 elif st.state == "pending":
                     st.state = "ok"
                     st.pending_since = None
-            # a latched-suppressed alert un-latches once the flap window
-            # has gone quiet AND the condition is clear
-            if st.suppressed and not breach and \
-                    not self._flapping(rule, st, now):
-                st.state = "ok"
-                st.firing_since = None
-                st.pending_since = None
-                st.suppressed = False
-                transitions.append(self._transition(
-                    "alert_resolved", rule, st, now))
         self.history.extend(transitions)
         for tr in transitions:
             tracing.event(tr["kind"], rule=tr["rule"],

@@ -183,16 +183,14 @@ class SeriesStore:
     #
     # Label semantics follow PromQL selectors: the given labels are a
     # SUBSET match, so a query for ``tpums_server_requests_total`` with no
-    # labels aggregates across every verb the scrape saw.  An exact-key
-    # match short-circuits (the common case for the derived watch series).
+    # labels aggregates across every verb the scrape saw.  No exact-key
+    # short-circuit: an unlabeled series coexisting with labeled series of
+    # the same name must still aggregate with them, not shadow them.
 
     def _matching(self, table: Dict[tuple, Deque], name: str,
                   labels: dict) -> List[Deque]:
-        exact = series_key(name, labels)
+        want = dict(series_key(name, labels)[1])
         with self._lock:
-            if exact in table:
-                return [table[exact]]
-            want = dict(exact[1])
             out = []
             for (n, items), dq in table.items():
                 if n != name:

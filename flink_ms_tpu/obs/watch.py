@@ -357,7 +357,10 @@ class FleetWatcher:
     def detection_latencies(self,
                             kill_kinds: Sequence[str] = tuple(KILL_KINDS)
                             ) -> dict:
-        """kill -> first subsequent page-severity firing, per kill::
+        """kill -> first subsequent page-severity firing inside the
+        attribution window, each page consumed by AT MOST one kill (so a
+        single page cannot "detect" several kills, and a page long after
+        a kill does not count as detecting it)::
 
             {"kills": N, "detected": M, "latencies_s": [...],
              "max_s": worst | None}
@@ -372,11 +375,15 @@ class FleetWatcher:
                        and tr.get("severity") == "page")
         latencies: List[float] = []
         detected = 0
+        pi = 0
         for k_ts in kills:
-            after = [p for p in pages if p >= k_ts]
-            if after:
+            while pi < len(pages) and pages[pi] < k_ts:
+                pi += 1
+            if pi < len(pages) and \
+                    pages[pi] - k_ts <= self.attribution_window_s:
                 detected += 1
-                latencies.append(round(after[0] - k_ts, 3))
+                latencies.append(round(pages[pi] - k_ts, 3))
+                pi += 1
         return {"kills": len(kills), "detected": detected,
                 "latencies_s": latencies,
                 "max_s": max(latencies) if latencies else None}

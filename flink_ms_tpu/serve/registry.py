@@ -737,9 +737,9 @@ def acquire_controller_lease(group: str, ttl_s: Optional[float] = None
 
     The lease is a registry-style heartbeat contract: the holder refreshes
     within ``ttl_s`` (default: the replica TTL) or is presumed dead, and a
-    dead holder's lease (pid gone, or heartbeat lapsed) is STOLEN — with
-    the same read-back guard as entry reaping, so two stealers cannot both
-    win one corpse.
+    dead holder's lease (pid gone, or heartbeat lapsed) is STOLEN —
+    serialized through a link-based steal lock so two stealers cannot
+    both win one corpse.
 
     Acquisition is link-based so the lease file appears ATOMICALLY with
     its full contents: an O_EXCL create would expose an empty file for
@@ -769,32 +769,39 @@ def acquire_controller_lease(group: str, ttl_s: Optional[float] = None
         except FileExistsError:
             pass
         current = _read_record(path, "controller")
-        if current is None:
-            # genuinely unreadable/foreign record (atomic creation means
-            # the normal path can no longer produce one): exactly ONE
-            # claimant recovers it — the rename is the mutual exclusion
-            corpse = f"{path}.corpse.{token[:8]}"
-            try:
-                os.rename(path, corpse)
-            except OSError:
-                return None
-            os.unlink(corpse)
-            try:
-                os.link(tmp, path)
-                return token
-            except FileExistsError:
-                return None
-        if not entry_is_dead(current):
+        if current is not None and not entry_is_dead(current):
             return None
-        # steal guarded against the live holder racing us: re-read, and
-        # only replace while the record still shows the same dead
-        # (pid, heartbeat) we judged
-        check = _read_record(path, "controller")
-        if (check or {}).get("pid") == current.get("pid") and \
-                (check or {}).get("heartbeat") == current.get("heartbeat"):
+        # unreadable/foreign record (atomic creation means the normal
+        # path can no longer produce one) OR a dead holder's lease:
+        # exactly ONE claimant recovers it.  Renaming ``path`` aside
+        # cannot be the mutual exclusion — the first winner re-creates
+        # ``path``, which a second stealer holding a stale read of the
+        # corpse would then rename aside again.  Instead a link-based
+        # steal LOCK serializes recovery: one claimant creates it,
+        # re-judges the record under the lock, and replaces atomically.
+        # A lock orphaned by a claimant dying mid-steal goes stale
+        # after the lease TTL and is cleared for the next attempt.
+        lock = f"{path}.steal"
+        try:
+            os.link(tmp, lock)
+        except FileExistsError:
+            try:
+                if time.time() - os.stat(lock).st_mtime > entry["ttl_s"]:
+                    os.unlink(lock)
+            except OSError:
+                pass
+            return None
+        try:
+            check = _read_record(path, "controller")
+            if check is not None and not entry_is_dead(check):
+                return None
             os.replace(tmp, path)
             return token
-        return None
+        finally:
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
     except OSError:
         return None
     finally:

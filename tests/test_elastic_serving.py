@@ -146,6 +146,41 @@ def test_controller_lease_stolen_from_dead_holder():
     registry.release_controller_lease("dead-ctl", t2)
 
 
+def test_controller_lease_dead_steal_single_winner_under_race():
+    # regression: concurrent stealers of one dead lease must never BOTH
+    # win (check-then-act on the corpse record let two through)
+    t1 = registry.acquire_controller_lease("dead-race", ttl_s=5.0)
+    assert t1 is not None
+    path = pathlib.Path(registry._controller_path("dead-race"))
+    entry = json.loads(path.read_text())
+    entry["heartbeat"] -= 60.0
+    path.write_text(json.dumps(entry))
+    tokens = []
+    barrier = threading.Barrier(8)
+
+    def steal():
+        barrier.wait()
+        tokens.append(registry.acquire_controller_lease("dead-race",
+                                                        ttl_s=5.0))
+
+    threads = [threading.Thread(target=steal) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [tok for tok in tokens if tok]
+    assert len(winners) <= 1
+    if not winners:
+        # every racer lost to steal-lock contention: the corpse must
+        # still be stealable on the next attempt
+        winners = [registry.acquire_controller_lease("dead-race")]
+        assert winners[0] is not None
+    # the winner's record is what's on disk, and no steal lock leaked
+    assert json.loads(path.read_text())["token"] == winners[0]
+    assert not path.with_name(path.name + ".steal").exists()
+    registry.release_controller_lease("dead-race", winners[0])
+
+
 def test_scale_controller_refuses_when_lease_held(tmp_path):
     token = registry.acquire_controller_lease("busy")
     assert token is not None

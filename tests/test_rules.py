@@ -167,6 +167,39 @@ def test_flap_suppression_latches_and_unlatches():
     assert eng.summary()["firing"] == 0
 
 
+def test_flap_latch_unlatches_under_continuous_clear_ticks():
+    # regression: a clear tick while latched must NOT count as a flap
+    # cycle — otherwise every watch tick refills the window and a
+    # continuously-clear signal stays suppressed-firing forever
+    s = SeriesStore(retention_s=1e6)
+    eng = _engine([Rule(name="flappy", series="g", mode="latest",
+                        op=">", value=5.0, flap_max=3,
+                        flap_window_s=120.0)], t0=0.0)
+    now = 0.0
+    for _ in range(3):                            # flap until latched
+        now += 5.0
+        s.observe("g", 9.0, ts=now)
+        eng.evaluate(s, now=now)
+        now += 5.0
+        s.observe("g", 1.0, ts=now)
+        eng.evaluate(s, now=now)
+    active = eng.active()
+    assert len(active) == 1 and active[0]["suppressed"]
+    latched_at = now                              # last flap cycle ts
+    # the signal stays clear; tick every 2s like the real watch loop
+    resolved_at = None
+    while now < latched_at + 400.0:
+        now += 2.0
+        s.observe("g", 1.0, ts=now)
+        if _fired(eng.evaluate(s, now=now), "alert_resolved"):
+            resolved_at = now
+            break
+    assert resolved_at is not None                # un-latched at all
+    # ...and promptly: one tick after the 120s flap window drained
+    assert resolved_at <= latched_at + 120.0 + 2.0
+    assert eng.summary()["firing"] == 0
+
+
 # -- attribution ------------------------------------------------------------
 
 def test_attribution_nearest_event_and_unattributed_gate():
@@ -403,3 +436,31 @@ def test_watcher_detection_latency_pairs_kill_with_page(live_job):
     summary = w.watch_summary()
     assert summary["fired_total"] == 1
     assert summary["detection"]["max_s"] == det["max_s"]
+
+
+def test_detection_latency_consumes_each_page_once_and_bounds_window():
+    from flink_ms_tpu.obs import tracing
+    from flink_ms_tpu.obs.watch import FleetWatcher
+
+    w = FleetWatcher(interval_s=0.1, rules=[], scope="t_det2",
+                     publish=False, attribution_window_s=5.0)
+    base = w.engine.started_at
+    # the tracing ring and engine history hold mutable dicts, so pin
+    # deterministic timestamps relative to this watcher's start
+    tracing.event("chaos_kill", job_id="a")["ts"] = base + 1.0
+    tracing.event("chaos_kill", job_id="b")["ts"] = base + 2.0
+    page = {"ts": base + 3.0, "kind": "alert_firing",
+            "rule": "replica_drop", "severity": "page"}
+    w.engine.history.append(page)
+    det = w.detection_latencies()
+    # ONE page detects ONE kill (the earliest), not both
+    assert det["kills"] == 2 and det["detected"] == 1
+    assert det["latencies_s"] == [2.0]
+    # a page far outside the attribution window is not a detection
+    tracing.event("chaos_kill", job_id="c")["ts"] = base + 10.0
+    w.engine.history.append({"ts": base + 30.0, "kind": "alert_firing",
+                             "rule": "server_error_burn",
+                             "severity": "page"})
+    det = w.detection_latencies()
+    assert det["kills"] == 3 and det["detected"] == 1
+    assert det["max_s"] == 2.0

@@ -105,6 +105,20 @@ def test_label_subset_matching_aggregates_across_verbs():
     assert s.latest("tpums_server_requests_total", verb="TOPK") == 6.0
 
 
+def test_unlabeled_series_aggregates_with_labeled_same_name():
+    # regression: an unlabeled series coexisting with labeled series of
+    # the same name must aggregate with them on a no-label query, not
+    # shadow them via an exact-key short-circuit
+    s = SeriesStore(retention_s=1e6)
+    for ts, (bare, get) in ((0.0, (7.0, 10.0)), (10.0, (9.0, 14.0))):
+        s.observe("tpums_server_requests_total", bare, ts=ts)
+        s.observe("tpums_server_requests_total", get, ts=ts, verb="GET")
+    assert s.latest("tpums_server_requests_total") == 23.0
+    assert s.increase("tpums_server_requests_total", 60.0, now=10.0) == 6.0
+    # exact label still selects the single series
+    assert s.latest("tpums_server_requests_total", verb="GET") == 14.0
+
+
 def test_series_key_is_order_insensitive():
     assert series_key("n", {"a": 1, "b": 2}) == \
         series_key("n", {"b": "2", "a": "1"})
