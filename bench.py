@@ -876,6 +876,9 @@ _COMPACT_KEYS = (
     "serving_watch_overhead_pct", "serving_watch_mse_abs_diff",
     "serving_watch_drift_fired", "serving_watch_detect_s",
     "serving_watch_unattributed_page",
+    "serving_autopilot_retrains", "serving_autopilot_win_rate",
+    "serving_autopilot_mse_monotone", "serving_autopilot_warm_beats_cold",
+    "serving_autopilot_rollback_detect_s",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1130,7 +1133,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
-        "serving_watch"
+        "serving_watch,serving_autopilot"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1215,6 +1218,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_ann", "run_serving_ann_section",
          lambda f: f(small)),
         ("serving_watch", "run_serving_watch_section",
+         lambda f: f(small)),
+        ("serving_autopilot", "run_serving_autopilot_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

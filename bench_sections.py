@@ -3000,3 +3000,199 @@ def run_serving_ann_section(small: bool) -> dict:
     out["serving_ann_gate_ivf_5x"] = bool(
         ivf_x is not None and ivf_x >= 5.0)
     return out
+
+
+def run_serving_autopilot_section(small: bool) -> dict:
+    """Unattended continuous-training flywheel (serve/autopilot.py):
+
+    1. **flywheel** — ratings stream in waves through the update plane
+       while the autopilot ticks: each wave is windowed, retrained
+       WARM-STARTED from the serving factors, evaluated candidate vs
+       incumbent on the rolling held-out slice, and rolled out when it
+       wins.  Artifact: retrain count, candidate-win rate, held-out MSE
+       trajectory with a monotone non-increasing gate (modulo the noise
+       floor — each wave adds data, so quality must not regress).
+    2. **warm vs cold** — equal-iteration ALS fits on the final window,
+       init from the serving factors vs the cold seed draw: the warm fit
+       must score better held-out MSE at 1 iteration, and the artifact
+       records how many iterations cold needs to catch up.
+    3. **drift -> rollback** — an injected live-MSE regression (the
+       canary gauge shortcut through the controller's hook) must drive an
+       automatic ``rollback()`` within the detection bound.
+    """
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.eval.mse import compute_mse, rolling_holdout_split
+    from flink_ms_tpu.ops.als import ALSConfig, als_fit, warm_start_factors
+    from flink_ms_tpu.parallel.mesh import honor_platform_env, make_mesh
+    from flink_ms_tpu.serve.autopilot import AutopilotController
+    from flink_ms_tpu.serve.journal import Journal
+    from flink_ms_tpu.serve.rollout import RolloutController
+    from flink_ms_tpu.serve.update_plane import UpdatePlaneClient
+
+    n = int(os.environ.get("BENCH_AUTOPILOT_USERS", 40 if small else 100))
+    k = 4
+    waves = int(os.environ.get("BENCH_AUTOPILOT_WAVES", 3))
+    iters = int(os.environ.get("BENCH_AUTOPILOT_ITERS", 3))
+    detect_bound_s = float(os.environ.get("BENCH_AUTOPILOT_DETECT_S", 5.0))
+    noise = 0.05
+
+    tmp = tempfile.mkdtemp(prefix="tpums_autopilot_bench_")
+    saved_env = {kk: os.environ.get(kk) for kk in
+                 ("TPUMS_REGISTRY_DIR", "TPUMS_HEARTBEAT_S",
+                  "TPUMS_REPLICA_TTL_S")}
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    os.environ["TPUMS_HEARTBEAT_S"] = "0.2"
+    os.environ["TPUMS_REPLICA_TTL_S"] = "30"
+    out: dict = {}
+    ctl = None
+    try:
+        honor_platform_env()
+        rng = np.random.default_rng(0)
+        U, V = rng.normal(size=(n, k)), rng.normal(size=(n, k))
+        uu, ii = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+        uu, ii = uu.ravel(), ii.ravel()
+        rr = (np.sum(U[uu] * V[ii], axis=1)
+              + rng.normal(0.0, noise, size=len(uu)))
+        order = rng.permutation(len(uu))
+        ratings = [(int(uu[j]), int(ii[j]), float(rr[j])) for j in order]
+        per_wave = len(ratings) // waves
+
+        # v0 incumbent: random factors — wave 1 must win immediately
+        j0 = Journal(os.path.join(tmp, "v0"), "models")
+        j0.append([F.format_als_row(u, "U", rng.normal(size=k))
+                   for u in range(n)]
+                  + [F.format_als_row(i, "I", rng.normal(size=k))
+                     for i in range(n)])
+        ctl = RolloutController("bench-autopilot",
+                                port_dir=os.path.join(tmp, "ports"),
+                                journal_dir=j0.dir, topic="models",
+                                ready_timeout_s=180)
+        ctl.rollout(j0.dir, "models", model_id="v0", shards=1)
+
+        producer = UpdatePlaneClient(os.path.join(tmp, "bus"), "models",
+                                    partitions=4)
+        live = [None]
+        pilot = AutopilotController(
+            "bench-autopilot", os.path.join(tmp, "bus"),
+            os.path.join(tmp, "work"), rollout=ctl, partitions=4,
+            min_window=max(per_wave // 2, 1), interval_s=0.05,
+            iterations=iters, num_factors=k, drift_source="gauge",
+            drift_factor=1.5, live_mse=lambda: live[0])
+
+        # -- 1. the flywheel, one tick per wave --------------------------
+        trajectory = []
+        warm_starts = 0
+        t0 = time.perf_counter()
+        for w in range(waves):
+            lo, hi = w * per_wave, (w + 1) * per_wave
+            producer.submit_many(
+                ratings[lo:] if w == waves - 1 else ratings[lo:hi],
+                flush=True)
+            tick = pilot.tick()
+            if "candidate_mse" in tick:
+                trajectory.append(round(tick["candidate_mse"], 6))
+                warm_starts += bool(tick.get("warm_start"))
+            _log(f"[bench:autopilot] wave {w + 1}/{waves}: "
+                 f"rows={tick.get('window_rows')} "
+                 f"mse={tick.get('candidate_mse')} "
+                 f"win={tick.get('win')} gen={tick.get('rollout_gen')}")
+        flywheel_s = time.perf_counter() - t0
+        s = pilot.summary()
+        evals = s["wins"] + s["losses"]
+        # monotone non-increasing modulo the noise floor: each wave sees
+        # MORE data, so held-out MSE may wobble by the label noise but
+        # must not climb past it
+        floor = max(2.0 * noise * noise, 0.005)
+        monotone = all(b <= a + floor
+                       for a, b in zip(trajectory, trajectory[1:]))
+        out["serving_autopilot_retrains"] = s["retrains"]
+        out["serving_autopilot_rollouts"] = s["rollouts"]
+        out["serving_autopilot_win_rate"] = (
+            round(s["wins"] / evals, 4) if evals else None)
+        out["serving_autopilot_mse_trajectory"] = trajectory
+        out["serving_autopilot_mse_monotone"] = monotone
+        out["serving_autopilot_warm_started"] = warm_starts
+        out["serving_autopilot_flywheel_s"] = round(flywheel_s, 2)
+
+        # -- 2. warm vs cold on the final window -------------------------
+        keys_acc = sorted(pilot._acc)
+        wu = np.asarray([kk[0] for kk in keys_acc], dtype=np.int64)
+        wi = np.asarray([kk[1] for kk in keys_acc], dtype=np.int64)
+        wr = np.asarray([pilot._acc[kk] for kk in keys_acc])
+        tr_idx, ho_idx = rolling_holdout_split(wu, wi, wr, fraction=0.2,
+                                               seed=99)
+        prev_u, prev_i = pilot._incumbent_tables()
+        uf0, itf0 = warm_start_factors(
+            np.unique(wu[tr_idx]), np.unique(wi[tr_idx]), prev_u, prev_i,
+            k, seed=42)
+        mesh = make_mesh(1)
+
+        def heldout_mse(model):
+            table = {f"{int(u)}-U": f for u, f
+                     in zip(model.user_ids, model.user_factors)}
+            table.update({f"{int(i)}-I": f for i, f
+                          in zip(model.item_ids, model.item_factors)})
+            mse, _, _ = compute_mse(wu[ho_idx], wi[ho_idx], wr[ho_idx],
+                                    table.get)
+            return float(mse) if mse is not None else float("inf")
+
+        def fit(n_iters, warm):
+            cfg = ALSConfig(num_factors=k, iterations=n_iters,
+                            lambda_=0.1, seed=42)
+            kw = ({"init_user_factors": uf0, "init_item_factors": itf0}
+                  if warm else {})
+            t = time.perf_counter()
+            m = als_fit(wu[tr_idx], wi[tr_idx], wr[tr_idx], cfg, mesh,
+                        **kw)
+            return heldout_mse(m), time.perf_counter() - t
+
+        warm_mse, warm_s = fit(1, warm=True)
+        cold_mse, cold_s = fit(1, warm=False)
+        cold_iters_to_match = None
+        for extra in range(1, 9):
+            m_mse, _ = fit(extra, warm=False)
+            if m_mse <= warm_mse:
+                cold_iters_to_match = extra
+                break
+        out["serving_autopilot_warm_mse_1iter"] = round(warm_mse, 6)
+        out["serving_autopilot_cold_mse_1iter"] = round(cold_mse, 6)
+        out["serving_autopilot_warm_beats_cold"] = warm_mse < cold_mse
+        out["serving_autopilot_cold_iters_to_match"] = cold_iters_to_match
+        out["serving_autopilot_warm_fit_s"] = round(warm_s, 3)
+        _log(f"[bench:autopilot] warm 1-iter mse {warm_mse:.4f} vs cold "
+             f"{cold_mse:.4f}; cold needs {cold_iters_to_match} iters "
+             f"to match")
+
+        # -- 3. injected drift -> automatic rollback ---------------------
+        baseline_rollbacks = pilot.summary()["rollbacks"]
+        live[0] = (pilot.state.get("rollout_probe_mse") or 1.0) * 100.0
+        t0 = time.perf_counter()
+        detect_s = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            pilot.tick()
+            if pilot.summary()["rollbacks"] > baseline_rollbacks:
+                detect_s = time.perf_counter() - t0
+                break
+            time.sleep(0.05)
+        out["serving_autopilot_rollback_detect_s"] = (
+            round(detect_s, 3) if detect_s is not None else None)
+        out["serving_autopilot_rollback_ok"] = (
+            detect_s is not None and detect_s <= detect_bound_s)
+        out["serving_autopilot_detect_bound_s"] = detect_bound_s
+        _log(f"[bench:autopilot] drift -> rollback in {detect_s}s "
+             f"(bound {detect_bound_s}s)")
+        pilot.release_lease()
+    finally:
+        for kk, v in saved_env.items():
+            if v is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = v
+        if ctl is not None:
+            try:
+                ctl.stop(drop_topology=True)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out

@@ -40,6 +40,65 @@ def _load_model_tables(paths: str) -> Dict[str, np.ndarray]:
     return table
 
 
+def rolling_holdout_split(
+    users,
+    items,
+    ratings,
+    *,
+    fraction: float = 0.2,
+    seed: int = 0,
+    min_train_per_user: int = 1,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Seeded, user-stratified held-out split -> (train_idx, holdout_idx).
+
+    The autopilot's evaluation slice: per user with enough ratings,
+    ``fraction`` of them (at least one, never more than leaves
+    ``min_train_per_user`` behind) move to the held-out side; users with
+    too few ratings keep everything in train.  Stratifying per user
+    guarantees every held-out user has train-side ratings — without it,
+    ``compute_mse``'s reference skip semantics (a missing user drops its
+    whole group) would silently evaluate nothing for users the candidate
+    model never trained on, and the candidate-vs-incumbent comparison
+    would reward models that forget users.
+
+    Deterministic in (inputs, seed): same triples and seed -> identical
+    index arrays, so the incumbent and every candidate are scored on the
+    byte-identical slice.  Rolling windows pass ``seed=base + version``
+    to rotate which ratings are held out as the window grows.
+
+    Returns positional indices into the input arrays (both sorted
+    ascending, disjoint, covering every row).
+    """
+    users = np.asarray(users)
+    n = len(users)
+    if not 0.0 < fraction < 1.0:
+        raise ValueError(f"fraction must be in (0, 1), got {fraction}")
+    if len(np.asarray(items)) != n or len(np.asarray(ratings)) != n:
+        raise ValueError("users/items/ratings length mismatch")
+    rng = np.random.default_rng(seed)
+    holdout: list = []
+    order = np.argsort(users, kind="stable")
+    sorted_users = users[order]
+    # group boundaries over the stable sort: per-user index runs, visited
+    # in ascending user order so the rng consumption is input-order
+    # independent for a fixed triple set
+    starts = np.flatnonzero(
+        np.r_[True, sorted_users[1:] != sorted_users[:-1]])
+    ends = np.r_[starts[1:], n]
+    for s, e in zip(starts, ends):
+        grp = order[s:e]
+        n_grp = len(grp)
+        n_hold = min(max(int(round(fraction * n_grp)), 1),
+                     n_grp - min_train_per_user)
+        if n_hold <= 0:
+            continue
+        holdout.extend(rng.choice(grp, size=n_hold, replace=False).tolist())
+    holdout_idx = np.sort(np.asarray(holdout, dtype=np.int64))
+    mask = np.ones(n, dtype=bool)
+    mask[holdout_idx] = False
+    return np.flatnonzero(mask), holdout_idx
+
+
 def compute_mse(
     users: np.ndarray,
     items: np.ndarray,

@@ -220,6 +220,16 @@ def fleet_signals(before: dict, after: dict,
                           AFTER, else the registry's published alert
                           record),
          "alerts_max_severity": "info"/"warn"/"page" or None}
+
+    Autopilot progress (round 13 — ``serve/autopilot.py``):
+
+        {"autopilot_retrains":  retrains completed over the window
+                           (``tpums_autopilot_retrains_total`` delta),
+         "autopilot_rollouts":  automatic rollouts over the window,
+         "autopilot_rollbacks": drift-triggered rollbacks over the window,
+         "autopilot_heldout_mse": newest candidate's held-out MSE at
+                           AFTER (min across processes; None until an
+                           evaluation has run)}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -300,7 +310,21 @@ def fleet_signals(before: dict, after: dict,
                                if alerts_sev_level else None)
     except ImportError:  # pragma: no cover - rules is stdlib-only
         alerts_max_severity = None
+    # autopilot loop progress (round 13 — serve/autopilot.py): counter
+    # DELTAS over the window (the autoscaler and bench ask "did the
+    # flywheel turn", not "how often has it ever turned") plus the latest
+    # held-out score when an evaluation has run
+    autopilot = {
+        f"autopilot_{k}": max(
+            _counter_total(after, f"tpums_autopilot_{k}_total")
+            - _counter_total(before, f"tpums_autopilot_{k}_total"), 0.0)
+        for k in ("retrains", "rollouts", "rollbacks")
+    }
+    heldout = [g["value"] for g in after.get("gauges", [])
+               if g["name"] == "tpums_autopilot_heldout_mse"]
+    autopilot["autopilot_heldout_mse"] = min(heldout) if heldout else None
     return {
+        **autopilot,
         "qps": requests / dt_s,
         "p99_s": snapshot_quantile(window, 99) if window else None,
         "backlog_bytes": backlog,

@@ -58,6 +58,11 @@ CLIENT_TO_SERVER_VERB: Dict[str, Optional[str]] = {
 # event kinds that can legitimately explain an excursion
 DISRUPTIVE_KINDS = frozenset({
     "rehearsal_kill", "chaos_kill", "chaos_kill_warming",
+    # control-plane kill (the autopilot chaos arm): attributable like any
+    # kill, but deliberately NOT in watch.KILL_KINDS — the contract under
+    # test is that killing the controller must NOT page, so detection
+    # latency is meaningless for it
+    "chaos_kill_controller",
     "chaos_teardown",
     "elastic_scale_start", "elastic_cutover", "elastic_drained",
     "elastic_scale_abort", "generation_swap", "failover",

@@ -41,7 +41,7 @@ import json
 import os
 import re
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1315,6 +1315,43 @@ def compile_fit(
     return _cached_sweep(problem, config, mesh), dev_args
 
 
+def warm_start_factors(
+    user_ids: np.ndarray,
+    item_ids: np.ndarray,
+    prev_user: Dict[int, np.ndarray],
+    prev_item: Dict[int, np.ndarray],
+    k: int,
+    seed: int = 42,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Align a previously trained model onto a NEW problem's id space ->
+    ``(init_user_factors, init_item_factors)`` in dense-id order.
+
+    The continuous-training autopilot retrains on a grown ratings window
+    whose entity sets overlap — but rarely equal — the serving model's:
+    rows for ids the previous model knows are carried over verbatim (the
+    warm start that cuts iterations-to-converge on incremental data),
+    ids the model has never seen fall back to the cold seed draw (the
+    same ``init_factors`` family a cold fit would use, so a 100%-novel
+    window degrades exactly to a cold start, not to zeros — a zero row
+    is a stationary point of the user half-sweep for users with only
+    novel items).
+    """
+    user_ids = np.asarray(user_ids)
+    item_ids = np.asarray(item_ids)
+    key_u, key_i = jax.random.split(jax.random.PRNGKey(seed))
+    # np.array (copy): jax buffers come back as read-only views
+    uf = np.array(init_factors(len(user_ids), k, key_u, dtype))
+    itf = np.array(init_factors(len(item_ids), k, key_i, dtype))
+    for ids, table, out in ((user_ids, prev_user, uf),
+                            (item_ids, prev_item, itf)):
+        for row, id_ in enumerate(ids):
+            vec = table.get(int(id_))
+            if vec is not None and len(vec) == k:
+                out[row] = np.asarray(vec, dtype=dtype)
+    return uf, itf
+
+
 def als_fit(
     users: np.ndarray,
     items: np.ndarray,
@@ -1325,12 +1362,22 @@ def als_fit(
     init: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     temporary_path: Optional[str] = None,
     step_timer=None,
+    init_user_factors: Optional[np.ndarray] = None,
+    init_item_factors: Optional[np.ndarray] = None,
 ) -> ALSModel:
     """Train ALS factors for the given rating triples on the mesh.
 
     `init`, when given, is (user_factors (n_users, k), item_factors
     (n_items, k)) in dense-id order — used by tests to pin the starting
     point so different block counts are exactly comparable.
+
+    `init_user_factors` / `init_item_factors`: the warm-start override
+    (must be given together, mutually exclusive with `init`) — the same
+    dense-id-order arrays as `init`, named for the retrain path where the
+    starting point is the CURRENT SERVING MODEL rather than a test pin
+    (``warm_start_factors`` aligns a served model onto the new window's
+    id space).  A zero-iteration warm-started fit returns the init
+    verbatim (modulo dtype), which is what the parity test pins.
 
     `temporary_path` (the reference's setTemporaryPath, ALSImpl.scala:42-44):
     run iterations one at a time, materializing the factors to disk at every
@@ -1345,6 +1392,25 @@ def als_fit(
         problem = prepare_blocked(users, items, ratings, D)
     k = config.num_factors
     dtype = config.dtype
+    if (init_user_factors is None) != (init_item_factors is None):
+        raise ValueError(
+            "init_user_factors and init_item_factors must be given together"
+        )
+    if init_user_factors is not None:
+        if init is not None:
+            raise ValueError(
+                "init and init_user_factors/init_item_factors are mutually "
+                "exclusive"
+            )
+        uf_w = np.asarray(init_user_factors, dtype=dtype)
+        itf_w = np.asarray(init_item_factors, dtype=dtype)
+        if uf_w.shape != (problem.n_users, k) or \
+                itf_w.shape != (problem.n_items, k):
+            raise ValueError(
+                f"warm-start shapes {uf_w.shape}/{itf_w.shape} do not match "
+                f"problem ({problem.n_users}, {k})/({problem.n_items}, {k})"
+            )
+        init = (uf_w, itf_w)
     shard3 = block_sharding(mesh, rank=3)
     fit_fn, dev_args = compile_fit(problem, config, mesh, init=init)
     n_users_pad = problem.u.per_block * D

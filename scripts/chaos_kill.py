@@ -32,7 +32,17 @@ Knobs (env):
                            fleet through the cutover: in-quota clients
                            must see zero errors, the abuser must be SHED
                            rather than served, and the rollout must either
-                           complete on v2 or abort cleanly back on v1)
+                           complete on v2 or abort cleanly back on v1),
+                           or "autopilot" (run the continuous-training
+                           autopilot as a subprocess under rehearsal load
+                           and SIGKILL it twice — once mid-RETRAIN and
+                           once mid-ROLLOUT, timed off its persisted
+                           phase record: serving availability stays 1.0
+                           throughout, the next lease holder steals the
+                           dead lease, resumes from the persisted state
+                           record and converges to an automatically
+                           rolled-out candidate, with zero unattributed
+                           pages via the watch wrapper)
     CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
                            history over few keys so the fold has work)
     CHAOS_UPDATE_BATCH=200 ratings per producer tick (update mode)
@@ -738,6 +748,234 @@ def rollout_main() -> int:
         ctl.stop(drop_topology=True)
 
 
+def autopilot_main() -> int:
+    """SIGKILL the continuous-training autopilot twice — the trainer
+    mid-RETRAIN and the controller mid-ROLLOUT — under a sustained
+    in-quota query load (serve/autopilot.py).  Contracts under test: the
+    serving plane never degrades (workers outlive the autopilot by
+    construction — zero in-quota errors through both kills), the next
+    lease holder STEALS the dead holder's ``<group>#autopilot`` lease and
+    resumes from the persisted state record (the sealed-but-untrained
+    window is redone, the candidate dir sequence never collides), and the
+    flywheel still converges: an automatically trained candidate ends up
+    rolled out with no human action."""
+    from flink_ms_tpu.serve.elastic import ElasticClient
+    from flink_ms_tpu.serve.rollout import RolloutController
+    from flink_ms_tpu.serve.update_plane import UpdatePlaneClient
+
+    base = tempfile.mkdtemp(prefix="tpums_chaos_autopilot_")
+    os.environ.setdefault(
+        "TPUMS_REGISTRY_DIR", tempfile.mkdtemp(prefix="tpums_chaos_reg_"))
+    group = "chaos-autopilot"
+    k = 4
+    n = min(N_USERS, 80)  # the trainer refits every cycle — keep it CI-fast
+    rng = np.random.default_rng(0)
+    U, V = rng.normal(size=(n, k)), rng.normal(size=(n, k))
+    uu, ii = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    uu, ii = uu.ravel(), ii.ravel()
+    rr = np.sum(U[uu] * V[ii], axis=1)
+    ratings = [(int(u), int(i), float(r)) for u, i, r in zip(uu, ii, rr)]
+    # shuffled stream: BOTH halves cover every user, so the first
+    # auto-rolled-out candidate can answer every in-quota key (a missing
+    # user would read as a serving error when it is merely a cold id)
+    random.Random(0).shuffle(ratings)
+    half = len(ratings) // 2
+
+    # v0 incumbent: RANDOM factors — any trained candidate beats it, so
+    # the very first flywheel turn must end in an automatic rollout
+    j0 = Journal(os.path.join(base, "v0"), "models")
+    j0.append([F.format_als_row(u, "U", rng.normal(size=k))
+               for u in range(n)]
+              + [F.format_als_row(i, "I", rng.normal(size=k))
+                 for i in range(n)])
+
+    work_dir = os.path.join(base, "work")
+    state_path = os.path.join(work_dir, "autopilot_state.json")
+    keys = [f"{u}-U" for u in range(n)]
+    ctl = RolloutController(group, port_dir=os.path.join(base, "ports"),
+                            journal_dir=j0.dir, topic="models",
+                            replication=R, ready_timeout_s=180)
+    event("chaos_autopilot_start", shards=W, replication=R)
+    ok = [0] * THREADS
+    errs = [0] * THREADS
+    err_sample = []
+    stop = threading.Event()
+
+    def in_quota_load(widx):
+        c = ElasticClient(
+            group, retry=RetryPolicy(
+                attempts=6, backoff_s=0.02, max_backoff_s=0.5),
+            timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    if c.query_state(ALS_STATE, key) is None:
+                        errs[widx] += 1
+                        if len(err_sample) < 8:
+                            err_sample.append((key, "missing"))
+                    else:
+                        ok[widx] += 1
+                except Exception as e:
+                    errs[widx] += 1
+                    if len(err_sample) < 8:
+                        err_sample.append((key, repr(e)))
+
+    def read_phase():
+        try:
+            with open(state_path) as f:
+                return json.load(f).get("phase")
+        except (OSError, ValueError):
+            return None
+
+    def read_state():
+        try:
+            with open(state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    log_path = os.path.join(base, "autopilot.log")
+
+    def spawn_pilot():
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        log = open(log_path, "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", "flink_ms_tpu.serve.autopilot",
+             "--group", group, "--ratingsDir", os.path.join(base, "bus"),
+             "--workDir", work_dir,
+             "--portDir", os.path.join(base, "ports"),
+             "--interval", "0.2", "--minWindow", "50",
+             "--iterations", "3", "--numFactors", str(k),
+             "--duration", "120"],
+            stdout=log, stderr=log, env=env)
+
+    def kill_at_phase(proc, phase, timeout_s=60.0):
+        """Poll the PERSISTED phase record (every transition reaches disk
+        before the work starts) and SIGKILL the autopilot inside it."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and proc.poll() is None:
+            if read_phase() == phase:
+                event("chaos_kill_controller",
+                      target=f"autopilot@{phase}", pid=proc.pid)
+                proc.send_signal(signal.SIGKILL)
+                proc.wait()
+                return True
+            time.sleep(0.005)
+        return False
+
+    kills = {"training": False, "rolling-out": False}
+    procs = []
+    summary = {}
+    try:
+        ctl.rollout(j0.dir, "models", model_id="v0", shards=W)
+        threads = [threading.Thread(target=in_quota_load, args=(i,),
+                                    daemon=True) for i in range(THREADS)]
+        for t in threads:
+            t.start()
+
+        producer = UpdatePlaneClient(os.path.join(base, "bus"), "models")
+        producer.submit_many(ratings[:half], flush=True)
+
+        # kill 1: the TRAINER, mid-retrain on the first sealed window
+        p1 = spawn_pilot()
+        procs.append(p1)
+        kills["training"] = kill_at_phase(p1, "training")
+        mark = sum(ok)
+        deadline = time.time() + 10
+        while sum(ok) < mark + 50 and time.time() < deadline:
+            time.sleep(0.02)  # serving must keep answering over the corpse
+
+        # kill 2: the CONTROLLER, mid-rollout — the next holder stole the
+        # dead lease, redid the window's retrain, and is cutting over
+        p2 = spawn_pilot()
+        procs.append(p2)
+        kills["rolling-out"] = kill_at_phase(p2, "rolling-out")
+        mark = sum(ok)
+        deadline = time.time() + 10
+        while sum(ok) < mark + 50 and time.time() < deadline:
+            time.sleep(0.02)
+
+        # the rest of the stream, then an unharassed holder: it resumes
+        # from the persisted record and the flywheel converges
+        producer.submit_many(ratings[half:], flush=True)
+        p3 = spawn_pilot()
+        procs.append(p3)
+        deadline = time.time() + 120
+        converged = False
+        while time.time() < deadline and p3.poll() is None:
+            topo = registry.resolve_topology(group) or {}
+            model_id = (topo.get("model") or {}).get("model_id", "")
+            if model_id.startswith("auto-v") and \
+                    int(read_state().get("trained_version", 0)) >= \
+                    int(read_state().get("window_version", 1)):
+                converged = True
+                break
+            time.sleep(0.1)
+        p3.send_signal(signal.SIGTERM)
+        try:
+            p3.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p3.kill()
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        final_state = read_state()
+        topo = registry.resolve_topology(group) or {}
+        summary = {
+            "mode": "autopilot", "shards": W, "replication": R,
+            "killed_mid_retrain": kills["training"],
+            "killed_mid_rollout": kills["rolling-out"],
+            "converged": converged,
+            "live_model": (topo.get("model") or {}).get("model_id"),
+            "retrains": final_state.get("retrains"),
+            "rollouts": final_state.get("rollouts"),
+            "window_version": final_state.get("window_version"),
+            "trained_version": final_state.get("trained_version"),
+            "in_quota_ok": sum(ok), "in_quota_errors": sum(errs),
+            "in_quota_error_sample": err_sample,
+            "availability": (sum(ok) / max(sum(ok) + sum(errs), 1)),
+            "timeline": [e for e in recent_events()
+                         if e["kind"].startswith(("chaos_", "rollout_",
+                                                  "autopilot_",
+                                                  "replica_"))],
+        }
+        print(json.dumps(summary, indent=1, default=str))
+        failed = (sum(errs) > 0                    # serving degraded
+                  or not kills["training"]         # kill 1 never landed
+                  or not kills["rolling-out"]      # kill 2 never landed
+                  or not converged                 # flywheel never closed
+                  or int(final_state.get("retrains") or 0) < 2)
+        return 1 if failed else 0
+    finally:
+        stop.set()
+        event("chaos_teardown", mode="autopilot")
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        # dead autopilots orphaned their warming/served generations'
+        # workers (no supervisor left to stop them): the registry is
+        # PRIVATE to this run, so every locally-recorded live pid in it
+        # is ours to reap
+        my_host_entries = registry.list_jobs()
+        for entry in my_host_entries:
+            pid = entry.get("pid")
+            if isinstance(pid, int) and pid != os.getpid():
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+        ctl.stop(drop_topology=True)
+
+
 def update_main() -> int:
     """SIGKILL co-located UpdateWorkers mid-stream under a sustained
     rating load.  The cluster runs with the sharded update plane enabled
@@ -924,4 +1162,5 @@ if __name__ == "__main__":
     sys.exit(run_with_watch({"elastic": elastic_main,
                              "snapshot": snapshot_main,
                              "update": update_main,
-                             "rollout": rollout_main}.get(MODE, main)))
+                             "rollout": rollout_main,
+                             "autopilot": autopilot_main}.get(MODE, main)))

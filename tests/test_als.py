@@ -686,3 +686,91 @@ def test_bf16_exchange_converges_close_to_f32(rng):
     r_full = A.rmse(full, u, i, r)
     r_bf16 = A.rmse(bf16, u, i, r)
     assert abs(r_full - r_bf16) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# warm start (round 13 — the autopilot's retrain path)
+# ---------------------------------------------------------------------------
+
+def test_warm_start_zero_iteration_parity(rng):
+    """A zero-iteration warm-started fit returns the init verbatim — the
+    override feeds the SAME init path the seed draw does, no extra
+    transform between the caller's factors and the sweep."""
+    u, i, r = _synthetic(rng, n_users=12, n_items=9)
+    k = 4
+    uf0 = rng.normal(size=(12, k)).astype(np.float32)
+    itf0 = rng.normal(size=(9, k)).astype(np.float32)
+    model = A.als_fit(
+        u, i, r, A.ALSConfig(num_factors=k, iterations=0, lambda_=0.1),
+        make_mesh(1), init_user_factors=uf0, init_item_factors=itf0)
+    np.testing.assert_allclose(model.user_factors, uf0, rtol=1e-6)
+    np.testing.assert_allclose(model.item_factors, itf0, rtol=1e-6)
+
+
+def test_warm_start_kwargs_validation(rng):
+    u, i, r = _synthetic(rng, n_users=12, n_items=9)
+    k = 3
+    uf0 = rng.normal(size=(12, k)).astype(np.float32)
+    itf0 = rng.normal(size=(9, k)).astype(np.float32)
+    cfg = A.ALSConfig(num_factors=k, iterations=1, lambda_=0.1)
+    mesh = make_mesh(1)
+    with pytest.raises(ValueError, match="together"):
+        A.als_fit(u, i, r, cfg, mesh, init_user_factors=uf0)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        A.als_fit(u, i, r, cfg, mesh, init=(uf0, itf0),
+                  init_user_factors=uf0, init_item_factors=itf0)
+    with pytest.raises(ValueError, match="shapes"):
+        A.als_fit(u, i, r, cfg, mesh,
+                  init_user_factors=uf0[:5], init_item_factors=itf0)
+
+
+def test_warm_start_factors_alignment(rng):
+    """warm_start_factors carries known ids over verbatim and seeds novel
+    ids from the deterministic cold draw."""
+    k = 3
+    prev_u = {0: np.full(k, 1.0), 2: np.full(k, 2.0)}
+    prev_i = {5: np.full(k, 3.0)}
+    user_ids = np.asarray([0, 1, 2])
+    item_ids = np.asarray([4, 5])
+    uf, itf = A.warm_start_factors(user_ids, item_ids, prev_u, prev_i, k,
+                                   seed=7)
+    np.testing.assert_allclose(uf[0], 1.0)
+    np.testing.assert_allclose(uf[2], 2.0)
+    np.testing.assert_allclose(itf[1], 3.0)
+    # novel rows come from the seed draw, not zeros (a zero row is a
+    # stationary point of the opposite half-sweep)
+    assert np.abs(uf[1]).max() > 0
+    assert np.abs(itf[0]).max() > 0
+    # deterministic in (ids, seed)
+    uf2, itf2 = A.warm_start_factors(user_ids, item_ids, prev_u, prev_i,
+                                     k, seed=7)
+    np.testing.assert_array_equal(uf, uf2)
+    np.testing.assert_array_equal(itf, itf2)
+    # rank-mismatched carryover rows are ignored, not truncated
+    uf3, _ = A.warm_start_factors(
+        user_ids, item_ids, {0: np.ones(k + 2)}, prev_i, k, seed=7)
+    assert np.abs(uf3[0] - 1.0).max() > 0
+
+
+def test_warm_start_converges_faster_than_cold(rng):
+    """Warm-starting from a near-optimum beats the cold seed init at equal
+    iteration count on incrementally grown data — the autopilot's whole
+    reason to thread serving factors back into the trainer."""
+    u, i, r = _synthetic(rng, n_users=40, n_items=30, k_true=3)
+    k = 3
+    lam = 0.1
+    mesh = make_mesh(1)
+    # near-optimum on the first 80% of ratings
+    n_seed = int(0.8 * len(r))
+    opt = A.als_fit(u[:n_seed], i[:n_seed], r[:n_seed],
+                    A.ALSConfig(num_factors=k, iterations=12, lambda_=lam),
+                    make_mesh(1))
+    prev_u = {int(uu): f for uu, f in zip(opt.user_ids, opt.user_factors)}
+    prev_i = {int(ii): f for ii, f in zip(opt.item_ids, opt.item_factors)}
+    uf0, itf0 = A.warm_start_factors(
+        np.unique(u), np.unique(i), prev_u, prev_i, k, seed=42)
+    cfg = A.ALSConfig(num_factors=k, iterations=1, lambda_=lam, seed=42)
+    warm = A.als_fit(u, i, r, cfg, mesh,
+                     init_user_factors=uf0, init_item_factors=itf0)
+    cold = A.als_fit(u, i, r, cfg, mesh)
+    assert A.rmse(warm, u, i, r) < A.rmse(cold, u, i, r)

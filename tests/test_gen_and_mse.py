@@ -167,3 +167,56 @@ def test_mse_offline_tolerates_mean_rows(tmp_path, rng):
         Params.from_args(["--input", ratings_path, "--model", model_path])
     )
     assert out == pytest.approx(0.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# rolling held-out split (round 13 — the autopilot's evaluation slice)
+# ---------------------------------------------------------------------------
+
+def _split_triples(rng, n=400, n_users=25, n_items=40):
+    u = rng.integers(0, n_users, size=n)
+    i = rng.integers(0, n_items, size=n)
+    r = rng.normal(size=n)
+    return u, i, r
+
+
+def test_rolling_holdout_split_partition_and_determinism(rng):
+    u, i, r = _split_triples(rng)
+    tr, ho = mse_mod.rolling_holdout_split(u, i, r, fraction=0.25, seed=9)
+    # exact partition: disjoint, covering, sorted
+    assert len(np.intersect1d(tr, ho)) == 0
+    assert len(tr) + len(ho) == len(u)
+    assert (np.diff(tr) > 0).all() and (np.diff(ho) > 0).all()
+    # deterministic in (inputs, seed); rotated by seed
+    tr2, ho2 = mse_mod.rolling_holdout_split(u, i, r, fraction=0.25, seed=9)
+    np.testing.assert_array_equal(ho, ho2)
+    _, ho3 = mse_mod.rolling_holdout_split(u, i, r, fraction=0.25, seed=10)
+    assert not np.array_equal(ho, ho3)
+
+
+def test_rolling_holdout_split_user_stratified(rng):
+    """Every held-out user keeps train-side ratings — otherwise
+    compute_mse's whole-group skip would silently score nothing for them
+    and reward candidates that forget users."""
+    u, i, r = _split_triples(rng)
+    # add a user with a single rating: must stay entirely train-side
+    u = np.r_[u, [999]]
+    i = np.r_[i, [0]]
+    r = np.r_[r, [1.0]]
+    tr, ho = mse_mod.rolling_holdout_split(u, i, r, fraction=0.3, seed=1)
+    train_users = set(u[tr].tolist())
+    assert set(u[ho].tolist()) <= train_users
+    assert 999 in train_users
+    # no leakage: a held-out (user, item, rating) row index never appears
+    # train-side (positional indices partition the row set exactly)
+    assert set(tr.tolist()).isdisjoint(set(ho.tolist()))
+
+
+def test_rolling_holdout_split_validation_and_edges():
+    with pytest.raises(ValueError, match="fraction"):
+        mse_mod.rolling_holdout_split([1], [1], [1.0], fraction=1.0)
+    with pytest.raises(ValueError, match="mismatch"):
+        mse_mod.rolling_holdout_split([1, 2], [1], [1.0, 2.0])
+    # empty input -> empty partition, no crash
+    tr, ho = mse_mod.rolling_holdout_split([], [], [])
+    assert len(tr) == 0 and len(ho) == 0
