@@ -879,6 +879,10 @@ _COMPACT_KEYS = (
     "serving_autopilot_retrains", "serving_autopilot_win_rate",
     "serving_autopilot_mse_monotone", "serving_autopilot_warm_beats_cold",
     "serving_autopilot_rollback_detect_s",
+    "serving_forensics_stage1", "serving_forensics_stage1_share",
+    "serving_forensics_diff_ok", "serving_forensics_alert_fired",
+    "serving_forensics_exemplar_tids",
+    "serving_forensics_incident_names_stage", "serving_forensics_ok",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1133,7 +1137,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
-        "serving_watch,serving_autopilot"
+        "serving_watch,serving_autopilot,serving_forensics"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1220,6 +1224,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_watch", "run_serving_watch_section",
          lambda f: f(small)),
         ("serving_autopilot", "run_serving_autopilot_section",
+         lambda f: f(small)),
+        ("serving_forensics", "run_serving_forensics_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

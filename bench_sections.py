@@ -3196,3 +3196,148 @@ def run_serving_autopilot_section(small: bool) -> dict:
                 pass
         shutil.rmtree(tmp, ignore_errors=True)
     return out
+
+
+def run_serving_forensics_section(small: bool) -> dict:
+    """Tail-latency forensics efficacy (obs/tracing.py + obs/forensics.py
+    + obs/watch.py), the round-14 acceptance demo:
+
+    1. **injected tail** — every 10th traced GET against a live serving
+       job carries a deliberate ``injected_slow`` leaf span (a sleep in
+       the request path); the slow-vs-fast critical-path diff over the
+       span spill must rank that stage **#1** and attribute essentially
+       the whole slow-fast gap to it.
+    2. **incident forensics** — a p99 quantile alert on the (exemplar-
+       linked) request histogram must fire AND its incident record must
+       carry at least one exemplar trace id whose assembled span tree
+       shows the injected stage on its critical path — the alert NAMES
+       the cause, not just the number.
+
+    The hot-path overhead bar for spans+exemplars lives in
+    scripts/obs_overhead_ab.py (<= 3% GET p50, ABAB), not here.
+    """
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.obs import forensics as FX
+    from flink_ms_tpu.obs import tracing as T
+    from flink_ms_tpu.obs.metrics import get_registry, set_exemplars
+    from flink_ms_tpu.obs.rules import Rule
+    from flink_ms_tpu.obs.watch import FleetWatcher
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import (ALS_STATE, ServingJob,
+                                             make_backend,
+                                             parse_als_record)
+    from flink_ms_tpu.serve.journal import Journal
+
+    n_users = 200 if small else 1_000
+    n_q = int(os.environ.get("BENCH_FORENSICS_QUERIES",
+                             120 if small else 400))
+    slow_every = 10
+    slow_s = float(os.environ.get("BENCH_FORENSICS_SLOW_S", 0.02))
+    series = "tpums_bench_request_seconds"
+
+    tmp = tempfile.mkdtemp(prefix="tpums_forensics_bench_")
+    spill = os.path.join(tmp, "spans.jsonl")
+    saved = {k: os.environ.get(k)
+             for k in ("TPUMS_REGISTRY_DIR", "TPUMS_TRACE")}
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    os.environ["TPUMS_TRACE"] = spill
+    prev_ex = set_exemplars(True)
+    out: dict = {}
+    job = None
+    try:
+        rng = np.random.default_rng(0)
+        journal = Journal(os.path.join(tmp, "bus"), "models")
+        journal.append(
+            [F.format_als_row(u, "U", rng.normal(size=4))
+             for u in range(n_users)])
+        job = ServingJob(
+            journal, ALS_STATE, parse_als_record,
+            make_backend("memory", None),
+            host="127.0.0.1", port=0, poll_interval_s=0.01,
+        ).start()
+        assert job.wait_ready(120)
+
+        rule = Rule(name="bench_p99_latency", kind="threshold",
+                    series=series, mode="quantile", q=99.0,
+                    op=">", value=slow_s / 4.0, window_s=300.0,
+                    severity="warn")
+        watcher = FleetWatcher(interval_s=0.1, rules=[rule],
+                               scope="bench_forensics")
+        watcher.tick()  # baseline scrape: the quantile window needs one
+
+        # -- 1. traced load with an injected slow stage ------------------
+        hist = get_registry().histogram(series)
+        qrng = np.random.default_rng(1)
+        with QueryClient("127.0.0.1", job.port, timeout_s=30) as c:
+            for _ in range(30):
+                c.query_state(ALS_STATE, "1-U")  # warm, untraced
+            for i in range(n_q):
+                key = f"{int(qrng.integers(0, n_users))}-U"
+                tid = T.new_trace_id()
+                t0 = time.perf_counter()
+                with T.trace_span(tid):
+                    with T.span("bench_request", verb="GET"):
+                        if i % slow_every == 0:
+                            with T.span("injected_slow"):
+                                time.sleep(slow_s)
+                        c.query_state(ALS_STATE, key)
+                hist.observe(time.perf_counter() - t0, tid=tid)
+
+        # -- 2. the diff must name the injected stage --------------------
+        rep = FX.report([spill], slow_q=0.9)
+        stages = rep["diff"]["stages"]
+        top = stages[0] if stages else {}
+        out["serving_forensics_traces"] = rep["traces"]
+        out["serving_forensics_events"] = rep["events"]
+        out["serving_forensics_stage1"] = top.get("stage")
+        out["serving_forensics_stage1_delta_us"] = (
+            round(top["delta_s"] * 1e6, 1) if top else None)
+        out["serving_forensics_stage1_share"] = top.get("delta_share")
+        out["serving_forensics_diff_ok"] = (
+            top.get("stage") == "injected_slow"
+            and top.get("delta_share", 0.0) >= 0.5)
+        _log(f"[bench:forensics] {rep['traces']} traces; #1 stage "
+             f"{top.get('stage')} (+{(top.get('delta_s') or 0) * 1e6:.0f}us"
+             f", {100 * (top.get('delta_share') or 0):.0f}% of the gap)")
+
+        # -- 3. p99 alert fires and its incident names the stage ---------
+        fired = None
+        for _ in range(20):
+            trs = watcher.tick()
+            fired = next((t for t in trs
+                          if t["kind"] == "alert_firing"
+                          and t["rule"] == rule.name), None)
+            if fired:
+                break
+            time.sleep(0.05)
+        watcher.stop()
+        tids = (fired or {}).get("exemplar_tids") or []
+        incident_stages = set()
+        for row in (fired or {}).get("critical_path") or []:
+            incident_stages.update(r["stage"] for r in row["critical_path"])
+        out["serving_forensics_alert_fired"] = fired is not None
+        out["serving_forensics_exemplar_tids"] = len(tids)
+        out["serving_forensics_incident_names_stage"] = (
+            "injected_slow" in incident_stages)
+        out["serving_forensics_ok"] = (
+            out["serving_forensics_diff_ok"] and fired is not None
+            and len(tids) >= 1 and "injected_slow" in incident_stages)
+        _log(f"[bench:forensics] p99 alert fired={fired is not None} "
+             f"exemplar_tids={len(tids)} incident_stages="
+             f"{sorted(incident_stages)}")
+        job.stop()
+        job = None
+    finally:
+        if job is not None:
+            try:
+                job.stop()
+            except Exception:
+                pass
+        set_exemplars(prev_ex)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out

@@ -60,6 +60,32 @@ def set_enabled(on: bool) -> bool:
     return prev
 
 
+# Exemplars: when on, each histogram bucket remembers the last
+# ``(tid, value, ts)`` observed under an active trace, so any p99 number
+# resolves to a concrete trace id.  Off by default — the hot path then
+# pays exactly one module-global check per observation.
+_EXEMPLARS = os.environ.get("TPUMS_EXEMPLARS", "0") != "0"
+
+# The trace id rides INTO ``observe(v, tid=...)`` explicitly: the call
+# sites that can link an observation to a trace (serve/server.py request
+# epilogue) already hold the wire tid, and an untraced observation then
+# pays literally nothing for the feature — no thread-local read, no
+# provider call.  (A provider indirection was tried first and its ~0.1us
+# per-observe read alone threatened the 3% hot-path bar that
+# scripts/obs_overhead_ab.py enforces.)
+
+
+def exemplars_enabled() -> bool:
+    return _EXEMPLARS
+
+
+def set_exemplars(on: bool) -> bool:
+    """Flip exemplar retention live (bench A/B, tests) -> previous value."""
+    global _EXEMPLARS
+    prev, _EXEMPLARS = _EXEMPLARS, bool(on)
+    return prev
+
+
 # ---------------------------------------------------------------------------
 # shared bucket ladder
 # ---------------------------------------------------------------------------
@@ -159,7 +185,7 @@ class Histogram:
     the tests pin)."""
 
     __slots__ = ("name", "labels", "bounds", "_lock", "_counts",
-                 "_sum", "_count")
+                 "_sum", "_count", "_exemplars")
 
     def __init__(self, name: str,
                  labels: Tuple[Tuple[str, str], ...] = (),
@@ -175,11 +201,22 @@ class Histogram:
         self._counts = [0] * (len(bounds) + 1)
         self._sum = 0.0
         self._count = 0
+        # bucket index -> (tid, value, ts): last traced observation per
+        # bucket, populated only while exemplars are on AND a trace is
+        # active — bounded at one entry per bucket by construction
+        self._exemplars: Dict[int, tuple] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, tid: Optional[str] = None) -> None:
         if not _ENABLED:
             return
         i = bisect_left(self.bounds, v)
+        if tid is not None and _EXEMPLARS:
+            with self._lock:
+                self._counts[i] += 1
+                self._sum += v
+                self._count += 1
+                self._exemplars[i] = (tid, v, time.time())
+            return
         with self._lock:
             self._counts[i] += 1
             self._sum += v
@@ -196,6 +233,11 @@ class Histogram:
     def counts(self) -> List[int]:
         with self._lock:
             return list(self._counts)
+
+    def exemplars(self) -> Dict[int, tuple]:
+        """Snapshot of the per-bucket (tid, value, ts) exemplars."""
+        with self._lock:
+            return dict(self._exemplars)
 
     def fill(self, values: Sequence[float]) -> "Histogram":
         """Bulk-load observations IGNORING the enable switch — for
@@ -321,11 +363,15 @@ class MetricsRegistry:
             with h._lock:
                 counts = list(h._counts)
                 s, n = h._sum, h._count
-            out["histograms"].append({
+                ex = {str(i): list(rec) for i, rec in h._exemplars.items()}
+            entry = {
                 "name": h.name, "labels": dict(h.labels),
                 "le": list(h.bounds), "counts": counts,
                 "sum": s, "count": n,
-            })
+            }
+            if ex:
+                entry["exemplars"] = ex
+            out["histograms"].append(entry)
         return out
 
     def reset(self) -> None:
@@ -391,6 +437,9 @@ def merge_snapshots(snaps: Sequence[dict]) -> dict:
                             "le": list(e["le"]),
                             "counts": list(e["counts"]),
                             "sum": e["sum"], "count": e["count"]}
+                if e.get("exemplars"):
+                    acc_h[k]["exemplars"] = {
+                        b: list(rec) for b, rec in e["exemplars"].items()}
             elif cur["le"] != list(e["le"]):
                 skipped.append(e["name"])
             else:
@@ -398,6 +447,11 @@ def merge_snapshots(snaps: Sequence[dict]) -> dict:
                                  zip(cur["counts"], e["counts"])]
                 cur["sum"] += e["sum"]
                 cur["count"] += e["count"]
+                # exemplars keep the freshest per bucket across replicas
+                for b, rec in (e.get("exemplars") or {}).items():
+                    old = cur.get("exemplars", {}).get(b)
+                    if old is None or rec[2] >= old[2]:
+                        cur.setdefault("exemplars", {})[b] = list(rec)
     out["counters"] = [acc_c[k] for k in sorted(acc_c)]
     out["gauges"] = [acc_g[k] for k in sorted(acc_g)]
     out["histograms"] = [acc_h[k] for k in sorted(acc_h)]

@@ -323,6 +323,21 @@ def fleet_signals(before: dict, after: dict,
     heldout = [g["value"] for g in after.get("gauges", [])
                if g["name"] == "tpums_autopilot_heldout_mse"]
     autopilot["autopilot_heldout_mse"] = min(heldout) if heldout else None
+    # tail-forensics plane (round 14): span volume (rate of span records
+    # across the fleet), live exemplar retention, and how stale the last
+    # forensics collection is (None = never collected anywhere)
+    spans = max(
+        _counter_total(after, "tpums_trace_spans_total")
+        - _counter_total(before, "tpums_trace_spans_total"), 0.0)
+    exemplar_count = sum(
+        len(h.get("exemplars") or ())
+        for h in after.get("histograms", []))
+    last_collect = max(
+        (g["value"] for g in after.get("gauges", [])
+         if g["name"] == "tpums_forensics_last_collect_ts"), default=None)
+    forensics_staleness = (
+        max(time.time() - last_collect, 0.0)
+        if last_collect else None)
     return {
         **autopilot,
         "qps": requests / dt_s,
@@ -336,6 +351,9 @@ def fleet_signals(before: dict, after: dict,
         "ann_recall": min(recall_series) if recall_series else None,
         "alerts_firing": alerts_firing,
         "alerts_max_severity": alerts_max_severity,
+        "trace_spans_per_s": spans / dt_s,
+        "exemplar_count": exemplar_count,
+        "forensics_staleness_s": forensics_staleness,
         "dt_s": dt_s,
         "requests": requests,
     }

@@ -8,6 +8,17 @@ event with ``ts``/``tid``/``kind`` plus free-form span fields (queue wait,
 batch size, device seconds).  Reconstructing one slow request end to end
 is then a filter of the event log by tid.
 
+On top of the flat events sits a **span** layer: an event that also
+carries ``sid`` (8-hex span id), ``psid`` (parent span id), ``t0`` (wall
+start) and ``dur_s`` is a timed node in the request's causal tree.  A
+thread-local span stack parents nested spans automatically; crossing a
+process boundary, the wire tid field widens to ``tid=<tid>/<sid>`` so the
+server's spans parent under the client RPC that caused them (the bare
+``tid=<tid>`` form stays accepted, and servers echo the raw value so old
+clients' exact-suffix unstamp keeps working).  ``obs/forensics.py``
+assembles the per-process JSONL spills back into trees and diffs the
+slow ones against the fast ones.
+
 Wire compatibility is the hard constraint: the seed protocol's servers
 validate field counts strictly (``len(parts) == 3`` etc.), so the tid
 field is ONLY appended while a trace context is active — untraced traffic
@@ -25,13 +36,20 @@ Event sinks, controlled by ``TPUMS_TRACE``:
   one dict + deque append), which is what the in-process tests read;
 - a path — additionally appended as JSONL to that file (``-`` = stderr),
   which is what ``scripts/chaos_kill.py`` and multi-process smoke runs
-  use to correlate across processes.
+  use to correlate across processes.  The file sink rotates at
+  ``TPUMS_TRACE_MAX_BYTES`` (keeping ``TPUMS_TRACE_KEEP`` old files) so a
+  long soak cannot fill the disk.
+
+``TPUMS_TRACE_SAMPLE`` (0..1) is the head-sampling knob: ``sample_trace``
+rolls it once per would-be trace root, so span cost scales with the
+sample rate, not the request rate.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import secrets
 import sys
 import threading
@@ -43,19 +61,71 @@ from . import metrics as _metrics
 
 TID_FIELD = "tid="
 _RING_CAP = 4096
+_DEFAULT_MAX_BYTES = 64 << 20
+_DEFAULT_KEEP = 3
 
-_local = threading.local()
+class _TraceLocal(threading.local):
+    # Class-level defaults so the untraced read is a plain attribute hit:
+    # getattr(local, "tid", None) on a thread that never traced otherwise
+    # raises-and-catches AttributeError internally (~0.5us), and
+    # current_trace()/current_span_id() run on every request's hot path.
+    tid = None
+    spans = None
+
+
+_local = _TraceLocal()
 _ring_lock = threading.Lock()
 _ring: Deque[dict] = deque(maxlen=_RING_CAP)
 _file_lock = threading.Lock()
 _file_handle = None
 _file_path_cached: Optional[str] = None
+_file_bytes = 0
+_file_max_bytes = _DEFAULT_MAX_BYTES
 
 
 def new_trace_id() -> str:
     """16 hex chars — wide enough to never collide within a bench run,
     short enough to cost one small tab field on the wire."""
     return secrets.token_hex(8)
+
+
+def new_span_id() -> str:
+    """8 hex chars — unique within one trace, not globally."""
+    return secrets.token_hex(4)
+
+
+_sample_cache = ("", 0.0)  # (raw env string, parsed rate)
+
+
+def trace_sample_rate() -> float:
+    """``TPUMS_TRACE_SAMPLE`` clamped to [0, 1]; 0 when unset/garbage.
+    Parsed once per distinct env value — workload drivers roll this per
+    request root, so the steady-state cost is one dict lookup and a
+    string compare, not a float parse (the 3% hot-path bar counts it)."""
+    global _sample_cache
+    raw = os.environ.get("TPUMS_TRACE_SAMPLE") or "0"
+    cached_raw, cached = _sample_cache
+    if raw is cached_raw or raw == cached_raw:
+        return cached
+    try:
+        rate = max(0.0, min(1.0, float(raw)))
+    except ValueError:
+        rate = 0.0
+    _sample_cache = (raw, rate)
+    return rate
+
+
+def sample_trace() -> Optional[str]:
+    """Roll the sampling dice once: a fresh trace id with probability
+    ``TPUMS_TRACE_SAMPLE``, else None.  Workload drivers and the update
+    plane call this at trace-root points so span volume follows the knob
+    instead of the request rate."""
+    r = trace_sample_rate()
+    if r <= 0.0:
+        return None
+    if r < 1.0 and random.random() >= r:
+        return None
+    return new_trace_id()
 
 
 # ---------------------------------------------------------------------------
@@ -91,17 +161,96 @@ class trace_span:
         set_trace(self._prev)
 
 
+def current_span_id() -> Optional[str]:
+    """Innermost open span on this thread, or None outside any span."""
+    stack = getattr(_local, "spans", None)
+    return stack[-1] if stack else None
+
+
+def current_context() -> Optional[str]:
+    """The value to hand ``call_with_trace`` when fanning out to a pool:
+    ``tid/sid`` while a span is open (so the worker's spans parent under
+    it), the bare tid otherwise, None when untraced."""
+    tid = current_trace()
+    if tid is None:
+        return None
+    sid = current_span_id()
+    return f"{tid}/{sid}" if sid else tid
+
+
+class span:
+    """``with span("stage", op=...):`` — one timed node in the request's
+    causal tree.  Allocates a span id, parents under the innermost open
+    span on this thread, and emits a single event carrying
+    ``sid``/``psid``/``t0``/``dur_s`` on exit.  A no-op (no id, no event)
+    when no trace context is active, so instrumented code pays one
+    thread-local read on the untraced path."""
+
+    __slots__ = ("kind", "fields", "tid", "sid", "_psid", "_t0")
+
+    def __init__(self, kind: str, tid: Optional[str] = None, **fields):
+        self.kind = kind
+        self.fields = fields
+        self.tid = tid
+        self.sid = None
+
+    def __enter__(self) -> "span":
+        tid = self.tid if self.tid is not None else current_trace()
+        if tid is None:
+            return self
+        self.tid = tid
+        self.sid = new_span_id()
+        self._psid = current_span_id()
+        stack = getattr(_local, "spans", None)
+        if stack is None:
+            stack = _local.spans = []
+        stack.append(self.sid)
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.sid is None:
+            return
+        _local.spans.pop()
+        if exc_type is not None:
+            self.fields.setdefault("error", repr(exc))
+        event(self.kind, tid=self.tid, sid=self.sid, psid=self._psid,
+              t0=self._t0, dur_s=time.time() - self._t0, **self.fields)
+
+
+def span_event(kind: str, tid: Optional[str] = None,
+               dur_s: Optional[float] = None, t0: Optional[float] = None,
+               sid: Optional[str] = None, psid: Optional[str] = None,
+               **fields) -> Optional[dict]:
+    """One-shot span record for call sites that already know the duration
+    (client RPCs, server replies, synthesized microbatch stages).  None
+    when untraced."""
+    tid = tid if tid is not None else current_trace()
+    if tid is None:
+        return None
+    return event(kind, tid=tid, sid=sid if sid is not None else new_span_id(),
+                 psid=psid if psid is not None else current_span_id(),
+                 t0=t0, dur_s=dur_s, **fields)
+
+
 def call_with_trace(tid: Optional[str], fn: Callable, *args, **kwargs):
     """Run ``fn`` with ``tid`` installed — the pool-submit adapter used by
     the sharded/HA fan-out (``pool.submit(call_with_trace, tid, fn, ...)``)
-    so worker threads inherit the submitting request's context."""
+    so worker threads inherit the submitting request's context.  ``tid``
+    may be the composite ``tid/sid`` from ``current_context()``: the sid
+    seeds the worker thread's span stack so its spans parent under the
+    caller's open span."""
     if tid is None:
         return fn(*args, **kwargs)
-    prev = set_trace(tid)
+    base, psid = split_tid(tid)
+    prev = set_trace(base)
+    prev_stack = getattr(_local, "spans", None)
+    _local.spans = [psid] if psid else []
     try:
         return fn(*args, **kwargs)
     finally:
         set_trace(prev)
+        _local.spans = prev_stack if prev_stack is not None else []
 
 
 # ---------------------------------------------------------------------------
@@ -129,10 +278,27 @@ def unstamp_reply(reply: str, tid: str) -> str:
 
 def pop_tid(parts: List[str]) -> Optional[str]:
     """Server side: remove and return a trailing ``tid=`` field from a
-    split request line (mutates ``parts``); None when untraced."""
+    split request line (mutates ``parts``); None when untraced.  The
+    returned value is the RAW wire form — possibly ``tid/sid`` — so the
+    server can echo it verbatim; split with ``split_tid``."""
     if len(parts) >= 2 and parts[-1].startswith(TID_FIELD):
         return parts.pop()[len(TID_FIELD):]
     return None
+
+
+def wire_tid(tid: str, sid: Optional[str] = None) -> str:
+    """The wire form of a trace context: ``tid/sid`` when the caller has
+    an open span for this RPC, the bare tid otherwise."""
+    return f"{tid}/{sid}" if sid else tid
+
+
+def split_tid(raw: Optional[str]):
+    """Split a raw wire tid into ``(trace_id, parent_span_id)`` — the
+    parent is None for the bare pre-span form."""
+    if raw and "/" in raw:
+        base, _, psid = raw.partition("/")
+        return base, (psid or None)
+    return raw, None
 
 
 # ---------------------------------------------------------------------------
@@ -146,6 +312,13 @@ def _trace_file() -> Optional[str]:
     return v
 
 
+def trace_file_path() -> Optional[str]:
+    """The active JSONL spill path (None when TPUMS_TRACE is off or the
+    stderr sink ``-``) — where forensics should collect from."""
+    p = _trace_file()
+    return None if p == "-" else p
+
+
 def event(kind: str, tid: Optional[str] = None, **fields) -> dict:
     """Record one structured event.  Always lands in the in-process ring;
     additionally appended as one JSON line to ``TPUMS_TRACE`` when that is
@@ -154,6 +327,15 @@ def event(kind: str, tid: Optional[str] = None, **fields) -> dict:
                 "tid": tid if tid is not None else current_trace(),
                 "kind": kind}
     ev.update(fields)
+    if "sid" in ev:
+        # span record: count it so fleet_signals can rate the span volume
+        _metrics.get_registry().counter("tpums_trace_spans_total").inc()
+    elif "psid" not in ev:
+        # point event inside an open span parents under it automatically,
+        # so retries/fan-out markers land in the assembled tree
+        psid = current_span_id()
+        if psid is not None:
+            ev["psid"] = psid
     with _ring_lock:
         _ring.append(ev)
     path = _trace_file()
@@ -166,8 +348,15 @@ def event(kind: str, tid: Optional[str] = None, **fields) -> dict:
     return ev
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 def _append_line(path: str, line: str) -> None:
-    global _file_handle, _file_path_cached
+    global _file_handle, _file_path_cached, _file_bytes, _file_max_bytes
     with _file_lock:
         if _file_handle is None or _file_path_cached != path:
             if _file_handle is not None:
@@ -177,7 +366,42 @@ def _append_line(path: str, line: str) -> None:
                     pass
             _file_handle = open(path, "a", buffering=1)
             _file_path_cached = path
+            try:
+                _file_bytes = os.path.getsize(path)
+            except OSError:
+                _file_bytes = 0
+            # rotation knobs are read once per open — cheap appends, and a
+            # test that re-points TPUMS_TRACE re-reads them naturally
+            _file_max_bytes = _env_int("TPUMS_TRACE_MAX_BYTES",
+                                       _DEFAULT_MAX_BYTES)
+        if _file_bytes >= _file_max_bytes > 0:
+            _rotate_locked(path)
         _file_handle.write(line + "\n")
+        _file_bytes += len(line) + 1
+
+
+def _rotate_locked(path: str) -> None:
+    """Size-capped keep-K rotation: path -> path.1 -> ... -> path.K, the
+    oldest dropped.  Caller holds ``_file_lock``."""
+    global _file_handle, _file_bytes
+    try:
+        _file_handle.close()
+    except OSError:
+        pass
+    keep = max(0, _env_int("TPUMS_TRACE_KEEP", _DEFAULT_KEEP))
+    try:
+        if keep == 0:
+            os.remove(path)
+        else:
+            for i in range(keep - 1, 0, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            os.replace(path, f"{path}.1")
+    except OSError:
+        pass  # cross-process rotation race: the loser just keeps appending
+    _file_handle = open(path, "a", buffering=1)
+    _file_bytes = 0
 
 
 def recent_events(tid: Optional[str] = None,

@@ -54,6 +54,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..serve import registry as job_registry
+from . import forensics
 from . import tracing
 from .metrics import get_registry, render_prometheus
 from .rules import (RulesEngine, attribute_alerts, default_rules,
@@ -208,6 +209,31 @@ class ModelQualityCanary:
 # the watch loop
 # ---------------------------------------------------------------------------
 
+def _exemplar_tids(scrape: dict, series: str,
+                   limit: int = 8) -> List[str]:
+    """Trace ids retained by the fleet's exemplar-linked histogram
+    buckets for ``series``, slowest bucket first — the concrete requests
+    behind a breached latency quantile."""
+    recs = []  # (bucket_index, value, tid)
+    fleet = scrape.get("fleet") or {}
+    for h in fleet.get("histograms", []):
+        if h.get("name") != series:
+            continue
+        for idx, rec in (h.get("exemplars") or {}).items():
+            try:
+                recs.append((int(idx), float(rec[1]), str(rec[0])))
+            except (TypeError, ValueError, IndexError):
+                continue
+    recs.sort(key=lambda r: (-r[0], -r[1]))
+    out: List[str] = []
+    for _, _, tid in recs:
+        if tid not in out:
+            out.append(tid)
+        if len(out) >= limit:
+            break
+    return out
+
+
 class FleetWatcher:
     """Scrape/retain/evaluate/publish on a fixed cadence (see module
     docstring).  Use as a context manager or ``start()``/``stop()``;
@@ -280,6 +306,8 @@ class FleetWatcher:
                 self.store.observe("tpums_model_staleness_seconds",
                                    p["staleness_s"], ts=now)
         transitions = self.engine.evaluate(self.store, now=now)
+        if transitions:
+            self._attach_forensics(transitions, scrape)
         if self.publish:
             summary = self.engine.summary()
             reg = get_registry()
@@ -294,6 +322,33 @@ class FleetWatcher:
         self.ticks += 1
         self.tick_seconds.append(time.perf_counter() - t0)
         return transitions
+
+    def _attach_forensics(self, transitions: List[dict],
+                          scrape: dict) -> None:
+        """Enrich latency-quantile firings with forensics: the exemplar
+        tids the breached histogram retained, plus each trace's critical
+        path.  The incident record then NAMES the stage that made p99
+        slow instead of just quoting the breached number.  Transitions
+        are the same dict objects ``engine.history`` keeps, so the
+        enrichment lands in the incident timeline."""
+        rules = {r.name: r for r in self.engine.rules}
+        for tr in transitions:
+            rule = rules.get(tr.get("rule"))
+            if (tr.get("kind") != "alert_firing" or rule is None
+                    or rule.kind != "threshold"
+                    or rule.mode != "quantile"):
+                continue
+            tids = _exemplar_tids(scrape, rule.series)
+            if not tids:
+                continue
+            spill = tracing.trace_file_path()
+            try:
+                ctx = forensics.incident_context(
+                    tids, paths=[spill] if spill else None)
+            except (OSError, ValueError) as e:
+                self.last_error = f"forensics: {e}"
+                continue
+            tr.update(ctx)
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -99,9 +99,17 @@ class QueryClient:
         if mode not in ("tab", "b2", "auto"):
             raise ValueError(f"proto must be tab|b2|auto, got {mode!r}")
         self.proto = mode
+        # B2 per-record tracing is opt-in via TPUMS_TRACE_B2: it widens the
+        # HELLO (``tr=1``) and every request record by one field, so the
+        # default keeps binary wire bytes identical to the seed encoder —
+        # the same opt-in contract as the tab plane's tid field.  An old
+        # server refuses the extended HELLO and auto mode falls back to tab
+        # (where tracing needs no negotiation).
+        self._want_b2_trace = os.environ.get("TPUMS_TRACE_B2", "0") != "0"
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._binary = False  # per-connection: set by the HELLO exchange
+        self._b2_trace = False  # per-connection: tr=1 accepted
         self._frame_reader = None
 
     def _connect(self):
@@ -110,15 +118,20 @@ class QueryClient:
         self._sock = sock
         self._rfile = sock.makefile("rb")
         self._binary = False
+        self._b2_trace = False
         self._frame_reader = None
         if self.proto in ("b2", "auto"):
             # with a tenant, the HELLO carries it (connection-scoped — B2
-            # records have fixed field counts); an old server refuses the
-            # extended line exactly like a plain HELLO, so auto mode still
-            # falls back to tab, where the tenant rides per-request
-            hello = wire_proto.HELLO_LINE if self.tenant is None else (
-                f"{wire_proto.HELLO_LINE}\t"
-                f"{admission_ctl.TENANT_FIELD}{self.tenant}")
+            # records have fixed field counts); ``tr=1`` asks for the
+            # per-record trace field the same way.  An old server refuses
+            # an extended HELLO exactly like a plain one, so auto mode
+            # still falls back to tab, where tenant and tid ride
+            # per-request with no negotiation.
+            hello = wire_proto.HELLO_LINE
+            if self.tenant is not None:
+                hello += f"\t{admission_ctl.TENANT_FIELD}{self.tenant}"
+            if self._want_b2_trace:
+                hello += f"\t{wire_proto.TRACE_EXT}"
             sock.sendall(hello.encode("utf-8") + b"\n")
             line = self._rfile.readline()
             if not line:
@@ -127,6 +140,7 @@ class QueryClient:
             reply = line.decode("utf-8").rstrip("\n")
             if reply == wire_proto.HELLO_REPLY:
                 self._binary = True
+                self._b2_trace = self._want_b2_trace
                 self._frame_reader = wire_proto.FrameReader(self._rfile)
             elif self.proto == "b2":
                 self.close()
@@ -146,14 +160,22 @@ class QueryClient:
         stripped off the reply before any parsing (so tab-bearing payloads
         like MGET stay intact), and a ``client_rpc`` span event records
         the round-trip — including retries, which is how a failover shows
-        up in a request's event chain.  With no context active the wire
-        bytes are identical to the seed protocol.  On a B2-negotiated
-        connection the request rides a one-record binary frame instead,
-        with no tid stamping (tracing targets the tab plane; the record
-        layout has no room for extra fields)."""
+        up in a request's event chain.  The wire carries ``tid/sid`` so
+        the server's span parents under this rpc across the process
+        boundary.  With no context active the wire bytes are identical to
+        the seed protocol.  On a B2-negotiated connection the request
+        rides a one-record binary frame; the tid travels in the record's
+        extra trace field only when ``tr=1`` was negotiated
+        (``TPUMS_TRACE_B2``) — otherwise the frame bytes stay identical
+        and the client_rpc span is local-only."""
         tid = obs_tracing.current_trace()
+        sid = wt = None
         if tid is not None:
+            sid = obs_tracing.new_span_id()
+            psid = obs_tracing.current_span_id()
+            wt = obs_tracing.wire_tid(tid, sid)
             t0 = time.perf_counter()
+            t0_wall = time.time()
         # tenant field first, tid last: the server pops tid, then tenant
         # (serve/server.py _dispatch_parts).  No tenant -> ``line`` IS the
         # request and the wire stays byte-identical to the seed protocol.
@@ -166,16 +188,25 @@ class QueryClient:
                 if self._sock is None:
                     self._connect()
                 if self._binary:
-                    self._sock.sendall(
-                        wire_proto.encode_request_frame([request]))
+                    self._sock.sendall(wire_proto.encode_request_frame(
+                        [request],
+                        tids=[wt] if self._b2_trace else None))
                     texts = self._frame_reader.read_frame()
                     if len(texts) != 1:
                         raise ConnectionError(
                             f"reply frame carried {len(texts)} records "
                             "for a 1-record request")
+                    if tid is not None:
+                        dt = time.perf_counter() - t0
+                        obs_tracing.event(
+                            "client_rpc", tid=tid, sid=sid, psid=psid,
+                            t0=t0_wall, dur_s=round(dt, 9),
+                            verb=request.split("\t", 1)[0],
+                            host=self.host, port=self.port,
+                            retries=failures, lat_s=round(dt, 6))
                     return texts[0]
-                wire = data if tid is None else (
-                    f"{line}\t{obs_tracing.TID_FIELD}{tid}\n"
+                wire = data if wt is None else (
+                    f"{line}\t{obs_tracing.TID_FIELD}{wt}\n"
                     .encode("utf-8"))
                 self._sock.sendall(wire)
                 line = self._rfile.readline()
@@ -184,20 +215,24 @@ class QueryClient:
                         "lookup server closed the connection")
                 reply = line.decode("utf-8").rstrip("\n")
                 if tid is not None:
-                    reply = obs_tracing.unstamp_reply(reply, tid)
+                    reply = obs_tracing.unstamp_reply(reply, wt)
+                    dt = time.perf_counter() - t0
                     obs_tracing.event(
-                        "client_rpc", tid=tid,
+                        "client_rpc", tid=tid, sid=sid, psid=psid,
+                        t0=t0_wall, dur_s=round(dt, 9),
                         verb=request.split("\t", 1)[0],
                         host=self.host, port=self.port, retries=failures,
-                        lat_s=round(time.perf_counter() - t0, 6))
+                        lat_s=round(dt, 6))
                 return reply
             except (BrokenPipeError, ConnectionResetError, ConnectionError,
                     OSError) as e:
                 self.close()
                 failures += 1
                 if tid is not None:
+                    # retries parent under the rpc span, so a failover
+                    # shows up INSIDE the slow rpc in the assembled tree
                     obs_tracing.event(
-                        "client_retry", tid=tid, host=self.host,
+                        "client_retry", tid=tid, psid=sid, host=self.host,
                         port=self.port, attempt=failures, error=str(e))
                 if failures >= self.retry.attempts:
                     raise
@@ -288,6 +323,18 @@ class QueryClient:
             raise ValueError("window must be >= 1")
         if self._sock is None:
             self._connect()
+        tid = obs_tracing.current_trace()
+        sid = wt = None
+        if tid is not None:
+            # ONE span (and one wire tid/sid) for the whole window: the
+            # server's per-request spans all parent under this pipeline
+            # span, so a pipelined fan-out leg is still one
+            # reconstructable chain
+            sid = obs_tracing.new_span_id()
+            psid = obs_tracing.current_span_id()
+            wt = obs_tracing.wire_tid(tid, sid)
+            t0 = time.perf_counter()
+            t0_wall = time.time()
         if self._binary:
             chunks = [requests[i:i + window]
                       for i in range(0, len(requests), window)]
@@ -296,9 +343,12 @@ class QueryClient:
             next_send = 0
             while len(replies) < len(requests):
                 while next_send < len(chunks) and len(inflight) < 2:
-                    self._sock.sendall(
-                        wire_proto.encode_request_frame(chunks[next_send]))
-                    inflight.append(len(chunks[next_send]))
+                    chunk = chunks[next_send]
+                    self._sock.sendall(wire_proto.encode_request_frame(
+                        chunk,
+                        tids=[wt] * len(chunk)
+                        if self._b2_trace else None))
+                    inflight.append(len(chunk))
                     next_send += 1
                 texts = self._frame_reader.read_frame()
                 expect = inflight.pop(0)
@@ -307,20 +357,22 @@ class QueryClient:
                         f"reply frame carried {len(texts)} records, "
                         f"expected {expect}")
                 replies.extend(texts)
+            if tid is not None:
+                dt = time.perf_counter() - t0
+                obs_tracing.event(
+                    "client_pipeline", tid=tid, sid=sid, psid=psid,
+                    t0=t0_wall, dur_s=round(dt, 9), host=self.host,
+                    port=self.port, n=len(requests), window=window,
+                    lat_s=round(dt, 6))
             return replies
         if self.tenant is not None:
             # tab plane: tenant per request (before the tid, same order as
             # _roundtrip, so the server's two pops compose)
             tsuffix = f"\t{admission_ctl.TENANT_FIELD}{self.tenant}"
             requests = [req + tsuffix for req in requests]
-        tid = obs_tracing.current_trace()
-        if tid is not None:
-            # one tid for the whole window: the server's per-request span
-            # events all carry it, so a pipelined fan-out leg is still one
-            # reconstructable chain
-            suffix = f"\t{obs_tracing.TID_FIELD}{tid}"
+        if wt is not None:
+            suffix = f"\t{obs_tracing.TID_FIELD}{wt}"
             requests = [req + suffix for req in requests]
-            t0 = time.perf_counter()
         if self._sock is None:
             self._connect()
         replies, sent = [], 0
@@ -347,11 +399,13 @@ class QueryClient:
                 )
             replies.append(line.decode("utf-8").rstrip("\n"))
         if tid is not None:
-            replies = [obs_tracing.unstamp_reply(r, tid) for r in replies]
+            replies = [obs_tracing.unstamp_reply(r, wt) for r in replies]
+            dt = time.perf_counter() - t0
             obs_tracing.event(
-                "client_pipeline", tid=tid, host=self.host, port=self.port,
-                n=len(requests), window=window,
-                lat_s=round(time.perf_counter() - t0, 6))
+                "client_pipeline", tid=tid, sid=sid, psid=psid,
+                t0=t0_wall, dur_s=round(dt, 9), host=self.host,
+                port=self.port, n=len(requests), window=window,
+                lat_s=round(dt, 6))
         return replies
 
     def topk_pipelined(self, name: str, user_ids, k: int,

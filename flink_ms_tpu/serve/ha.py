@@ -350,8 +350,9 @@ class HAShardedClient:
 
         # pool threads don't inherit thread-local trace context: capture
         # the submitting request's tid NOW and re-install it per task, so
-        # every shard leg of a traced fan-out carries the same id
-        tid = obs_tracing.current_trace()
+        # every shard leg of a traced fan-out carries the same id (and,
+        # via the ``tid/sid`` composite, parents under the open span)
+        tid = obs_tracing.current_context()
         futures = {
             w: self._pool.submit(
                 obs_tracing.call_with_trace, tid,
@@ -383,19 +384,19 @@ class HAShardedClient:
         vecs = [payloads[i] for i in known]
         from concurrent.futures import wait as _futures_wait
 
-        tid = obs_tracing.current_trace()
-        if tid is not None:
-            obs_tracing.event(
-                "fanout", tid=tid, op="topk_many",
-                shards=self.num_workers, queries=len(known), k=k)
-        futs = [
-            self._pool.submit(
-                obs_tracing.call_with_trace, tid,
-                self._call, w, "topk_by_vector_pipelined", name, vecs, k)
-            for w in range(self.num_workers)
-        ]
-        _futures_wait(futs)
-        per_worker = [f.result() for f in futs]
+        with obs_tracing.span("fanout", op="topk_many",
+                              shards=self.num_workers,
+                              queries=len(known), k=k):
+            ctx = obs_tracing.current_context()
+            futs = [
+                self._pool.submit(
+                    obs_tracing.call_with_trace, ctx,
+                    self._call, w, "topk_by_vector_pipelined",
+                    name, vecs, k)
+                for w in range(self.num_workers)
+            ]
+            _futures_wait(futs)
+            per_worker = [f.result() for f in futs]
         for j, i in enumerate(known):
             merged: List[Tuple[str, float]] = []
             for worker_results in per_worker:

@@ -134,6 +134,8 @@ def _declare_abi(lib):
         ctypes.POINTER(ctypes.c_double), ctypes.c_int,
     ]
     lib.tpums_server_set_health.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpums_server_set_trace.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int]
     lib.tpums_server_port.restype = ctypes.c_int
     lib.tpums_server_port.argtypes = [ctypes.c_void_p]
     lib.tpums_server_requests.restype = ctypes.c_uint64
@@ -439,6 +441,36 @@ class NativeLookupServer:
         self.state_name = state_name
         self.job_id = job_id
         self.port = int(self._lib.tpums_server_port(self._h))
+        # tail-forensics span spill: when TPUMS_TRACE is a file path (the
+        # Python plane's event sink, obs/tracing.py), traced requests on
+        # this server append their server_reply span records to the SAME
+        # file — one fleet-wide spill for obs.forensics to collect
+        tpath = os.environ.get("TPUMS_TRACE", "").strip()
+        if tpath not in ("", "0", "1", "-"):
+            self.set_trace(tpath)
+
+    def set_trace(self, path: Optional[str],
+                  max_bytes: Optional[int] = None,
+                  keep: Optional[int] = None) -> None:
+        """Point the C++ span spill at ``path`` (None/"" disables it).
+        ``max_bytes``/``keep`` default to the TPUMS_TRACE_MAX_BYTES /
+        TPUMS_TRACE_KEEP rotation knobs, matching the Python sink."""
+        if not self._h:
+            return
+
+        def _env_int(name: str, default: int) -> int:
+            try:
+                return int(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+
+        if max_bytes is None:
+            max_bytes = _env_int("TPUMS_TRACE_MAX_BYTES", 0)  # 0 = C default
+        if keep is None:
+            keep = _env_int("TPUMS_TRACE_KEEP", -1)  # -1 = C default
+        self._lib.tpums_server_set_trace(
+            self._h, path.encode("utf-8") if path else None,
+            max_bytes, keep)
 
     def set_health(self, health_json: Optional[str]) -> None:
         """Push the owning job's health dict (one-line JSON) into the C++

@@ -35,10 +35,37 @@ from typing import List, Optional, Sequence, Tuple
 
 MAGIC = b"B2"
 
-# The negotiation handshake, sent as a plain tab-protocol line.
+# The negotiation handshake, sent as a plain tab-protocol line.  Optional
+# extensions ride as extra tab fields, each self-describing: ``tn=<tenant>``
+# (admission identity, serve/admission.py) and ``tr=1`` (per-record trace
+# field, obs/tracing.py).  A HELLO with any OTHER extra field is malformed
+# and answers ``E\tbad request`` — pinned, so old and new servers refuse
+# unknown extensions identically.  The accept reply stays the frozen
+# two-field line either way.
 HELLO_VERB = "HELLO"
 HELLO_LINE = "HELLO\tB2"
 HELLO_REPLY = "HELLO\tB2"
+TRACE_EXT = "tr=1"
+_TENANT_FIELD = "tn="  # mirrors serve/admission.py TENANT_FIELD (no import:
+                       # proto stays dependency-free)
+
+
+def parse_hello(parts: Sequence[str]) -> Optional[dict]:
+    """Validate a split HELLO line -> ``{"proto", "tenant", "trace"}`` or
+    None when structurally malformed (unknown extension, duplicate tenant).
+    The caller still refuses protos other than ``B2``."""
+    if len(parts) < 2 or parts[0] != HELLO_VERB:
+        return None
+    tenant: Optional[str] = None
+    trace = False
+    for ext in parts[2:]:
+        if ext.startswith(_TENANT_FIELD) and tenant is None:
+            tenant = ext[len(_TENANT_FIELD):]
+        elif ext == TRACE_EXT and not trace:
+            trace = True
+        else:
+            return None
+    return {"proto": parts[1], "tenant": tenant, "trace": trace}
 
 # Opcode byte per verb.  Order is frozen; new verbs append.
 OPCODES = {
@@ -124,8 +151,14 @@ _B1 = [bytes([i]) for i in range(0x80)]
 _OPCODE_BYTES = {verb: bytes([op]) for verb, op in OPCODES.items()}
 
 
-def record_from_line(line: str) -> bytes:
-    """Encode one tab-protocol request line as a B2 request record."""
+def record_from_line(line: str, tid: Optional[str] = None) -> bytes:
+    """Encode one tab-protocol request line as a B2 request record.
+
+    ``tid`` is only legal on a ``tr=1``-negotiated connection: the record
+    grows exactly one trailing length-prefixed field carrying the raw wire
+    tid (empty = this record untraced).  Without negotiation the layout is
+    the frozen v2 record, byte-identical to the seed encoder.
+    """
     parts = line.split("\t")
     verb = parts[0]
     opb = _OPCODE_BYTES.get(verb)
@@ -134,6 +167,8 @@ def record_from_line(line: str) -> bytes:
     nfields = FIELD_COUNTS[verb]
     if len(parts) - 1 != nfields:
         raise ProtoError("verb %s takes %d fields, got %d" % (verb, nfields, len(parts) - 1))
+    if tid is not None:
+        parts = parts + [tid]
     pieces = [opb]
     for f in parts[1:]:
         raw = f.encode("utf-8")
@@ -143,11 +178,16 @@ def record_from_line(line: str) -> bytes:
     return b"".join(pieces)
 
 
-def record_to_parts(body, pos: int, end: int) -> Tuple[List[str], int]:
+def record_to_parts(body, pos: int, end: int,
+                    trace: bool = False) -> Tuple[List[str], int]:
     """Decode one request record from ``body[pos:end]``.
 
     Returns ``(parts, next_pos)`` where ``parts`` is the tab-protocol parts
-    list (verb first).  Raises :class:`ProtoError` on structural corruption.
+    list (verb first).  On a ``trace`` (``tr=1``) connection every record
+    carries one extra trailing field; when non-empty it is surfaced as a
+    trailing ``tid=<raw>`` part, exactly where the tab plane's
+    ``pop_tid`` expects it.  Raises :class:`ProtoError` on structural
+    corruption.
     """
     if pos >= end:
         raise ProtoError("bad body")
@@ -157,7 +197,7 @@ def record_to_parts(body, pos: int, end: int) -> Tuple[List[str], int]:
     if verb is None:
         raise ProtoError("bad body")
     parts = [verb]
-    for _ in range(FIELD_COUNTS[verb]):
+    for _ in range(FIELD_COUNTS[verb] + (1 if trace else 0)):
         if pos >= end:
             raise ProtoError("bad body")
         flen = body[pos]
@@ -175,16 +215,25 @@ def record_to_parts(body, pos: int, end: int) -> Tuple[List[str], int]:
         except UnicodeDecodeError:
             raise ProtoError("bad body")
         pos += flen
+    if trace:
+        raw_tid = parts.pop()
+        if raw_tid:
+            parts.append("tid=" + raw_tid)
     return parts, pos
 
 
-def encode_request_frame(lines: Sequence[str]) -> bytes:
-    """Encode a batch of tab-protocol request lines as one B2 frame."""
+def encode_request_frame(lines: Sequence[str],
+                         tids: Optional[Sequence[Optional[str]]] = None
+                         ) -> bytes:
+    """Encode a batch of tab-protocol request lines as one B2 frame.
+    ``tids`` (tr=1 connections only) aligns with ``lines``; None entries
+    encode as the empty trace field."""
     n = len(lines)
     pieces = [_B1[n] if n < 0x80 else encode_varint(n)]
     body_len = len(pieces[0])
-    for line in lines:
-        rec = record_from_line(line)
+    for i, line in enumerate(lines):
+        rec = record_from_line(
+            line, None if tids is None else (tids[i] or ""))
         body_len += len(rec)
         pieces.append(rec)
     if body_len > MAX_REQUEST_BODY:
@@ -213,12 +262,14 @@ def _decode_frame(buf, pos: int, max_body: int) -> Optional[Tuple[int, int]]:
     return body_start, body_start + body_len
 
 
-def decode_request_frame(buf, pos: int = 0) -> Optional[Tuple[List[List[str]], int]]:
+def decode_request_frame(buf, pos: int = 0, trace: bool = False
+                         ) -> Optional[Tuple[List[List[str]], int]]:
     """Decode one request frame from ``buf[pos:]``.
 
     Returns ``(records, next_pos)`` where each record is a parts list, or
     ``None`` when the buffer does not yet hold a complete frame.  Raises
-    :class:`ProtoError` on structural corruption.
+    :class:`ProtoError` on structural corruption.  ``trace`` reflects the
+    connection's ``tr=1`` negotiation (see :func:`record_to_parts`).
     """
     if isinstance(buf, memoryview):
         buf = buf.tobytes()
@@ -232,7 +283,7 @@ def decode_request_frame(buf, pos: int = 0) -> Optional[Tuple[List[List[str]], i
     count, rpos = dv
     records: List[List[str]] = []
     for _ in range(count):
-        parts, rpos = record_to_parts(buf, rpos, end)
+        parts, rpos = record_to_parts(buf, rpos, end, trace)
         records.append(parts)
     if rpos != end:
         raise ProtoError("bad body")

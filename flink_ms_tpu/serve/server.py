@@ -214,8 +214,11 @@ class LookupServer:
                 # tenant bound to THIS connection by an extended HELLO
                 # (``HELLO\tB2\ttn=<t>``) — the B2 record layout has no
                 # room for a per-request field, so on the binary plane
-                # tenancy is a connection property
+                # tenancy is a connection property.  ``tr=1`` likewise
+                # binds per-record tracing: every subsequent request
+                # record carries one extra trailing tid field.
                 conn_tenant = None
+                conn_trace = False
                 try:
                     while True:
                         # block for at least one complete line (or EOF)
@@ -257,21 +260,24 @@ class LookupServer:
                             lines.append(raw.decode("utf-8"))
                             hello_b = proto.HELLO_LINE.encode("utf-8")
                             if raw == hello_b or raw.startswith(
-                                    hello_b + b"\t"
-                                    + admission_ctl.TENANT_FIELD
-                                    .encode("utf-8")):
-                                # protocol switch: whatever follows the
-                                # HELLO line is already B2 frames — stop
-                                # line-splitting and leave it buffered.
-                                # An extended HELLO binds its tenant to
-                                # the connection.
-                                if raw != hello_b:
-                                    conn_tenant = (
-                                        raw.decode("utf-8").split("\t")[2]
-                                        [len(admission_ctl.TENANT_FIELD):]
-                                        or None)
-                                hello = True
-                                break
+                                    hello_b + b"\t"):
+                                # candidate protocol switch: only a HELLO
+                                # whose every extension parses (tn=/tr=)
+                                # flips the connection — anything else
+                                # stays a normal line and answers the
+                                # generic E\tbad request below, exactly
+                                # like an old server.
+                                ext = proto.parse_hello(
+                                    raw.decode("utf-8").split("\t"))
+                                if ext is not None:
+                                    # whatever follows the HELLO line is
+                                    # already B2 frames — stop
+                                    # line-splitting, leave it buffered,
+                                    # bind the extensions to the conn
+                                    conn_tenant = ext["tenant"] or None
+                                    conn_trace = ext["trace"]
+                                    hello = True
+                                    break
                         if eof and buf and not hello:
                             # trailing request without a newline is still
                             # answered (readline()-at-EOF parity, pinned by
@@ -309,7 +315,8 @@ class LookupServer:
                             return
                         if hello:
                             outer._serve_binary(sock, self.wfile, buf, eof,
-                                                tenant=conn_tenant)
+                                                tenant=conn_tenant,
+                                                trace=conn_trace)
                             return
                         if eof:
                             return
@@ -423,7 +430,8 @@ class LookupServer:
         return self._dispatch_parts(line.split("\t"), burst)
 
     def _dispatch_parts(self, parts, burst: int = 1, traced: bool = True,
-                        tenant: Optional[str] = None):
+                        tenant: Optional[str] = None,
+                        echo_tid: bool = True):
         """Dispatch over already-split fields — the shared core of the tab
         line loop and the B2 frame loop (binary records arrive pre-split,
         and their fields may legally contain tabs, so they must never take
@@ -432,8 +440,10 @@ class LookupServer:
         Also the observability choke point: pops an optional trailing
         ``tid=`` trace field FIRST (so every verb handler below sees the
         seed protocol's exact field counts — untraced traffic is
-        byte-identical in both directions; binary mode passes
-        ``traced=False``, tracing targets the tab plane), times the
+        byte-identical in both directions; an un-negotiated binary
+        connection passes ``traced=False``, a ``tr=1`` one gets its
+        per-record tid surfaced as the same trailing field but with
+        ``echo_tid=False`` — B2 replies are never suffixed), times the
         dispatch, feeds the per-verb counter/latency instruments, and
         echoes the tid on the reply.  Deferred top-k replies do all of
         that at resolve time via the post hook, when their true latency
@@ -454,18 +464,20 @@ class LookupServer:
         if self.admission is not None and \
                 not self.admission.admit(tenant, verb):
             return self._finish(verb, tid, t0, admission_ctl.SHED_REPLY,
-                                shed=True)
+                                shed=True, echo=echo_tid)
         if verb == "METRICS" and len(parts) == 1:
-            return self._finish(verb, tid, t0, self._metrics_reply())
+            return self._finish(verb, tid, t0, self._metrics_reply(),
+                                echo=echo_tid)
         reply = self._handle(parts, burst)
         if isinstance(reply, _DeferredReply):
             reply.post = lambda rendered, resolver: self._finish(
-                verb, tid, t0, rendered, resolver)
+                verb, tid, t0, rendered, resolver, echo=echo_tid)
             return reply
-        return self._finish(verb, tid, t0, reply)
+        return self._finish(verb, tid, t0, reply, echo=echo_tid)
 
     def _serve_binary(self, sock, wfile, buf: bytearray, eof: bool,
-                      tenant: Optional[str] = None) -> None:
+                      tenant: Optional[str] = None,
+                      trace: bool = False) -> None:
         """B2 frame loop, entered after an accepted HELLO (``serve.proto``).
 
         One request frame in -> one reply frame out, records answered in
@@ -478,7 +490,7 @@ class LookupServer:
         atomic or absent)."""
         while True:
             try:
-                res = proto.decode_request_frame(buf)
+                res = proto.decode_request_frame(buf, trace=trace)
             except proto.ProtoError as e:
                 try:
                     wfile.write(proto.error_frame(str(e)))
@@ -502,8 +514,13 @@ class LookupServer:
             if len(records) > 1:
                 self._obs_burst.observe(len(records))
             replies = [
+                # tr=1 records surface their tid as the standard trailing
+                # field (decoder contract), so ``traced=trace`` reuses the
+                # tab plane's pop/span path — but B2 replies are never
+                # tid-suffixed (the client keeps its own request order)
                 self._dispatch_parts(parts, burst=len(records),
-                                     traced=False, tenant=tenant)
+                                     traced=trace, tenant=tenant,
+                                     echo_tid=False)
                 for parts in records
             ]
             if len(records) > 1:
@@ -529,18 +546,29 @@ class LookupServer:
         return inst
 
     def _finish(self, verb: str, tid: Optional[str], t0: float,
-                reply: str, resolver=None, shed: bool = False) -> str:
+                reply: str, resolver=None, shed: bool = False,
+                echo: bool = True) -> str:
         """Request epilogue: per-verb metrics, span event + tid echo for
         traced requests.  ``resolver`` (deferred top-k only) may expose a
         ``pending`` with the microbatcher's span fields — queue wait,
-        batch size, device seconds — which join the event so one slow
-        traced query shows WHERE its time went.
+        batch size, device seconds — which join the event AND become
+        synthesized child spans (``mb_queue_wait``/``mb_device``) under
+        the ``server_reply`` span, so one slow traced query shows WHERE
+        its time went.
+
+        ``tid`` is the RAW wire value (possibly ``tid/sid`` — the sid is
+        the CLIENT's rpc span, which parents this server's span across
+        the process boundary); it is echoed verbatim so the client's
+        exact-suffix unstamp keeps working.  ``echo=False`` (B2) skips
+        the suffix — frames carry no reply-side tid.
 
         ``shed`` marks an admission reject: it is an E-reply on the wire
         but NOT a server error — it rides its own counter
         (``tpums_admission_shed_total``), so deliberate shedding never
         reads as the fleet failing."""
         dt = time.perf_counter() - t0
+        trace_id, psid = obs_tracing.split_tid(tid) if tid is not None \
+            else (None, None)
         if obs_metrics.metrics_enabled():
             # ONE locked observation per request: the per-verb request
             # count is the latency histogram's count, and the
@@ -548,13 +576,19 @@ class LookupServer:
             # synthesized from it at snapshot time (synthesize_requests)
             # instead of paying a second lock on every request
             latency, errors = self._verb_obs(verb)
-            latency.observe(dt)
+            # the tid rides along so an exemplar (obs/metrics.py) can link
+            # this bucket to this trace; None for untraced requests
+            latency.observe(dt, tid=trace_id)
             if reply.startswith("E") and not shed:
                 errors.inc()
         if tid is not None:
+            t_end = time.time()
+            sid = obs_tracing.new_span_id()
             fields = {"verb": verb, "job_id": self.job_id,
                       "port": self.port, "lat_s": round(dt, 6),
                       "ok": not reply.startswith("E")}
+            if shed:
+                fields["shed"] = True
             pending = getattr(resolver, "pending", None)
             if pending is not None:
                 for name in ("queue_wait_s", "batch_size", "device_s"):
@@ -562,8 +596,28 @@ class LookupServer:
                     if v is not None:
                         fields[name] = round(v, 6) if isinstance(v, float) \
                             else v
-            obs_tracing.event("server_reply", tid=tid, **fields)
-            reply = f"{reply}\t{obs_tracing.TID_FIELD}{tid}"
+            obs_tracing.event("server_reply", tid=trace_id, sid=sid,
+                              psid=psid, t0=t_end - dt,
+                              dur_s=round(dt, 9), **fields)
+            if pending is not None:
+                # synthesize the microbatch stages as child spans — the
+                # batcher records durations, not span ids, so the tree
+                # shape is rebuilt here from the request timeline
+                qw = getattr(pending, "queue_wait_s", None)
+                dev = getattr(pending, "device_s", None)
+                if qw is not None:
+                    obs_tracing.event(
+                        "mb_queue_wait", tid=trace_id,
+                        sid=obs_tracing.new_span_id(), psid=sid,
+                        t0=t_end - dt, dur_s=round(qw, 9))
+                if dev is not None:
+                    obs_tracing.event(
+                        "mb_device", tid=trace_id,
+                        sid=obs_tracing.new_span_id(), psid=sid,
+                        t0=t_end - dev, dur_s=round(dev, 9),
+                        batch_size=getattr(pending, "batch_size", None))
+            if echo:
+                reply = f"{reply}\t{obs_tracing.TID_FIELD}{tid}"
         return reply
 
     def _metrics_reply(self) -> str:
@@ -583,18 +637,17 @@ class LookupServer:
         """Verb dispatch over already-split fields (tid removed)."""
         if parts[0] == "PING":
             return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
-        if parts[0] == proto.HELLO_VERB and (
-                len(parts) == 2
-                or (len(parts) == 3
-                    and parts[2].startswith(admission_ctl.TENANT_FIELD))):
+        if parts[0] == proto.HELLO_VERB and \
+                proto.parse_hello(parts) is not None:
             # protocol negotiation: the handler loop flips the connection
             # to B2 on the exact accept line (an old server answers
             # E\tbad request here, which clients read as "tab only").
-            # The ONLY accepted 3-field form carries a tenant binding
-            # (``tn=<t>``) the handler loop already captured; the reply
-            # stays the frozen 2-field accept so old and new clients
-            # parse it alike.  Any other 3-field HELLO stays the generic
-            # E\tbad request, byte-identical to the native server.
+            # Accepted extensions — a tenant binding (``tn=<t>``) and/or
+            # per-record tracing (``tr=1``) — were already captured by
+            # the handler loop; the reply stays the frozen 2-field accept
+            # so old and new clients parse it alike.  A HELLO with any
+            # other extra field stays the generic E\tbad request,
+            # byte-identical to the native server.
             if parts[1] == "B2":
                 return proto.HELLO_REPLY
             return f"E\tunsupported proto: {parts[1]}"

@@ -172,8 +172,10 @@ class ShardedQueryClient:
 
         # capture the submitting request's trace context: pool threads
         # don't inherit thread-locals, and a traced fan-out must stamp
-        # every shard leg with the same tid (obs/tracing.py)
-        tid = obs_tracing.current_trace()
+        # every shard leg with the same tid (obs/tracing.py); the
+        # ``tid/sid`` composite parents each leg under the caller's
+        # open span
+        tid = obs_tracing.current_context()
         futures = {
             w: self._pool.submit(
                 obs_tracing.call_with_trace, tid,
@@ -224,23 +226,23 @@ class ShardedQueryClient:
         vecs = [payloads[i] for i in known]
         from concurrent.futures import wait as _futures_wait
 
-        tid = obs_tracing.current_trace()
-        if tid is not None:
-            obs_tracing.event(
-                "fanout", tid=tid, op="topk_many",
-                shards=self.num_workers, queries=len(known), k=k)
-        futs = [
-            self._pool.submit(
-                obs_tracing.call_with_trace, tid,
-                c.topk_by_vector_pipelined, name, vecs, k)
-            for c in self._clients
-        ]
-        _futures_wait(futs)  # join all before any result() can raise
-        try:
-            per_worker = [f.result() for f in futs]
-        except (ConnectionError, OSError, TimeoutError):
-            self._count_error("TOPKV")
-            raise
+        with obs_tracing.span("fanout", op="topk_many",
+                              shards=self.num_workers,
+                              queries=len(known), k=k):
+            # capture inside the span so each shard leg parents under it
+            ctx = obs_tracing.current_context()
+            futs = [
+                self._pool.submit(
+                    obs_tracing.call_with_trace, ctx,
+                    c.topk_by_vector_pipelined, name, vecs, k)
+                for c in self._clients
+            ]
+            _futures_wait(futs)  # join all before any result() can raise
+            try:
+                per_worker = [f.result() for f in futs]
+            except (ConnectionError, OSError, TimeoutError):
+                self._count_error("TOPKV")
+                raise
         for j, i in enumerate(known):
             merged: List[Tuple[str, float]] = []
             for worker_results in per_worker:

@@ -84,6 +84,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core import formats as F
 from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
 from ..online.sgd import SGDStep
 from .consumer import ALS_STATE
 from .journal import Journal, OffsetTruncatedError
@@ -376,9 +377,12 @@ class _VisibilityProbe(threading.Thread):
         self.shed = 0
         self.last_visibility_s: Optional[float] = None
 
-    def enqueue(self, key: str, payload: str) -> None:
+    def enqueue(self, key: str, payload: str,
+                tid: Optional[str] = None,
+                psid: Optional[str] = None) -> None:
         try:
-            self._q.put_nowait((time.monotonic(), key, payload))
+            self._q.put_nowait(
+                (time.monotonic(), key, payload, tid, psid, time.time()))
         except queue.Full:
             self.shed += 1
 
@@ -388,7 +392,8 @@ class _VisibilityProbe(threading.Thread):
     def run(self) -> None:
         while not self._stop.is_set():
             try:
-                t0, key, expected = self._q.get(timeout=0.2)
+                t0, key, expected, tid, psid, t0_wall = self._q.get(
+                    timeout=0.2)
             except queue.Empty:
                 continue
             deadline = t0 + self._timeout_s
@@ -410,6 +415,12 @@ class _VisibilityProbe(threading.Thread):
                 self.last_visibility_s = dt
                 self._hist.observe(dt)
                 self.observed += 1
+                if tid:
+                    # closes the apply -> publish -> visible chain: same
+                    # tid, parented under the batch's apply span
+                    obs_tracing.span_event(
+                        "update_visible", tid=tid, psid=psid, t0=t0_wall,
+                        dur_s=round(dt, 9), key=key)
             elif time.monotonic() >= deadline:
                 self.timeouts += 1
 
@@ -770,6 +781,12 @@ class UpdateWorker:
 
     def _apply_batch(self, part: _Part, batch, seq_from: int,
                      in_off_after: int) -> None:
+        # sampled trace root: apply -> publish -> visible is the update
+        # plane's critical chain, and TPUMS_TRACE_SAMPLE decides which
+        # batches leave spans behind
+        tid = obs_tracing.sample_trace()
+        apply_sid = obs_tracing.new_span_id() if tid else None
+        t_apply0 = time.time()
         step = self._ensure_step()
         self._last_reads = {}
         self._recording = True
@@ -785,7 +802,19 @@ class UpdateWorker:
             [f"{seq_from}\t{seq_to}\t{in_off_after}\t" + "|".join(rows)],
             flush=False,
         )
+        if tid:
+            obs_tracing.span_event(
+                "update_apply", tid=tid, sid=apply_sid, psid=None,
+                t0=t_apply0, dur_s=round(time.time() - t_apply0, 9),
+                worker=self.worker_index, updates=len(batch),
+                rows=len(rows))
+            t_pub0 = time.time()
         self._publish(rows)
+        if tid:
+            obs_tracing.span_event(
+                "update_publish", tid=tid, psid=apply_sid, t0=t_pub0,
+                dur_s=round(time.time() - t_pub0, 9),
+                worker=self.worker_index, rows=len(rows))
         probe_key = probe_payload = None
         for row in rows:
             try:
@@ -806,7 +835,8 @@ class UpdateWorker:
         self.stats["applied"] += len(batch)
         self.stats["batches"] += 1
         if self._probe is not None and probe_key is not None:
-            self._probe.enqueue(probe_key, probe_payload)
+            self._probe.enqueue(probe_key, probe_payload,
+                                tid=tid, psid=apply_sid)
 
     def _drain_part(self, part: _Part) -> bool:
         before = part.in_off

@@ -48,6 +48,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -91,6 +92,7 @@ struct PendingReply {
   std::atomic<bool> ready{false};
   std::string text;
   size_t req_bytes = 0;  // queued request payload accounted to the conn
+  std::string tid;  // tab-mode echo: raw wire tid appended before the \n
 };
 
 // Cap on unconsumed reply slots per connection — a client flooding TOPKs
@@ -137,6 +139,8 @@ struct Conn {
   bool writable_armed = false;
   bool eof = false;  // client half-closed: answer what's buffered, then close
   bool binary = false;  // negotiated B2: c->in holds frames, not lines
+  bool b2_trace = false;  // HELLO tr=1: every request record carries one
+                          // extra trailing trace field (possibly empty)
   bool fatal = false;   // corrupt frame: error frame queued, close after flush
 };
 
@@ -176,6 +180,8 @@ struct TopkTask {
   std::shared_ptr<PendingReply> reply;
   std::string verb, state, query_arg, k_s;
   double t0 = 0.0;  // submit time: worker observes latency incl. queue wait
+  std::string tid;     // raw wire tid when the request was traced
+  double t0_wall = 0.0;  // wall-clock twin of t0, for span records
 };
 
 // Per-verb serving stats on the shared log-bucket ladder (obs/metrics.py
@@ -230,12 +236,131 @@ struct ServerState {
   std::map<std::string, VerbStat> verb_stats;  // ordered => stable JSON
   std::mutex health_mu;
   std::string health_json;  // last report pushed via tpums_server_set_health
+  // Tail-forensics span spill (obs/tracing.py JSONL schema): path set via
+  // tpums_server_set_trace; every TRACED request (trailing tab ``tid=``
+  // field, or the B2 ``tr=1`` per-record trace field) appends ONE
+  // server_reply span record.  Untraced requests never touch this.
+  std::mutex trace_mu;
+  std::string trace_path;  // empty = span spill off
+  long long trace_max_bytes = 64ll << 20;
+  int trace_keep = 3;
+  long long trace_file_bytes = -1;  // -1 = stat on next append
+  std::atomic<uint64_t> span_seq{0};
 };
 
 double now_s() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+// Wall clock for span records: forensics correlates spans ACROSS processes
+// by timestamp, so span t0/ts must be system_clock (now_s() is steady_clock
+// and only comparable within this process).
+double wall_s() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// Minimal JSON string escape — tids/verbs come off the wire.
+void json_escape_into(std::string& out, const std::string& v) {
+  for (unsigned char ch : v) {
+    if (ch == '"' || ch == '\\') {
+      out.push_back('\\');
+      out.push_back(static_cast<char>(ch));
+    } else if (ch < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\u%04x", ch);
+      out += buf;
+    } else {
+      out.push_back(static_cast<char>(ch));
+    }
+  }
+}
+
+// Size-capped keep-K rotation, mirroring obs/tracing._rotate_locked:
+// path -> path.1 -> ... -> path.K, oldest dropped.  Caller holds trace_mu.
+void trace_rotate_locked(ServerState* s) {
+  const std::string& p = s->trace_path;
+  if (s->trace_keep == 0) {
+    ::remove(p.c_str());
+  } else {
+    for (int i = s->trace_keep - 1; i >= 1; --i) {
+      std::string src = p + "." + std::to_string(i);
+      ::rename(src.c_str(), (p + "." + std::to_string(i + 1)).c_str());
+    }
+    ::rename(p.c_str(), (p + ".1").c_str());
+  }
+  s->trace_file_bytes = 0;
+}
+
+// Append one server_reply span record for a traced request.  The raw wire
+// tid may be the ``tid/sid`` composite (obs/tracing.wire_tid): the part
+// after the slash is the CLIENT's rpc span id, recorded here as psid so
+// forensics parents this server span under the caller's tree.
+void trace_spill(ServerState* s, const std::string& raw_tid,
+                 const std::string& verb, double t0_wall, double dur_s,
+                 double queue_s, double serve_s, bool is_err) {
+  std::lock_guard<std::mutex> g(s->trace_mu);
+  if (s->trace_path.empty()) return;
+  std::string tid = raw_tid, psid;
+  size_t slash = raw_tid.find('/');
+  if (slash != std::string::npos) {
+    tid = raw_tid.substr(0, slash);
+    psid = raw_tid.substr(slash + 1);
+  }
+  // sid: port-salted sequence — unique across the servers a fanned-out
+  // trace touches, which is all tree assembly needs
+  char sid[24];
+  snprintf(sid, sizeof sid, "%04x%06llx",
+           static_cast<unsigned>(s->port & 0xffff),
+           static_cast<unsigned long long>(
+               (s->span_seq.fetch_add(1, std::memory_order_relaxed) + 1) &
+               0xffffff));
+  char num[48];
+  std::string line = "{\"ts\":";
+  snprintf(num, sizeof num, "%.6f", wall_s());
+  line += num;
+  line += ",\"tid\":\"";
+  json_escape_into(line, tid);
+  line += "\",\"kind\":\"server_reply\",\"plane\":\"native\",\"sid\":\"";
+  line += sid;
+  line += "\"";
+  if (!psid.empty()) {
+    line += ",\"psid\":\"";
+    json_escape_into(line, psid);
+    line += "\"";
+  }
+  snprintf(num, sizeof num, ",\"t0\":%.6f", t0_wall);
+  line += num;
+  snprintf(num, sizeof num, ",\"dur_s\":%.9f", dur_s);
+  line += num;
+  line += ",\"verb\":\"";
+  json_escape_into(line, verb);
+  line += "\",\"job_id\":\"";
+  json_escape_into(line, s->job_id);
+  line += "\",\"port\":" + std::to_string(s->port);
+  snprintf(num, sizeof num, ",\"lat_s\":%.6f", dur_s);
+  line += num;
+  snprintf(num, sizeof num, ",\"queue_wait_s\":%.9f", queue_s);
+  line += num;
+  snprintf(num, sizeof num, ",\"serve_s\":%.9f", serve_s);
+  line += num;
+  line += is_err ? ",\"ok\":false}\n" : ",\"ok\":true}\n";
+  if (s->trace_file_bytes < 0) {
+    struct stat st;
+    s->trace_file_bytes =
+        (stat(s->trace_path.c_str(), &st) == 0) ? st.st_size : 0;
+  }
+  if (s->trace_file_bytes >= s->trace_max_bytes && s->trace_max_bytes > 0) {
+    trace_rotate_locked(s);
+  }
+  FILE* f = fopen(s->trace_path.c_str(), "a");
+  if (!f) return;
+  fwrite(line.data(), 1, line.size(), f);
+  fclose(f);
+  s->trace_file_bytes += static_cast<long long>(line.size());
 }
 
 void observe_verb(ServerState* s, const std::string& verb, double dt,
@@ -977,9 +1102,12 @@ std::string handle_line(ServerState* s, const std::string* parts, int n) {
   if (parts[0] == "PING") {  // Python matches on parts[0] alone
     return "PONG\t" + s->job_id + "\t" + s->state_name + "\n";
   }
-  if (parts[0] == "HELLO" && n == 2) {
+  if (parts[0] == "HELLO" &&
+      (n == 2 || (n == 3 && parts[2] == "tr=1"))) {
     // protocol negotiation (serve/proto.py HELLO_LINE): the caller flips
-    // the connection to binary iff this answers the accept line
+    // the connection to binary iff this answers the accept line.  The
+    // tr=1 extension (proto.TRACE_EXT) negotiates per-record trace
+    // fields; route_parts latches it on the Conn when the flip happens.
     if (parts[1] == "B2") return "HELLO\tB2\n";
     return "E\tunsupported proto: " + parts[1] + "\n";
   }
@@ -1271,6 +1399,7 @@ void topk_worker_loop(ServerState* s) {
     }
     if (task.reply.use_count() > 1) {  // conn still holds its slot — a
       // closed connection's orphaned tasks skip the O(catalog) work
+      double t_pop = now_s();
       task.reply->text =
           task.verb == "DOT"
               ? handle_dot(s, task.state, task.k_s, task.query_arg)
@@ -1280,8 +1409,17 @@ void topk_worker_loop(ServerState* s) {
       // Python plane's deferred-reply observation at resolve time; an
       // orphaned task is never observed — its Python twin (handler thread
       // gone mid-request) never reaches _finish either
-      observe_verb(s, task.verb, now_s() - task.t0,
-                   !task.reply->text.empty() && task.reply->text[0] == 'E');
+      double t_done = now_s();
+      bool is_err =
+          !task.reply->text.empty() && task.reply->text[0] == 'E';
+      observe_verb(s, task.verb, t_done - task.t0, is_err);
+      if (!task.tid.empty()) {
+        // queue wait vs device/serve split is exactly what the slow-vs-
+        // fast diff attributes, so spill both
+        trace_spill(s, task.tid, task.verb, task.t0_wall,
+                    t_done - task.t0, t_pop - task.t0, t_done - t_pop,
+                    is_err);
+      }
     }
     task.reply->ready.store(true, std::memory_order_release);
     ssize_t wr = write(s->wake_fd, &one, 8);
@@ -1304,7 +1442,17 @@ void drain_ready_replies(Conn* c) {
     }
     if (!all_ready) break;
     if (!u.frame) {
-      c->out += c->pending.front()->text;
+      const PendingReply& pr = *c->pending.front();
+      if (!pr.tid.empty() && !pr.text.empty() && pr.text.back() == '\n') {
+        // deferred tab reply: append the raw tid echo before the newline
+        // (inline replies get theirs inserted at route time)
+        c->out.append(pr.text, 0, pr.text.size() - 1);
+        c->out += "\ttid=";
+        c->out += pr.tid;
+        c->out.push_back('\n');
+      } else {
+        c->out += pr.text;
+      }
     } else {
       std::string body;
       append_varint(body, u.count);
@@ -1335,7 +1483,8 @@ void drain_ready_replies(Conn* c) {
 // frame unit can group them.  Returns false when the connection must
 // close (pending-flood protection).
 bool route_parts(ServerState* s, Conn* c, std::string* parts, int n,
-                 size_t src_bytes, bool always_slot) {
+                 size_t src_bytes, bool always_slot,
+                 const std::string& tid) {
   if ((parts[0] == "TOPK" || parts[0] == "TOPKV" || parts[0] == "DOT") &&
       n == 4) {
     s->requests.fetch_add(1, std::memory_order_relaxed);
@@ -1348,6 +1497,9 @@ bool route_parts(ServerState* s, Conn* c, std::string* parts, int n,
     }
     auto reply = std::make_shared<PendingReply>();
     reply->req_bytes = src_bytes;
+    // tab replies echo the raw tid back (drain_ready_replies appends it);
+    // B2 replies never carry the tid — the client pairs them by order
+    if (!always_slot) reply->tid = tid;
     c->pending_req_bytes += src_bytes;
     c->pending.push_back(reply);
     if (!always_slot) c->units.push_back(OutUnit{false, 1});
@@ -1355,7 +1507,8 @@ bool route_parts(ServerState* s, Conn* c, std::string* parts, int n,
     // DOT operands: state, range, payload (range rides the k_s slot)
     TopkTask task{std::move(reply), parts[0], parts[1],
                   parts[0] == "TOPK" ? parts[2] : parts[3],
-                  parts[0] == "TOPK" ? parts[3] : parts[2], now_s()};
+                  parts[0] == "TOPK" ? parts[3] : parts[2], now_s(),
+                  tid, tid.empty() ? 0.0 : wall_s()};
     {
       std::lock_guard<std::mutex> lk(s->task_mu);
       s->tasks.push_back(std::move(task));
@@ -1364,13 +1517,25 @@ bool route_parts(ServerState* s, Conn* c, std::string* parts, int n,
     return true;
   }
   double t0 = now_s();
+  double t0_wall = tid.empty() ? 0.0 : wall_s();
   std::string text = handle_line(s, parts, n);
-  observe_verb(s, parts[0], now_s() - t0,
-               !text.empty() && text[0] == 'E');
-  if (parts[0] == "HELLO" && !c->binary && text[0] == 'H') {
+  double dt = now_s() - t0;
+  bool is_err = !text.empty() && text[0] == 'E';
+  observe_verb(s, parts[0], dt, is_err);
+  if (!tid.empty()) {
+    trace_spill(s, tid, parts[0], t0_wall, dt, 0.0, dt, is_err);
+  }
+  if (parts[0] == "HELLO" && !c->binary && text[0] == 'H' && tid.empty()) {
     // negotiation accepted: every byte after this line is a B2 frame and
-    // every reply after this line's is a B2 frame
+    // every reply after this line's is a B2 frame.  A HELLO that carried
+    // a tid= stamp stays in tab mode (Python-plane parity: parse_hello
+    // rejects the tid extension, so the reply is echoed but the framing
+    // never flips).
     c->binary = true;
+    if (n == 3) c->b2_trace = true;  // handle_line only accepts tr=1 at n==3
+  }
+  if (!always_slot && !tid.empty() && !text.empty() && text.back() == '\n') {
+    text.insert(text.size() - 1, "\ttid=" + tid);
   }
   if (!always_slot && c->pending.empty()) {
     c->out += text;
@@ -1400,8 +1565,18 @@ bool submit_line(ServerState* s, Conn* c, const std::string& line) {
   // distinguishable from an exact TOPK (Python splits unbounded; parity
   // demands "TOPK\ta\tb\tc\td" be a bad request, not a TOPK)
   std::string parts[5];
+  // trailing ``\ttid=<raw>`` trace stamp (obs/tracing.pop_tid parity:
+  // strip it BEFORE the split so a stamped TOPK still parses as n==4);
+  // the value never contains a tab, so "last field" == "no tab after"
+  size_t tp = line.rfind("\ttid=");
+  if (tp != std::string::npos && tp > 0 && tp + 5 < line.size() &&
+      line.find('\t', tp + 1) == std::string::npos) {
+    std::string tid = line.substr(tp + 5);
+    int n = split_tabs(line.substr(0, tp), parts, 5);
+    return route_parts(s, c, parts, n, line.size(), false, tid);
+  }
   int n = split_tabs(line, parts, 5);
-  return route_parts(s, c, parts, n, line.size(), false);
+  return route_parts(s, c, parts, n, line.size(), false, std::string());
 }
 
 // Queue the structural-corruption reply (one-record error frame, matching
@@ -1446,7 +1621,12 @@ int parse_one_frame(ServerState* s, Conn* c) {
   // parses or is rejected whole (serve/proto.decode_request_frame parity)
   std::vector<std::vector<std::string>> records;
   std::vector<size_t> rec_bytes;
+  std::vector<std::string> rec_tids;
   records.reserve(count);
+  // tr=1 connections carry ONE extra trailing length-prefixed field per
+  // record — the raw trace id, empty for untraced requests (the Python
+  // encoder's record_to_parts/record_from_line twin)
+  const int extra = c->b2_trace ? 1 : 0;
   for (uint64_t r = 0; r < count; ++r) {
     size_t rec_start = pos;
     if (pos >= end) return fatal_frame(c, "bad body");
@@ -1454,9 +1634,9 @@ int parse_one_frame(ServerState* s, Conn* c) {
     if (op < 1 || op > kMaxOpcode) return fatal_frame(c, "bad body");
     const VerbSpec& spec = kVerbByOp[op];
     std::vector<std::string> parts;
-    parts.reserve(spec.fields + 1);
+    parts.reserve(spec.fields + 1 + extra);
     parts.emplace_back(spec.verb);
-    for (int f = 0; f < spec.fields; ++f) {
+    for (int f = 0; f < spec.fields + extra; ++f) {
       uint64_t flen = 0;
       vr = parse_varint(in.data(), end, &pos, &flen);
       if (vr != 0 || pos + flen > end) return fatal_frame(c, "bad body");
@@ -1465,6 +1645,12 @@ int parse_one_frame(ServerState* s, Conn* c) {
       parts.emplace_back(in.data() + pos, flen);
       pos += flen;
     }
+    std::string rtid;
+    if (extra) {
+      rtid = std::move(parts.back());
+      parts.pop_back();
+    }
+    rec_tids.push_back(std::move(rtid));
     rec_bytes.push_back(pos - rec_start);
     records.push_back(std::move(parts));
   }
@@ -1473,7 +1659,9 @@ int parse_one_frame(ServerState* s, Conn* c) {
     std::string parts[5];
     int n = static_cast<int>(records[r].size());
     for (int i = 0; i < n; ++i) parts[i] = std::move(records[r][i]);
-    if (!route_parts(s, c, parts, n, rec_bytes[r], true)) return -2;
+    if (!route_parts(s, c, parts, n, rec_bytes[r], true, rec_tids[r])) {
+      return -2;
+    }
   }
   c->units.push_back(
       OutUnit{true, static_cast<uint32_t>(records.size())});
@@ -1770,6 +1958,17 @@ void tpums_server_set_health(void* srv, const char* health_json) {
   auto* s = static_cast<ServerState*>(srv);
   std::lock_guard<std::mutex> g(s->health_mu);
   s->health_json = health_json ? health_json : "";
+}
+
+void tpums_server_set_trace(void* srv, const char* path,
+                            long long max_bytes, int keep) {
+  if (!srv) return;
+  auto* s = static_cast<ServerState*>(srv);
+  std::lock_guard<std::mutex> g(s->trace_mu);
+  s->trace_path = path ? path : "";
+  if (max_bytes > 0) s->trace_max_bytes = max_bytes;
+  if (keep >= 0) s->trace_keep = keep;
+  s->trace_file_bytes = -1;  // re-stat: the path may have changed
 }
 
 int tpums_server_port(void* srv) {

@@ -75,6 +75,14 @@ void* tpums_server_start3(void* store, const char* state_name,
 // in the live key count and metrics_uri.  NULL or "" reverts to the
 // synthesized always-ready report.
 void tpums_server_set_health(void* srv, const char* health_json);
+// Enable the tail-forensics span spill: traced requests (tab ``tid=``
+// stamp or the B2 ``tr=1`` per-record trace field) append one JSONL
+// server_reply span record to `path` (obs/tracing.py event schema), with
+// size-capped keep-K rotation (path -> path.1 -> ... -> path.K).
+// max_bytes <= 0 keeps the 64 MiB default; keep < 0 keeps the default 3;
+// NULL or "" path turns the spill off.
+void tpums_server_set_trace(void* srv, const char* path,
+                            long long max_bytes, int keep);
 int tpums_server_port(void* srv);
 uint64_t tpums_server_requests(void* srv);
 // Stops the loop, closes all connections, joins the thread, frees the handle.

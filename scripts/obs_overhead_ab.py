@@ -9,6 +9,12 @@ The acceptance bar (README "Observability"): p50 overhead <= 3%.
 Percentiles route through the shared bucket ladder
 (``obs.metrics.bucketed_quantiles``), which works in BOTH arms — the
 off-arm only disables collection, not offline math.
+
+Round 14 adds the tail-forensics arms: spans (head-sampled at
+``TRACE_SAMPLE``, default 1%) plus exemplar-linked histograms on vs both
+off, same ABAB discipline, on the GET hot path.  That arm's dispatch-level
+p50 overhead is ENFORCED <= 3% (exit 1 past the bar) — the in-process
+measurement is reproducible where the socket ratio rides machine noise.
 """
 
 import json
@@ -28,6 +34,8 @@ K = 16
 TOPK = 10
 N_Q = int(os.environ.get("N_Q", 400))
 ROUNDS = int(os.environ.get("ROUNDS", 4))
+TRACE_SAMPLE = float(os.environ.get("TRACE_SAMPLE", 0.01))
+TRACE_BAR_PCT = float(os.environ.get("TRACE_BAR_PCT", 3.0))
 
 
 def main() -> int:
@@ -116,7 +124,66 @@ def main() -> int:
             "delta_us": round(d_on - d_off, 2),
             "overhead_pct": round(100.0 * (d_on / d_off - 1.0), 2),
         }
+        # --- tail-forensics arms: spans (head-sampled) + exemplars -------
+        # Both arms keep metrics ON (the baseline the 3% bar is against is
+        # the already-instrumented GET path); the "trace" arm additionally
+        # samples trace roots at TRACE_SAMPLE and retains exemplars.
+        from flink_ms_tpu.obs import tracing as Tr
+        from flink_ms_tpu.obs.metrics import set_exemplars
+
+        get_line = f"GET\t{ALS_STATE}\t7-U"
+        for _ in range(300):
+            srv._dispatch(get_line)
+
+        # one sampling roll + (when sampled) one span per WINDOW requests,
+        # exactly the serve/client.py pipeline() shape — the roll is inside
+        # the timed region, amortized the way the real hot path amortizes it
+        WINDOW = 32
+
+        def window_us():
+            t0 = time.perf_counter()
+            tid = Tr.sample_trace()
+            if tid is not None:
+                with Tr.trace_span(tid):
+                    stamped = Tr.stamp(get_line)
+                    for _ in range(WINDOW):
+                        srv._dispatch(stamped)
+            else:
+                for _ in range(WINDOW):
+                    srv._dispatch(get_line)
+            return (time.perf_counter() - t0) / WINDOW * 1e6
+
+        tdisp = {"trace": [], "plain": []}
+        for r in range(10):
+            order = ("trace", "plain") if r % 2 == 0 else ("plain", "trace")
+            for arm in order:
+                on = arm == "trace"
+                os.environ["TPUMS_TRACE_SAMPLE"] = \
+                    str(TRACE_SAMPLE) if on else "0"
+                set_exemplars(on)
+                xs = [window_us() for _ in range(200)]
+                tdisp[arm].append(float(np.percentile(xs, 50)))
+        os.environ["TPUMS_TRACE_SAMPLE"] = "0"
+        set_exemplars(False)
+        # min-of-round-p50s, symmetric across arms: each arm's best round
+        # is its contention-free cost, which is what the overhead bar is
+        # about — medians ride scheduler/thermal noise that swamps a
+        # sub-0.1us per-request delta
+        t_on = float(np.min(tdisp["trace"]))
+        t_off = float(np.min(tdisp["plain"]))
+        trace_pct = 100.0 * (t_on / t_off - 1.0)
+        out["trace"] = {
+            "sample": TRACE_SAMPLE,
+            "p50_on_us": round(t_on, 2), "p50_off_us": round(t_off, 2),
+            "delta_us": round(t_on - t_off, 2),
+            "overhead_pct": round(trace_pct, 2),
+            "bar_pct": TRACE_BAR_PCT,
+        }
         print(json.dumps(out, indent=1))
+        if trace_pct > TRACE_BAR_PCT:
+            print(f"FAIL: spans+exemplars GET p50 overhead "
+                  f"{trace_pct:.2f}% > {TRACE_BAR_PCT}%", file=sys.stderr)
+            return 1
         return 0
     finally:
         job.stop()

@@ -1,0 +1,355 @@
+"""Tail-latency forensics (obs/forensics.py) and its feeders: span-tree
+assembly with missing-parent tolerance, self-time critical paths,
+slow-vs-fast diffing that names the injected stage, spill collection
+across rotated files, exemplar-linked histograms, the watch plane's
+incident enrichment, the update plane's apply->publish->visible chain,
+and the new fleet_signals keys."""
+
+import json
+import os
+import time
+
+import pytest
+
+from flink_ms_tpu.obs import forensics as FX
+from flink_ms_tpu.obs import metrics as M
+from flink_ms_tpu.obs import tracing as T
+from flink_ms_tpu.obs.rules import Rule
+from flink_ms_tpu.obs.scrape import fleet_signals
+
+
+def _span(tid, sid, kind, t0, dur, psid=None, **fields):
+    ev = {"ts": t0 + dur, "tid": tid, "kind": kind, "sid": sid,
+          "t0": t0, "dur_s": dur}
+    if psid:
+        ev["psid"] = psid
+    ev.update(fields)
+    return ev
+
+
+# ---------------------------------------------------------------------------
+# tree assembly + critical path
+# ---------------------------------------------------------------------------
+
+def test_assemble_links_children_and_promotes_orphans():
+    evs = [
+        _span("t1", "r0", "client_pipeline", 0.0, 0.010),
+        _span("t1", "c1", "client_rpc", 0.001, 0.008, psid="r0"),
+        # parent "gone" never spilled: subtree must become a root, not drop
+        _span("t1", "o1", "server_reply", 0.002, 0.003, psid="gone",
+              verb="GET"),
+        # annotation (no sid, no dur) rides along without becoming a span
+        {"ts": 0.004, "tid": "t1", "kind": "ha_failover"},
+        # second trace stays separate
+        _span("t2", "r0", "client_rpc", 0.0, 0.002),
+    ]
+    trees = FX.assemble(evs)
+    assert set(trees) == {"t1", "t2"}
+    t1 = trees["t1"]
+    assert sorted(t1.roots) == ["o1", "r0"]
+    assert t1.children["r0"] == ["c1"]
+    assert [a["kind"] for a in t1.annotations] == ["ha_failover"]
+    # duplicate sid keeps the longer duration (retried spill write)
+    dup = FX.assemble([_span("t3", "s", "x", 0.0, 0.001),
+                       _span("t3", "s", "x", 0.0, 0.005)])
+    assert dup["t3"].spans["s"]["dur_s"] == 0.005
+
+
+def test_total_is_wall_extent_not_sum_of_durations():
+    # two overlapping fan-out legs under one root: wall = 10ms, sum = 19ms
+    evs = [
+        _span("t", "r", "fanout", 0.0, 0.010),
+        _span("t", "a", "client_rpc", 0.001, 0.009, psid="r"),
+        _span("t", "b", "client_rpc", 0.001, 0.009, psid="r"),
+    ]
+    tree = FX.assemble(evs)["t"]
+    assert tree.total_s() == pytest.approx(0.010)
+
+
+def test_self_time_subtracts_children_and_clips():
+    evs = [
+        _span("t", "p", "server_reply", 0.0, 0.010, verb="TOPKV"),
+        _span("t", "c", "mb_device", 0.001, 0.009, psid="p"),
+        # child longer than parent (clock skew): parent self clips to 0
+        _span("t", "q", "server_reply", 0.0, 0.002, verb="GET"),
+        _span("t", "d", "mb_device", 0.0, 0.004, psid="q"),
+    ]
+    tree = FX.assemble(evs)["t"]
+    st = tree.self_times()
+    assert st["server_reply:TOPKV"] == pytest.approx(0.001)
+    assert st["server_reply:GET"] == 0.0
+    assert st["mb_device"] == pytest.approx(0.013)
+    ranked = FX.critical_path(tree)
+    assert ranked[0]["stage"] == "mb_device"
+    assert ranked[0]["share"] > 0.5
+    # render shows nesting depth by indentation
+    out = tree.render()
+    assert "server_reply:TOPKV" in out and "  mb_device" in out
+
+
+# ---------------------------------------------------------------------------
+# slow-vs-fast diff
+# ---------------------------------------------------------------------------
+
+def _synthetic_trees(n=20, slow_every=10, slow_extra=0.020):
+    """n traces of ~2ms GETs; every ``slow_every``-th carries an extra
+    ``injected_slow`` child span of ``slow_extra`` seconds."""
+    evs = []
+    for i in range(n):
+        tid = f"t{i:03d}"
+        slow = (i % slow_every) == 0
+        dur = 0.002 + (slow_extra if slow else 0.0) + i * 1e-6
+        evs.append(_span(tid, "r", "client_rpc", 0.0, dur))
+        evs.append(_span(tid, "s", "server_reply", 0.0005, 0.001,
+                         psid="r", verb="GET"))
+        if slow:
+            evs.append(_span(tid, "x", "injected_slow", 0.0015,
+                             slow_extra, psid="r"))
+    return FX.assemble(evs)
+
+
+def test_diff_ranks_injected_stage_first():
+    trees = _synthetic_trees()
+    d = FX.diff_slow_fast(trees, slow_q=0.9)
+    assert d["slow_n"] >= 1 and d["fast_n"] >= 1
+    assert d["stages"][0]["stage"] == "injected_slow"
+    assert d["stages"][0]["delta_s"] == pytest.approx(0.020, rel=0.05)
+    # the injected stage owns essentially the whole slow-fast gap
+    assert d["stages"][0]["delta_share"] > 0.9
+    # slow_tids lead with the slowest trace, and every one is an injected one
+    assert all(int(t[1:]) % 10 == 0 for t in d["slow_tids"])
+    assert d["quantiles"]["p99"] > d["quantiles"]["p50"]
+
+
+def test_diff_degrades_gracefully_below_four_traces():
+    trees = _synthetic_trees(n=3, slow_every=2)
+    d = FX.diff_slow_fast(trees)
+    assert d["n_traces"] == 3 and d["stages"] == [] and d["slow_tids"] == []
+
+
+def test_report_and_render_name_the_stage(tmp_path):
+    spill = tmp_path / "spill.jsonl"
+    with open(spill, "w") as f:
+        for tree in _synthetic_trees().values():
+            for ev in tree.spans.values():
+                f.write(json.dumps(ev) + "\n")
+    rep = FX.report([str(spill)])
+    assert rep["diff"]["stages"][0]["stage"] == "injected_slow"
+    human = FX.render_human(rep)
+    assert "#1 injected_slow" in human and "% of the gap" in human
+    # CLI --json path round-trips the same report
+    rc = FX.main([str(spill), "--json"])
+    assert rc == 0
+    # --tree renders a specific trace
+    assert FX.main([str(spill), "--tree", rep["diff"]["slow_tids"][0]]) == 0
+    assert FX.main([str(spill), "--tree", "nonexistent"]) == 1
+
+
+def test_expand_paths_picks_up_rotated_siblings(tmp_path):
+    p = tmp_path / "s.jsonl"
+    for name in ["s.jsonl", "s.jsonl.1", "s.jsonl.2"]:
+        (tmp_path / name).write_text("")
+    got = FX.expand_paths([str(p)])
+    assert got == [str(p), str(p) + ".1", str(p) + ".2"]
+    # glob form finds the same set; duplicates collapse
+    got2 = FX.expand_paths([str(tmp_path / "s.jsonl*"), str(p)])
+    assert sorted(got2) == sorted(got)
+
+
+def test_collect_merges_rotated_files_and_sets_staleness_gauges(tmp_path):
+    p = tmp_path / "s.jsonl"
+    (tmp_path / "s.jsonl.1").write_text(
+        json.dumps(_span("old", "a", "client_rpc", 0.0, 0.001)) + "\n")
+    p.write_text(
+        json.dumps(_span("new", "b", "client_rpc", 10.0, 0.001)) + "\n"
+        + "not json\n")
+    evs = FX.collect([str(p)])
+    assert [e["tid"] for e in evs] == ["old", "new"]  # ts-ordered
+    snap = M.get_registry().snapshot()
+    by = {g["name"]: g["value"] for g in snap["gauges"]}
+    assert by["tpums_forensics_events"] == 2.0
+    assert time.time() - by["tpums_forensics_last_collect_ts"] < 60.0
+
+
+# ---------------------------------------------------------------------------
+# exemplar-linked histograms
+# ---------------------------------------------------------------------------
+
+def test_histogram_retains_exemplars_only_with_gate_and_trace():
+    reg = M.MetricsRegistry()
+    h = reg.histogram("lat_s", bounds=[0.001, 0.01, 0.1])
+    prev = M.set_exemplars(True)
+    try:
+        h.observe(0.0005)  # no trace in hand -> no exemplar
+        h.observe(0.05, tid="aaaa000000000001")
+        h.observe(0.0005, tid="aaaa000000000002")
+        ex = h.exemplars()
+        # bucket index 2 holds the 0.05 observation for the traced request
+        assert ex[2][0] == "aaaa000000000001"
+        assert ex[2][1] == pytest.approx(0.05)
+        assert ex[0][0] == "aaaa000000000002"
+        snap = reg.snapshot()
+        hist = [e for e in snap["histograms"] if e["name"] == "lat_s"][0]
+        assert hist["exemplars"]["2"][0] == "aaaa000000000001"
+        # merge keeps the freshest exemplar per bucket
+        other = dict(hist, exemplars={
+            "2": ["bbbb000000000001", 0.09, time.time() + 100]})
+        merged = M.merge_snapshots(
+            [snap, {"ts": snap["ts"], "counters": [], "gauges": [],
+                    "histograms": [other]}])
+        mh = [e for e in merged["histograms"] if e["name"] == "lat_s"][0]
+        assert mh["exemplars"]["2"][0] == "bbbb000000000001"
+    finally:
+        M.set_exemplars(prev)
+
+
+def test_exemplars_off_by_default_costs_nothing():
+    reg = M.MetricsRegistry()
+    h = reg.histogram("lat2_s", bounds=[0.01])
+    h.observe(0.5, tid="cccc000000000001")  # gate off: tid is ignored
+    assert h.exemplars() == {}
+    assert "exemplars" not in [e for e in reg.snapshot()["histograms"]
+                               if e["name"] == "lat2_s"][0]
+
+
+# ---------------------------------------------------------------------------
+# watch plane: incident enrichment
+# ---------------------------------------------------------------------------
+
+def _fake_scrape(series, tid, bucket=5, value=0.08):
+    return {"fleet": {"histograms": [
+        {"name": series,
+         "exemplars": {str(bucket): [tid, value, time.time()],
+                       "1": ["fast-tid", 0.001, time.time()]}}]}}
+
+
+def test_exemplar_tids_prefers_slowest_bucket_and_dedups():
+    from flink_ms_tpu.obs.watch import _exemplar_tids
+    sc = _fake_scrape("tpums_server_latency_seconds", "slow-tid")
+    assert _exemplar_tids(sc, "tpums_server_latency_seconds") == \
+        ["slow-tid", "fast-tid"]
+    assert _exemplar_tids(sc, "other_series") == []
+
+
+def test_watch_attaches_critical_path_to_quantile_firing(monkeypatch):
+    """An alert_firing transition for a quantile rule gains exemplar tids
+    and per-trace critical paths mined from the in-process ring."""
+    from flink_ms_tpu.obs.watch import FleetWatcher
+    monkeypatch.delenv("TPUMS_TRACE", raising=False)
+    T.clear_events()
+    tid = "feed000000000001"
+    with T.trace_span(tid):
+        with T.span("client_pipeline"):
+            with T.span("injected_slow"):
+                pass
+    rule = Rule(name="p99", kind="threshold", series="lat_s",
+                mode="quantile", q=99.0, op=">", value=0.01)
+    w = FleetWatcher(interval_s=0.1, rules=[rule], publish=False)
+    tr = {"ts": time.time(), "kind": "alert_firing", "rule": "p99",
+          "severity": "warn", "measured": 0.08, "value": 0.01}
+    w._attach_forensics([tr], _fake_scrape("lat_s", tid))
+    assert tr["exemplar_tids"][0] == tid
+    stages = [r["stage"] for r in tr["critical_path"][0]["critical_path"]]
+    assert "injected_slow" in stages
+    # a non-quantile firing is left untouched
+    tr2 = {"ts": time.time(), "kind": "alert_firing", "rule": "nope"}
+    w._attach_forensics([tr2], _fake_scrape("lat_s", tid))
+    assert "exemplar_tids" not in tr2
+
+
+def test_incident_context_tolerates_unknown_tids():
+    T.clear_events()
+    with T.trace_span("cafe000000000001"):
+        with T.span("server_reply", verb="GET"):
+            pass
+    ctx = FX.incident_context(["cafe000000000001", "missing", "", None])
+    assert ctx["exemplar_tids"] == ["cafe000000000001", "missing"]
+    assert len(ctx["critical_path"]) == 1
+    assert ctx["critical_path"][0]["tid"] == "cafe000000000001"
+
+
+# ---------------------------------------------------------------------------
+# spill rotation
+# ---------------------------------------------------------------------------
+
+def test_spill_rotation_keeps_k_files(tmp_path, monkeypatch):
+    spill = tmp_path / "rot.jsonl"
+    monkeypatch.setenv("TPUMS_TRACE", str(spill))
+    monkeypatch.setenv("TPUMS_TRACE_MAX_BYTES", "400")
+    monkeypatch.setenv("TPUMS_TRACE_KEEP", "2")
+    for i in range(60):
+        T.event("rotkind", tid=f"{i:016x}", seq=i)
+    names = sorted(os.listdir(tmp_path))
+    assert "rot.jsonl" in names and "rot.jsonl.1" in names
+    assert "rot.jsonl.2" in names and "rot.jsonl.3" not in names
+    # rotated generations stay parseable and forensics reads them as one
+    total = len(FX.collect([str(spill)]))
+    live = len(T.load_events(str(spill)))
+    assert total > live  # rotated siblings contributed events
+    # re-point the sink so later tests don't append here
+    monkeypatch.setenv("TPUMS_TRACE", "0")
+    T.event("flush")
+
+
+# ---------------------------------------------------------------------------
+# update plane: apply -> publish -> visible chain
+# ---------------------------------------------------------------------------
+
+def test_update_plane_emits_apply_publish_chain(tmp_path, monkeypatch):
+    from flink_ms_tpu.serve import update_plane as up
+    from tests.test_update_plane import TableClient, seed_table
+    monkeypatch.setenv("TPUMS_TRACE_SAMPLE", "1")
+    T.clear_events()
+    table = seed_table()
+    cli = up.UpdatePlaneClient(str(tmp_path), "models", partitions=2)
+    cli.submit_many([(1, 2, 4.5), (3, 4, 2.0)])
+    w = up.UpdateWorker(
+        str(tmp_path), "models", 0, 1, table=table,
+        client_factory=lambda: TableClient(table), partitions=2,
+        batch_size=8, poll_s=0.005, visibility_probe=False).start()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if sum(up.applied_watermarks(str(tmp_path), "models", 2)
+               .values()) >= 2:
+            break
+        time.sleep(0.01)
+    w.stop()
+    applies = T.recent_events(kind="update_apply")
+    publishes = T.recent_events(kind="update_publish")
+    assert applies and publishes
+    # publish parents under apply within the same sampled trace
+    by_tid = {a["tid"]: a for a in applies}
+    linked = [p for p in publishes
+              if p.get("psid") == by_tid.get(p["tid"], {}).get("sid")]
+    assert linked, "no publish span parented under its apply span"
+    tree = FX.assemble(applies + publishes)[linked[0]["tid"]]
+    assert linked[0]["sid"] in tree.children[linked[0]["psid"]]
+    ranked = [r["stage"] for r in FX.critical_path(tree)]
+    assert "update_apply" in ranked and "update_publish" in ranked
+
+
+# ---------------------------------------------------------------------------
+# fleet_signals: new forensic keys
+# ---------------------------------------------------------------------------
+
+def test_fleet_signals_reports_span_rate_exemplars_and_staleness():
+    now = time.time()
+    before = {"ts": now - 10, "counters": [
+        {"name": "tpums_trace_spans_total", "labels": {}, "value": 100.0}],
+        "gauges": [], "histograms": []}
+    after = {"ts": now, "counters": [
+        {"name": "tpums_trace_spans_total", "labels": {}, "value": 150.0}],
+        "gauges": [{"name": "tpums_forensics_last_collect_ts",
+                    "labels": {}, "value": now - 5}],
+        "histograms": [{"name": "lat_s", "counts": [], "bounds": [],
+                        "sum": 0.0, "count": 0,
+                        "exemplars": {"3": ["t1", 0.05, now],
+                                      "5": ["t2", 0.2, now]}}]}
+    sig = fleet_signals(before, after, dt_s=10.0)
+    assert sig["trace_spans_per_s"] == pytest.approx(5.0)
+    assert sig["exemplar_count"] == 2
+    assert sig["forensics_staleness_s"] == pytest.approx(5.0, abs=2.0)
+    # no collect ever -> staleness is None, not a crash
+    after2 = dict(after, gauges=[])
+    assert fleet_signals(before, after2, dt_s=10.0)[
+        "forensics_staleness_s"] is None

@@ -518,6 +518,100 @@ def test_client_b2_native(nsrv):
 
 
 # ---------------------------------------------------------------------------
+# tracing on the wire (round 14): tab tid echo, the B2 tr=1 extension, and
+# the native span spill — untraced traffic stays pinned byte-identical by
+# the v1/parity tests above even with all of this code present
+# ---------------------------------------------------------------------------
+
+_RAW_TID = "00c0ffee00c0ffee/01ab23cd"  # composite tid/sid wire form
+
+
+def _tab_tid_echo(port):
+    """Stamped lines come back with the RAW tid echoed verbatim (composite
+    form included); unstamped lines pipelined on the same connection come
+    back without any suffix."""
+    payload = (f"GET\tALS_MODEL\t7-U\ttid={_RAW_TID}\n"
+               f"TOPKV\tALS_MODEL\t2\t1.0;2.0;0.5;-1.0\ttid={_RAW_TID}\n"
+               f"GET\tALS_MODEL\tmissing\ttid=bare16hexdigits\n"
+               "PING\n").encode("utf-8")
+    want = (f"V\t1.0;2.0;0.5;-1.0\ttid={_RAW_TID}\n"
+            f"V\t12:4.25;11:1.25\ttid={_RAW_TID}\n"
+            f"N\ttid=bare16hexdigits\n"
+            "PONG\tjid\tALS_MODEL\n").encode("utf-8")
+    assert _raw(port, payload) == want
+
+
+def test_tab_tid_echo_python(pysrv):
+    _tab_tid_echo(pysrv.port)
+
+
+@_needs_native
+def test_tab_tid_echo_native(nsrv):
+    _tab_tid_echo(nsrv.port)
+
+
+@_needs_native
+def test_hello_with_tid_stays_tab_identically(pysrv, nsrv):
+    # a traced HELLO is a tab request like any other: echoed, never a
+    # protocol flip (the flip requires a clean negotiation line)
+    payload = b"HELLO\tB2\ttid=abc\nPING\n"
+    assert _raw(pysrv.port, payload) == _raw(nsrv.port, payload)
+    assert b"PONG" in _raw(pysrv.port, payload)  # connection stayed tab
+
+
+def _b2_trace_roundtrip(port):
+    """HELLO tr=1: every request record carries one extra trace field
+    (empty when untraced); replies are never tid-suffixed — the span
+    linkage travels through the server's spill, not the reply bytes."""
+    lines = ["GET\tALS_MODEL\t7-U", "PING",
+             "TOPKV\tALS_MODEL\t2\t1.0;2.0;0.5;-1.0"]
+    frame = proto.encode_request_frame(lines, tids=[_RAW_TID, None, None])
+    out = _raw(port, b"HELLO\tB2\ttr=1\n" + frame)
+    assert out.startswith(HELLO)
+    replies = _decode_all(out[len(HELLO):])
+    assert replies == ["V\t1.0;2.0;0.5;-1.0", "PONG\tjid\tALS_MODEL",
+                       "V\t12:4.25;11:1.25"]
+    # same lines over a plain (no tr=1) B2 connection: byte-identical
+    # reply stream, proving tr=1 changes only the request framing
+    plain = _binary_exchange(port, proto.encode_request_frame(lines))
+    assert _decode_all(plain) == replies
+
+
+def test_b2_trace_extension_python(pysrv):
+    _b2_trace_roundtrip(pysrv.port)
+
+
+@_needs_native
+def test_b2_trace_extension_native(nsrv):
+    _b2_trace_roundtrip(nsrv.port)
+
+
+@_needs_native
+def test_native_spill_records_spans(nsrv, tmp_path):
+    spill = str(tmp_path / "native_spans.jsonl")
+    nsrv.set_trace(spill)
+    payload = (f"GET\tALS_MODEL\t7-U\ttid={_RAW_TID}\n"
+               f"TOPK\tALS_MODEL\t7\t2\ttid={_RAW_TID}\n"
+               "PING\n").encode("utf-8")
+    _raw(nsrv.port, payload)
+    deadline = time.time() + 5
+    spans = []
+    while time.time() < deadline and len(spans) < 2:
+        from flink_ms_tpu.obs import tracing as T
+        spans = [e for e in T.load_events(spill)
+                 if e.get("plane") == "native"]
+        time.sleep(0.02)
+    assert len(spans) == 2  # traced GET + TOPK; the untraced PING spilled
+    tid, psid = _RAW_TID.split("/")
+    for ev in spans:
+        assert ev["tid"] == tid and ev["psid"] == psid
+        assert ev["kind"] == "server_reply" and ev["ok"]
+        assert ev["dur_s"] >= 0 and ev["sid"]
+    topk = next(e for e in spans if e["verb"] == "TOPK")
+    assert topk["queue_wait_s"] >= 0 and topk["serve_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
 # fleet scrape: foreign native ladder is an error, not a silent skip
 # ---------------------------------------------------------------------------
 

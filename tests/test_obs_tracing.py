@@ -291,3 +291,96 @@ def test_event_ring_and_jsonl_file_sink(tmp_path, monkeypatch):
     )
     assert after == before + 1
     assert T.recent_events(kind="zeta")[-1]["shard"] == 1
+
+
+# ---------------------------------------------------------------------------
+# span layer (round 14): nesting, cross-process linkage, sampling
+# ---------------------------------------------------------------------------
+
+def test_span_stack_parents_nested_spans():
+    T.clear_events()
+    with T.trace_span() as tid:
+        with T.span("outer", op="a") as outer:
+            assert T.current_span_id() == outer.sid
+            assert T.current_context() == f"{tid}/{outer.sid}"
+            with T.span("inner") as inner:
+                pass
+        assert T.current_span_id() is None
+    evs = {e["kind"]: e for e in T.recent_events(tid=tid)}
+    assert evs["inner"]["psid"] == outer.sid
+    assert evs["outer"]["psid"] is None
+    assert evs["outer"]["dur_s"] >= evs["inner"]["dur_s"] >= 0
+    assert evs["outer"]["sid"] != evs["inner"]["sid"]
+    # a point event inside an open span auto-parents under it
+    with T.trace_span() as tid2:
+        with T.span("outer2") as o2:
+            T.event("marker", tid=tid2)
+    mk = T.recent_events(tid=tid2, kind="marker")[0]
+    assert mk["psid"] == o2.sid
+    # no trace context -> span is a free no-op (no sid, no event)
+    before = len(T.recent_events())
+    with T.span("untraced") as s:
+        assert s.sid is None
+    assert len(T.recent_events()) == before
+
+
+def test_cross_process_span_chain_over_the_wire():
+    """The server's span parents under the client RPC that caused it:
+    server_reply.psid == client_rpc.sid, via the composite tid/sid wire
+    field — the forensics tree assembles both processes' spans as one."""
+    from flink_ms_tpu.obs import forensics as FX
+
+    table = ModelTable(2)
+    table.put("k", "v")
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0).start()
+    try:
+        with QueryClient("127.0.0.1", srv.port, timeout_s=5) as c:
+            with T.trace_span() as tid:
+                assert c.query_state(ALS_STATE, "k") == "v"
+        chain = T.recent_events(tid=tid)
+        srv_ev = next(e for e in chain if e["kind"] == "server_reply")
+        cli_ev = next(e for e in chain if e["kind"] == "client_rpc")
+        assert srv_ev["psid"] == cli_ev["sid"]
+        assert cli_ev.get("psid") is None  # the RPC is the trace root here
+        tree = FX.assemble(chain)[tid]
+        assert tree.roots == [cli_ev["sid"]]
+        assert tree.children[cli_ev["sid"]] == [srv_ev["sid"]]
+    finally:
+        srv.stop()
+
+
+def test_wire_tid_helpers_roundtrip_composite_form():
+    assert T.wire_tid("t") == "t"
+    assert T.wire_tid("t", "s") == "t/s"
+    assert T.split_tid("t/s") == ("t", "s")
+    assert T.split_tid("t") == ("t", None)
+    assert T.split_tid(None) == (None, None)
+    # pop_tid returns the RAW wire value so servers echo it verbatim
+    parts = ["GET", "S", "k", "tid=t/s"]
+    assert T.pop_tid(parts) == "t/s"
+    assert parts == ["GET", "S", "k"]
+    # call_with_trace seeds the worker's span stack from the composite
+    got = {}
+
+    def probe():
+        got["tid"] = T.current_trace()
+        got["psid"] = T.current_span_id()
+
+    T.call_with_trace("t/s", probe)
+    assert got == {"tid": "t", "psid": "s"}
+    assert T.current_trace() is None  # restored
+
+
+def test_sample_trace_follows_knob(monkeypatch):
+    monkeypatch.delenv("TPUMS_TRACE_SAMPLE", raising=False)
+    assert T.sample_trace() is None           # default: off
+    monkeypatch.setenv("TPUMS_TRACE_SAMPLE", "1")
+    tid = T.sample_trace()
+    assert tid and len(tid) == 16             # always-on: fresh id
+    monkeypatch.setenv("TPUMS_TRACE_SAMPLE", "0")
+    assert T.sample_trace() is None
+    monkeypatch.setenv("TPUMS_TRACE_SAMPLE", "garbage")
+    assert T.sample_trace() is None           # unparseable = off
+    monkeypatch.setenv("TPUMS_TRACE_SAMPLE", "0.5")
+    hits = sum(1 for _ in range(400) if T.sample_trace())
+    assert 100 < hits < 300                   # the knob is a probability
