@@ -39,7 +39,8 @@ BENCH_SMALL=1 (quick sanity config), BENCH_SKIP_CPU=1, BENCH_PEAK_FLOPS
 BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
-serving_native,serving_update_plane,serving_rollout,serving_ann;
+serving_native,serving_update_plane,serving_rollout,serving_ann,
+serving_watch,serving_autopilot,serving_forensics,serving_geo;
 default all),
 BENCH_ANN_ROWS_EXACT / BENCH_ANN_ROWS_IVF / BENCH_ANN_ARM_TIMEOUT_S
 (retrieval-plane A/B arm sizes: sharded-exact question at 1M rows,
@@ -883,6 +884,9 @@ _COMPACT_KEYS = (
     "serving_forensics_diff_ok", "serving_forensics_alert_fired",
     "serving_forensics_exemplar_tids",
     "serving_forensics_incident_names_stage", "serving_forensics_ok",
+    "serving_geo_repl_lag_p50_ms", "serving_geo_repl_lag_p99_ms",
+    "serving_geo_stale_reads", "serving_geo_staleness_max_s",
+    "serving_geo_failover_ms", "serving_geo_errors", "serving_geo_ok",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1137,7 +1141,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
-        "serving_watch,serving_autopilot,serving_forensics"
+        "serving_watch,serving_autopilot,serving_forensics,serving_geo"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1226,6 +1230,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_autopilot", "run_serving_autopilot_section",
          lambda f: f(small)),
         ("serving_forensics", "run_serving_forensics_section",
+         lambda f: f(small)),
+        ("serving_geo", "run_serving_geo_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

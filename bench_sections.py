@@ -3341,3 +3341,133 @@ def run_serving_forensics_section(small: bool) -> dict:
                 os.environ[k] = v
         shutil.rmtree(tmp, ignore_errors=True)
     return out
+
+# ---------------------------------------------------------------------------
+# geo-distributed serving section: replication lag, staleness, failover time
+# ---------------------------------------------------------------------------
+
+def run_serving_geo_section(small: bool) -> dict:
+    """Geo-replication efficacy (serve/georepl.py, round 15):
+
+    1. **replication lag under write load** — a home journal takes a
+       steady update stream while a follower replicator (5ms poll) keeps
+       a second region's journal in byte parity; the sampled
+       ``lag_seconds`` distribution is the headline (p99 must sit well
+       under the 250ms chaos-gate bar).
+    2. **region-local stale reads** — a follower ServingJob answers
+       ``st=``-opted queries; every reply carries the follower's
+       measured staleness, and every read must succeed (zero errors).
+    3. **failover** — the follower's RegionController promotes it after
+       the home fleet's heartbeat lease lapses; the wall-clock from
+       home-death to the CAS-published new generation is the failover
+       metric, and the write forwarder must re-point to the new home.
+    """
+    from flink_ms_tpu.serve import georepl
+    from flink_ms_tpu.serve import registry
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import (ALS_STATE, ServingJob,
+                                             make_backend,
+                                             parse_als_record)
+    from flink_ms_tpu.serve.journal import Journal
+
+    n_users = 500 if small else 2_000
+    load_s = float(os.environ.get("BENCH_GEO_LOAD_S", 2.0 if small else 5.0))
+    n_q = int(os.environ.get("BENCH_GEO_QUERIES", 300 if small else 1_000))
+
+    tmp = tempfile.mkdtemp(prefix="tpums_geo_bench_")
+    saved = os.environ.get("TPUMS_REGISTRY_DIR")
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    us, eu = os.path.join(tmp, "us"), os.path.join(tmp, "eu")
+    out: dict = {}
+    rep = ctl = job = None
+    try:
+        home = Journal(us, "models")
+        home.append([f"{u},U,{u * 0.25};1.0;0.5;-0.25"
+                     for u in range(n_users)])
+        georepl.publish_region_topology(
+            "bench-geo", "us",
+            {"us": {"journal_dir": us}, "eu": {"journal_dir": eu}},
+            topic="models")
+        rep = georepl.JournalReplicator(us, eu, "models", "eu",
+                                        poll_s=0.005)
+        rep.run_until_caught_up()
+        rep.start()
+        job = ServingJob(
+            Journal(eu, "models"), ALS_STATE, parse_als_record,
+            make_backend("memory", None),
+            host="127.0.0.1", port=0, poll_interval_s=0.01,
+        ).start()
+        assert job.wait_ready(120)
+
+        # -- 1+2. write load at home, stale reads at the follower --------
+        lag_s: list = []
+        stale_vals: list = []
+        errors = 0
+        deadline = time.time() + load_s
+        seq = n_users
+        rng = np.random.default_rng(0)
+        with QueryClient("127.0.0.1", job.port, timeout_s=30,
+                         stale=True) as c:
+            while time.time() < deadline:
+                home.append([f"{seq + i},U,1.0;1.0;1.0;1.0"
+                             for i in range(50)])
+                seq += 50
+                for _ in range(max(1, n_q // 200)):
+                    key = f"{int(rng.integers(0, n_users))}-U"
+                    if c.query_state(ALS_STATE, key) is None:
+                        errors += 1
+                    if c.last_staleness_s is not None:
+                        stale_vals.append(c.last_staleness_s)
+                lag_s.append(rep.lag_seconds())
+                time.sleep(0.005)
+        lag_p = _pcts([s * 1e3 for s in lag_s])
+        out["serving_geo_repl_lag_p50_ms"] = lag_p["p50"]
+        out["serving_geo_repl_lag_p99_ms"] = lag_p["p99"]
+        out["serving_geo_stale_reads"] = len(stale_vals)
+        out["serving_geo_staleness_max_s"] = (
+            round(max(stale_vals), 3) if stale_vals else None)
+        out["serving_geo_errors"] = errors
+        _log(f"[bench:geo] lag p50={lag_p['p50']}ms p99={lag_p['p99']}ms; "
+             f"{len(stale_vals)} stale reads, {errors} errors")
+
+        # -- 3. home dies; the follower's controller promotes ------------
+        scoped = registry.qualify_region("bench-geo", "us")
+        registry.register(f"{scoped}:s0r0", "127.0.0.1", 1, ALS_STATE,
+                          replica_of=f"{scoped}/shard-0", ttl_s=0.2)
+        fwd = georepl.GeoWriteForwarder("bench-geo", "models")
+        ctl = georepl.RegionController("bench-geo", "models", "eu",
+                                       replicator=rep, detect_misses=2,
+                                       poll_s=0.02).start()
+        t_dead = time.time() + 0.2  # the lease's natural expiry = "death"
+        promoted = None
+        wait_until = time.time() + 15.0
+        while time.time() < wait_until:
+            if ctl.promoted:
+                promoted = time.time()
+                break
+            time.sleep(0.01)
+        failover_ms = (round((promoted - t_dead) * 1e3, 1)
+                       if promoted else None)
+        fwd._refresh(force=True)
+        repointed = fwd.home() == "eu"
+        out["serving_geo_failover_ms"] = failover_ms
+        out["serving_geo_forwarder_repointed"] = repointed
+        out["serving_geo_ok"] = (
+            errors == 0 and len(stale_vals) > 0 and promoted is not None
+            and failover_ms is not None and failover_ms < 5_000.0
+            and repointed and lag_p["p99"] < 250.0)
+        _log(f"[bench:geo] failover={failover_ms}ms "
+             f"repointed={repointed} ok={out['serving_geo_ok']}")
+    finally:
+        for closer in (ctl, rep, job):
+            if closer is not None:
+                try:
+                    closer.stop()
+                except Exception:
+                    pass
+        if saved is None:
+            os.environ.pop("TPUMS_REGISTRY_DIR", None)
+        else:
+            os.environ["TPUMS_REGISTRY_DIR"] = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out

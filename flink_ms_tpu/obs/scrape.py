@@ -230,6 +230,15 @@ def fleet_signals(before: dict, after: dict,
          "autopilot_heldout_mse": newest candidate's held-out MSE at
                            AFTER (min across processes; None until an
                            evaluation has run)}
+
+    Geo-replication plane (round 15 — ``serve/georepl.py``):
+
+        {"georepl_lag_bytes":   fleet-summed un-replicated journal
+                           backlog at AFTER (``tpums_georepl_lag_bytes``
+                           across topics/regions),
+         "georepl_lag_seconds": WORST follower staleness at AFTER (max
+                           over ``tpums_georepl_lag_seconds`` — a fleet
+                           sum of times means nothing)}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -338,6 +347,15 @@ def fleet_signals(before: dict, after: dict,
     forensics_staleness = (
         max(time.time() - last_collect, 0.0)
         if last_collect else None)
+    # geo-replication plane (round 15 — serve/georepl.py): bytes lag SUMS
+    # across topics/regions (total un-replicated backlog), seconds lag is
+    # the WORST follower (a fleet sum of times means nothing)
+    georepl_lag_bytes = sum(
+        g["value"] for g in after.get("gauges", [])
+        if g["name"] == "tpums_georepl_lag_bytes")
+    georepl_lag_seconds = max(
+        (g["value"] for g in after.get("gauges", [])
+         if g["name"] == "tpums_georepl_lag_seconds"), default=0.0)
     return {
         **autopilot,
         "qps": requests / dt_s,
@@ -354,6 +372,8 @@ def fleet_signals(before: dict, after: dict,
         "trace_spans_per_s": spans / dt_s,
         "exemplar_count": exemplar_count,
         "forensics_staleness_s": forensics_staleness,
+        "georepl_lag_bytes": georepl_lag_bytes,
+        "georepl_lag_seconds": georepl_lag_seconds,
         "dt_s": dt_s,
         "requests": requests,
     }

@@ -75,6 +75,7 @@ class QueryClient:
         retry: Optional[RetryPolicy] = None,
         proto: Optional[str] = None,
         tenant: Optional[str] = None,
+        stale: Optional[bool] = None,
     ):
         self.host = host
         self.port = port
@@ -106,10 +107,21 @@ class QueryClient:
         # server refuses the extended HELLO and auto mode falls back to tab
         # (where tracing needs no negotiation).
         self._want_b2_trace = os.environ.get("TPUMS_TRACE_B2", "0") != "0"
+        # per-read staleness reporting (serve/georepl.py): opt-in, same
+        # wire contract as tenancy — tab requests gain a trailing ``st=1``
+        # field and every reply a trailing ``st=<seconds>`` the client
+        # strips into ``last_staleness_s``; the B2 HELLO binds it per
+        # connection (``st=1`` extension).  Off (the default) keeps both
+        # planes byte-identical to the seed protocol.
+        if stale is None:
+            stale = os.environ.get("TPUMS_GEO_STALE_READS", "0") != "0"
+        self.stale = bool(stale)
+        self.last_staleness_s: Optional[float] = None
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._binary = False  # per-connection: set by the HELLO exchange
         self._b2_trace = False  # per-connection: tr=1 accepted
+        self._b2_stale = False  # per-connection: st=1 accepted
         self._frame_reader = None
 
     def _connect(self):
@@ -119,6 +131,7 @@ class QueryClient:
         self._rfile = sock.makefile("rb")
         self._binary = False
         self._b2_trace = False
+        self._b2_stale = False
         self._frame_reader = None
         if self.proto in ("b2", "auto"):
             # with a tenant, the HELLO carries it (connection-scoped — B2
@@ -132,6 +145,8 @@ class QueryClient:
                 hello += f"\t{admission_ctl.TENANT_FIELD}{self.tenant}"
             if self._want_b2_trace:
                 hello += f"\t{wire_proto.TRACE_EXT}"
+            if self.stale:
+                hello += f"\t{wire_proto.STALE_EXT}"
             sock.sendall(hello.encode("utf-8") + b"\n")
             line = self._rfile.readline()
             if not line:
@@ -141,6 +156,7 @@ class QueryClient:
             if reply == wire_proto.HELLO_REPLY:
                 self._binary = True
                 self._b2_trace = self._want_b2_trace
+                self._b2_stale = self.stale
                 self._frame_reader = wire_proto.FrameReader(self._rfile)
             elif self.proto == "b2":
                 self.close()
@@ -176,11 +192,15 @@ class QueryClient:
             wt = obs_tracing.wire_tid(tid, sid)
             t0 = time.perf_counter()
             t0_wall = time.time()
-        # tenant field first, tid last: the server pops tid, then tenant
-        # (serve/server.py _dispatch_parts).  No tenant -> ``line`` IS the
-        # request and the wire stays byte-identical to the seed protocol.
-        line = request if self.tenant is None else (
-            f"{request}\t{admission_ctl.TENANT_FIELD}{self.tenant}")
+        # append order st=, tn=, tid= — the reverse of the server's pops
+        # (tid, then tenant, then stale; serve/server.py _dispatch_parts).
+        # With none of them set ``line`` IS the request and the wire stays
+        # byte-identical to the seed protocol.
+        line = request
+        if self.stale:
+            line = f"{line}\t{wire_proto.STALE_EXT}"
+        if self.tenant is not None:
+            line = f"{line}\t{admission_ctl.TENANT_FIELD}{self.tenant}"
         data = line.encode("utf-8") + b"\n"
         failures = 0
         while True:
@@ -204,6 +224,8 @@ class QueryClient:
                             verb=request.split("\t", 1)[0],
                             host=self.host, port=self.port,
                             retries=failures, lat_s=round(dt, 6))
+                    if self._b2_stale:
+                        return self._pop_reply_stale(texts[0])
                     return texts[0]
                 wire = data if wt is None else (
                     f"{line}\t{obs_tracing.TID_FIELD}{wt}\n"
@@ -223,6 +245,8 @@ class QueryClient:
                         verb=request.split("\t", 1)[0],
                         host=self.host, port=self.port, retries=failures,
                         lat_s=round(dt, 6))
+                if self.stale:
+                    reply = self._pop_reply_stale(reply)
                 return reply
             except (BrokenPipeError, ConnectionResetError, ConnectionError,
                     OSError) as e:
@@ -364,7 +388,14 @@ class QueryClient:
                     t0=t0_wall, dur_s=round(dt, 9), host=self.host,
                     port=self.port, n=len(requests), window=window,
                     lat_s=round(dt, 6))
+            if self._b2_stale:
+                replies = [self._pop_reply_stale(r) for r in replies]
             return replies
+        if self.stale:
+            # tab plane: staleness per request, stamped FIRST so the
+            # server's pops (tid, tenant, stale) compose
+            ssuffix = f"\t{wire_proto.STALE_EXT}"
+            requests = [req + ssuffix for req in requests]
         if self.tenant is not None:
             # tab plane: tenant per request (before the tid, same order as
             # _roundtrip, so the server's two pops compose)
@@ -406,7 +437,26 @@ class QueryClient:
                 t0=t0_wall, dur_s=round(dt, 9), host=self.host,
                 port=self.port, n=len(requests), window=window,
                 lat_s=round(dt, 6))
+        if self.stale:
+            replies = [self._pop_reply_stale(r) for r in replies]
         return replies
+
+    def _pop_reply_stale(self, reply: str) -> str:
+        """Strip the trailing ``st=<seconds>`` field the server appends to
+        every reply of a staleness-opted read, recording the value in
+        ``last_staleness_s``.  The server ALWAYS appends the field when
+        asked (0.000 on the home region), so on an opted-in connection the
+        trailing field is unambiguous even for payloads containing
+        ``st=``."""
+        head, sep, tail = reply.rpartition("\t")
+        if sep and tail.startswith(wire_proto.STALE_FIELD):
+            try:
+                self.last_staleness_s = float(
+                    tail[len(wire_proto.STALE_FIELD):])
+            except ValueError:
+                return reply
+            return head
+        return reply
 
     def topk_pipelined(self, name: str, user_ids, k: int,
                        window: int = 32) -> list:

@@ -362,8 +362,17 @@ class ServingJob:
                 job_id=self.job_id,
                 topk_handlers=topk_handlers,
                 health_fn=self.health,
+                staleness_fn=self._staleness,
             )
         self.port = self.server.port
+
+    def _staleness(self):
+        """Replication staleness for st=-opted reads: the follower
+        replicator's journal-dir status record (serve/georepl.py), or None
+        (-> 0.000 on the wire) when this journal is not a geo follower."""
+        from . import georepl
+
+        return georepl.staleness_of(self.journal.dir, self.journal.topic)
 
     # -- lifecycle ---------------------------------------------------------
 

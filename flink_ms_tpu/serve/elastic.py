@@ -434,7 +434,7 @@ class ElasticClient:
                 now - self._last_refresh < self.refresh_s:
             return True
         self._last_refresh = now
-        topo = registry.resolve_topology(self.group)
+        topo = self._resolve_topology_retrying()
         if topo is None:
             return self._inner is not None
         gen = int(topo["gen"])
@@ -459,6 +459,26 @@ class ElasticClient:
             except Exception:
                 pass
         return True
+
+    def _resolve_topology_retrying(self):
+        """Topology read with the read ERROR distinguished from the record
+        being GONE.  A transient registry failure (unreadable dir, torn
+        write beyond the registry's own one-re-read guard) used to look
+        identical to "no record" and was silently swallowed; now it earns
+        a short bounded backoff and a counter, and on persistent failure
+        the caller keeps serving the last known generation."""
+        delay = 0.01
+        for attempt in range(3):
+            try:
+                return registry.resolve_topology(self.group, strict=True)
+            except (OSError, ValueError):
+                obs_metrics.get_registry().counter(
+                    "tpums_client_topology_refresh_errors_total",
+                    group=self.group).inc()
+                if attempt < 2:
+                    time.sleep(delay)
+                    delay *= 4
+        return None
 
     def _call(self, op: str, *args):
         self._maybe_swap()

@@ -37,35 +37,56 @@ MAGIC = b"B2"
 
 # The negotiation handshake, sent as a plain tab-protocol line.  Optional
 # extensions ride as extra tab fields, each self-describing: ``tn=<tenant>``
-# (admission identity, serve/admission.py) and ``tr=1`` (per-record trace
-# field, obs/tracing.py).  A HELLO with any OTHER extra field is malformed
-# and answers ``E\tbad request`` — pinned, so old and new servers refuse
-# unknown extensions identically.  The accept reply stays the frozen
-# two-field line either way.
+# (admission identity, serve/admission.py), ``tr=1`` (per-record trace
+# field, obs/tracing.py) and ``st=1`` (per-read staleness reporting,
+# serve/georepl.py — every reply record gains a trailing ``st=<seconds>``
+# field).  A HELLO with any OTHER extra field is malformed and answers
+# ``E\tbad request`` — pinned, so old and new servers refuse unknown
+# extensions identically.  The accept reply stays the frozen two-field
+# line either way.
 HELLO_VERB = "HELLO"
 HELLO_LINE = "HELLO\tB2"
 HELLO_REPLY = "HELLO\tB2"
 TRACE_EXT = "tr=1"
+STALE_EXT = "st=1"
+STALE_FIELD = "st="  # request: trailing tab field opting one read into
+                     # staleness reporting; reply: trailing ``st=<seconds>``
 _TENANT_FIELD = "tn="  # mirrors serve/admission.py TENANT_FIELD (no import:
                        # proto stays dependency-free)
 
 
 def parse_hello(parts: Sequence[str]) -> Optional[dict]:
-    """Validate a split HELLO line -> ``{"proto", "tenant", "trace"}`` or
-    None when structurally malformed (unknown extension, duplicate tenant).
-    The caller still refuses protos other than ``B2``."""
+    """Validate a split HELLO line -> ``{"proto", "tenant", "trace",
+    "stale"}`` or None when structurally malformed (unknown extension,
+    duplicate tenant).  The caller still refuses protos other than
+    ``B2``."""
     if len(parts) < 2 or parts[0] != HELLO_VERB:
         return None
     tenant: Optional[str] = None
     trace = False
+    stale = False
     for ext in parts[2:]:
         if ext.startswith(_TENANT_FIELD) and tenant is None:
             tenant = ext[len(_TENANT_FIELD):]
         elif ext == TRACE_EXT and not trace:
             trace = True
+        elif ext == STALE_EXT and not stale:
+            stale = True
         else:
             return None
-    return {"proto": parts[1], "tenant": tenant, "trace": trace}
+    return {"proto": parts[1], "tenant": tenant, "trace": trace,
+            "stale": stale}
+
+
+def pop_stale(parts: List[str]) -> bool:
+    """Pop a strictly-trailing ``st=1`` staleness opt-in field off a split
+    tab request -> True when present.  Mirrors ``admission.pop_tenant`` /
+    ``tracing.pop_tid``: append order on the wire is ``st=`` then ``tn=``
+    then ``tid=``, so the server pops tid, tenant, stale."""
+    if len(parts) > 1 and parts[-1] == STALE_EXT:
+        parts.pop()
+        return True
+    return False
 
 # Opcode byte per verb.  Order is frozen; new verbs append.
 OPCODES = {

@@ -90,6 +90,39 @@ def replica_ttl_s() -> float:
     return 5.0 * heartbeat_interval_s()
 
 
+def _load_json_retry(path: str, strict: bool = False):
+    """Shared torn-read guard for every registry file read.
+
+    Writers are atomic (tmp + rename/link), but a reader can still open a
+    file mid-replacement on filesystems whose rename visibility is not a
+    single point (NFS attribute caching, overlayfs copy-up), or catch a
+    non-registry writer mid-write.  A JSON decode failure is therefore
+    ambiguous: torn-mid-write or an actual corpse.  ONE short re-read
+    disambiguates — a concurrent writer's rename lands within the backoff,
+    so a live record is never judged dead off a single torn read.  A
+    missing file stays an immediate None (no entry is not a torn entry).
+
+    ``strict=True`` (the elastic client's topology refresh) re-raises the
+    final failure instead of returning None, so callers can tell "no
+    record" from "the registry is unreadable right now" and keep serving
+    their last known state rather than silently treating an I/O blip as a
+    dropped topology."""
+    last_err: Optional[Exception] = None
+    for attempt in (0, 1):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            last_err = e
+            if attempt == 0:
+                time.sleep(0.002)
+    if strict and last_err is not None:
+        raise last_err
+    return None
+
+
 def _entry_path(job_id: str) -> str:
     # jobIds are caller-chosen strings: sanitize for the filesystem, and
     # append a short digest of the RAW id so distinct ids can never map to
@@ -175,10 +208,8 @@ def _reap_if_unchanged(path: str, entry: dict) -> Optional[dict]:
     re-registered the job at this path since our read — only unlink if the
     file still carries the same (pid, heartbeat) we judged dead.  Returns
     the FRESH entry when one replaced the dead one, else None."""
-    try:
-        with open(path) as f:
-            current = json.load(f)
-    except (OSError, ValueError):
+    current = _load_json_retry(path)
+    if current is None:
         return None
     if (
         isinstance(current, dict)
@@ -207,11 +238,7 @@ def resolve(job_id: str) -> Optional[dict]:
     recorded elsewhere (shared-FS registry) are never pid-checked: the pid
     is meaningless across machines; their TTL still applies."""
     path = _entry_path(job_id)
-    try:
-        with open(path) as f:
-            entry = json.load(f)
-    except (OSError, ValueError):
-        return None
+    entry = _load_json_retry(path)
     if not isinstance(entry, dict) or "port" not in entry:
         return None
     if entry_is_dead(entry):
@@ -232,11 +259,7 @@ def list_jobs(gc: bool = True) -> List[dict]:
         if not name.endswith(".json"):
             continue
         path = os.path.join(registry_dir(), name)
-        try:
-            with open(path) as f:
-                entry = json.load(f)
-        except (OSError, ValueError):
-            continue
+        entry = _load_json_retry(path)
         if not isinstance(entry, dict) or "port" not in entry:
             continue
         if entry_is_dead(entry):
@@ -365,14 +388,122 @@ def gc_tenant_entries(tenant: str) -> int:
         if not name.endswith(".json"):
             continue
         path = os.path.join(registry_dir(), name)
-        try:
-            with open(path) as f:
-                entry = json.load(f)
-        except (OSError, ValueError):
-            continue
+        entry = _load_json_retry(path)
         if not isinstance(entry, dict) or "port" not in entry:
             continue
         if _entry_tenant(entry) != tenant:
+            continue
+        if entry_is_dead(entry) and _reap_if_unchanged(path, entry) is None:
+            reaped += 1
+    return reaped
+
+
+# ---------------------------------------------------------------------------
+# region namespaces (the geo-distributed plane, serve/georepl.py)
+# ---------------------------------------------------------------------------
+
+# A region is the OUTERMOST name prefix on group/job identifiers:
+# ``eu@@acme::als`` is region "eu"'s view of tenant "acme"'s serving group
+# "als".  Same discipline as tenant namespaces, one level further out:
+# every id derived from a region-qualified group — worker job ids, replica
+# groups, generation groups, topology records, controller leases, snapshot
+# scopes, alert scopes — inherits the prefix, so a follower fleet in one
+# region shares zero registry records with the home fleet, and region GC
+# structurally cannot touch another region's entries.
+
+REGION_SEP = "@@"
+
+
+def default_region() -> Optional[str]:
+    """The ambient region (``TPUMS_GEO_REGION``), or None for the
+    unscoped namespace — single-region deployments' default."""
+    r = os.environ.get("TPUMS_GEO_REGION", "").strip()
+    return r or None
+
+
+def qualify_region(name: str, region: Optional[str] = None) -> str:
+    """Region-scope a group/job name -> ``<region>@@<name>``.
+
+    ``region=None`` uses the ambient ``TPUMS_GEO_REGION``; an explicit
+    empty string pins the unscoped namespace regardless of environment.
+    Already region-qualified names pass through unchanged (idempotent).
+    Applied OUTSIDE tenant qualification: ``eu@@acme::als``."""
+    if REGION_SEP in name:
+        return name
+    r = default_region() if region is None else (region.strip() or None)
+    if not r:
+        return name
+    if (REGION_SEP in r or TENANT_SEP in r or "/" in r
+            or "\t" in r or "\n" in r):
+        raise ValueError(f"bad region name: {r!r}")
+    return f"{r}{REGION_SEP}{name}"
+
+
+def split_region(name: str) -> Tuple[Optional[str], str]:
+    """``"eu@@acme::als@g3/shard-0"`` -> ("eu", "acme::als@g3/shard-0");
+    unscoped names -> (None, name)."""
+    if REGION_SEP in name:
+        r, _, base = name.partition(REGION_SEP)
+        return (r or None), base
+    return None, name
+
+
+def region_of(name: str) -> Optional[str]:
+    return split_region(name)[0]
+
+
+def _entry_region(entry: dict) -> Optional[str]:
+    return region_of(entry.get("replica_of") or entry.get("job_id") or "")
+
+
+def list_regions() -> List[str]:
+    """Regions with any registry presence (live worker entries or topology
+    records), sorted.  The unscoped namespace is not a region."""
+    seen = set()
+    for e in list_jobs(gc=False):
+        r = _entry_region(e)
+        if r:
+            seen.add(r)
+    try:
+        names = os.listdir(registry_dir())
+    except OSError:
+        names = []
+    for name in names:
+        if not name.endswith(".topo.json"):
+            continue
+        rec = _read_record(os.path.join(registry_dir(), name), "topology")
+        if rec:
+            r = region_of(rec.get("group") or "")
+            if r:
+                seen.add(r)
+    return sorted(seen)
+
+
+def list_region_jobs(region: Optional[str], gc: bool = True) -> List[dict]:
+    """Live entries belonging to one region's namespace (``region=None``
+    selects the unscoped namespace)."""
+    return [e for e in list_jobs(gc=gc) if _entry_region(e) == region]
+
+
+def gc_region_entries(region: str) -> int:
+    """Reap DEAD worker entries of ONE region -> count reaped.  Same
+    structural-isolation statement as ``gc_tenant_entries``: only entries
+    whose identifiers carry ``<region>@@`` are reachable."""
+    if not region:
+        raise ValueError("gc_region_entries needs a region name")
+    reaped = 0
+    try:
+        names = os.listdir(registry_dir())
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(registry_dir(), name)
+        entry = _load_json_retry(path)
+        if not isinstance(entry, dict) or "port" not in entry:
+            continue
+        if _entry_region(entry) != region:
             continue
         if entry_is_dead(entry) and _reap_if_unchanged(path, entry) is None:
             reaped += 1
@@ -420,21 +551,22 @@ def _topology_path(group: str) -> str:
     return _group_path(group, "topo.json")
 
 
-def _read_record(path: str, kind: str) -> Optional[dict]:
-    try:
-        with open(path) as f:
-            record = json.load(f)
-    except (OSError, ValueError):
-        return None
+def _read_record(path: str, kind: str, strict: bool = False
+                 ) -> Optional[dict]:
+    record = _load_json_retry(path, strict=strict)
     if not isinstance(record, dict) or record.get("kind") != kind:
         return None
     return record
 
 
-def resolve_topology(group: str) -> Optional[dict]:
+def resolve_topology(group: str, strict: bool = False) -> Optional[dict]:
     """The group's active topology record ``{gen, shards, replicas, ...}``,
-    or None when no generation was ever published."""
-    return _read_record(_topology_path(group), "topology")
+    or None when no generation was ever published.  ``strict=True`` raises
+    the underlying ``OSError``/``ValueError`` when the record exists but
+    cannot be read — clients refreshing a topology must distinguish "gone"
+    (rebuild against defaults) from "unreadable" (keep the generation they
+    have)."""
+    return _read_record(_topology_path(group), "topology", strict=strict)
 
 
 class _GroupLock:
@@ -711,11 +843,7 @@ def gc_generation_entries(group: str, active_gen: int) -> int:
         if not name.endswith(".json"):
             continue
         path = os.path.join(registry_dir(), name)
-        try:
-            with open(path) as f:
-                entry = json.load(f)
-        except (OSError, ValueError):
-            continue
+        entry = _load_json_retry(path)
         if not isinstance(entry, dict) or "port" not in entry:
             continue
         gen = generation_of(entry, group)

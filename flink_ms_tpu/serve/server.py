@@ -156,10 +156,16 @@ class LookupServer:
         topk_handlers: Optional[Dict[str, object]] = None,
         health_fn=None,
         admission: Optional[admission_ctl.AdmissionController] = None,
+        staleness_fn=None,
     ):
         self.tables = tables
         self.job_id = job_id
         self.topk_handlers = topk_handlers or {}
+        # per-read staleness provider (serve/georepl.py): a callable ->
+        # seconds this server's state trails its home region, or None on
+        # a non-follower.  Only consulted for requests that opted in via
+        # the ``st=`` wire field — untagged traffic never pays the call.
+        self.staleness_fn = staleness_fn
         # per-tenant admission control (serve/admission.py): None unless a
         # TPUMS_ADMIT_* rate knob is set (or a controller is injected) —
         # the admission-off hot path costs one attribute check
@@ -219,6 +225,7 @@ class LookupServer:
                 # record carries one extra trailing tid field.
                 conn_tenant = None
                 conn_trace = False
+                conn_stale = False  # ``st=1``: staleness on every reply
                 try:
                     while True:
                         # block for at least one complete line (or EOF)
@@ -276,6 +283,7 @@ class LookupServer:
                                     # bind the extensions to the conn
                                     conn_tenant = ext["tenant"] or None
                                     conn_trace = ext["trace"]
+                                    conn_stale = ext.get("stale", False)
                                     hello = True
                                     break
                         if eof and buf and not hello:
@@ -316,7 +324,8 @@ class LookupServer:
                         if hello:
                             outer._serve_binary(sock, self.wfile, buf, eof,
                                                 tenant=conn_tenant,
-                                                trace=conn_trace)
+                                                trace=conn_trace,
+                                                stale=conn_stale)
                             return
                         if eof:
                             return
@@ -431,7 +440,7 @@ class LookupServer:
 
     def _dispatch_parts(self, parts, burst: int = 1, traced: bool = True,
                         tenant: Optional[str] = None,
-                        echo_tid: bool = True):
+                        echo_tid: bool = True, stale: bool = False):
         """Dispatch over already-split fields — the shared core of the tab
         line loop and the B2 frame loop (binary records arrive pre-split,
         and their fields may legally contain tabs, so they must never take
@@ -459,25 +468,36 @@ class LookupServer:
         tid = obs_tracing.pop_tid(parts) if traced else None
         if tenant is None and traced:
             tenant = admission_ctl.pop_tenant(parts)
+        if not stale and traced:
+            # tab-plane per-read staleness opt-in; on B2 the HELLO binds
+            # it per connection and arrives via the ``stale`` argument
+            stale = proto.pop_stale(parts)
         verb = parts[0] if parts and parts[0] else "?"
+        if verb == proto.HELLO_VERB:
+            # the accept reply is frozen (old and new clients parse it
+            # alike): an ``st=1`` HELLO extension binds staleness to the
+            # CONNECTION (handler loop), never to the handshake reply
+            stale = False
         t0 = time.perf_counter()
         if self.admission is not None and \
                 not self.admission.admit(tenant, verb):
             return self._finish(verb, tid, t0, admission_ctl.SHED_REPLY,
-                                shed=True, echo=echo_tid)
+                                shed=True, echo=echo_tid, stale=stale)
         if verb == "METRICS" and len(parts) == 1:
             return self._finish(verb, tid, t0, self._metrics_reply(),
-                                echo=echo_tid)
+                                echo=echo_tid, stale=stale)
         reply = self._handle(parts, burst)
         if isinstance(reply, _DeferredReply):
             reply.post = lambda rendered, resolver: self._finish(
-                verb, tid, t0, rendered, resolver, echo=echo_tid)
+                verb, tid, t0, rendered, resolver, echo=echo_tid,
+                stale=stale)
             return reply
-        return self._finish(verb, tid, t0, reply, echo=echo_tid)
+        return self._finish(verb, tid, t0, reply, echo=echo_tid,
+                            stale=stale)
 
     def _serve_binary(self, sock, wfile, buf: bytearray, eof: bool,
                       tenant: Optional[str] = None,
-                      trace: bool = False) -> None:
+                      trace: bool = False, stale: bool = False) -> None:
         """B2 frame loop, entered after an accepted HELLO (``serve.proto``).
 
         One request frame in -> one reply frame out, records answered in
@@ -520,7 +540,7 @@ class LookupServer:
                 # tid-suffixed (the client keeps its own request order)
                 self._dispatch_parts(parts, burst=len(records),
                                      traced=trace, tenant=tenant,
-                                     echo_tid=False)
+                                     echo_tid=False, stale=stale)
                 for parts in records
             ]
             if len(records) > 1:
@@ -547,7 +567,7 @@ class LookupServer:
 
     def _finish(self, verb: str, tid: Optional[str], t0: float,
                 reply: str, resolver=None, shed: bool = False,
-                echo: bool = True) -> str:
+                echo: bool = True, stale: bool = False) -> str:
         """Request epilogue: per-verb metrics, span event + tid echo for
         traced requests.  ``resolver`` (deferred top-k only) may expose a
         ``pending`` with the microbatcher's span fields — queue wait,
@@ -616,9 +636,26 @@ class LookupServer:
                         sid=obs_tracing.new_span_id(), psid=sid,
                         t0=t_end - dev, dur_s=round(dev, 9),
                         batch_size=getattr(pending, "batch_size", None))
-            if echo:
-                reply = f"{reply}\t{obs_tracing.TID_FIELD}{tid}"
+        if stale:
+            # staleness rides BEFORE the tid echo: the client strips its
+            # exact tid suffix first, then pops the trailing st field
+            reply = (f"{reply}\t{proto.STALE_FIELD}"
+                     f"{self._staleness_value():.3f}")
+        if tid is not None and echo:
+            reply = f"{reply}\t{obs_tracing.TID_FIELD}{tid}"
         return reply
+
+    def _staleness_value(self) -> float:
+        """Seconds this server's state trails its home region; 0.0 on the
+        home region itself (or when the provider fails — a read that got
+        an answer is not staler for the status file being unreadable)."""
+        if self.staleness_fn is None:
+            return 0.0
+        try:
+            v = self.staleness_fn()
+        except Exception:
+            return 0.0
+        return 0.0 if v is None else max(float(v), 0.0)
 
     def _metrics_reply(self) -> str:
         """The METRICS verb: the whole process-wide registry as ONE

@@ -42,7 +42,19 @@ Knobs (env):
                            dead lease, resumes from the persisted state
                            record and converges to an automatically
                            rolled-out candidate, with zero unattributed
-                           pages via the watch wrapper)
+                           pages via the watch wrapper),
+                           or "region" (run a two-region deployment —
+                           home fleet + geo-replicated follower fleet
+                           serving region-local reads — under rehearsal
+                           write load, PARTITION the journal replicator
+                           mid-segment, then SIGKILL the entire home
+                           region including its supervisor: the follower
+                           RegionController must promote within 5s,
+                           region-local reads stay at availability 1.0
+                           throughout, write forwarding re-points to the
+                           new home, replication lag p99 before the kill
+                           stays under 250ms, and staleness is visible
+                           per-read over the wire)
     CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
                            history over few keys so the fold has work)
     CHAOS_UPDATE_BATCH=200 ratings per producer tick (update mode)
@@ -1112,6 +1124,227 @@ def update_main() -> int:
     return 1 if failed else 0
 
 
+def region_main() -> int:
+    """Partition the cross-region journal replicator mid-segment, then
+    SIGKILL the ENTIRE home region — every worker process and the
+    supervisor that would have respawned them — while the follower region
+    keeps serving region-local reads (serve/georepl.py).  Contracts under
+    test: the follower's ``RegionController`` detects home death (zero
+    live home entries, lease expiry confirmed) and promotes in under 5s;
+    region-local reads see ZERO errors through partition, kill and
+    promotion; ``GeoWriteForwarder`` re-points writes to the new home
+    without restart; replication lag p99 at rehearsal write rates stays
+    under 250ms before the kill; and per-read staleness is visible over
+    the wire (``st=``) the whole time."""
+    from flink_ms_tpu.serve import georepl
+    from flink_ms_tpu.serve.client import QueryClient
+
+    base = tempfile.mkdtemp(prefix="tpums_chaos_region_")
+    os.environ.setdefault(
+        "TPUMS_REGISTRY_DIR", tempfile.mkdtemp(prefix="tpums_chaos_reg_"))
+    us_bus = os.path.join(base, "us", "bus")
+    eu_bus = os.path.join(base, "eu", "bus")
+    journal = Journal(us_bus, "models")
+    rng = np.random.default_rng(0)
+    k = 4
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=k))
+         for u in range(N_USERS)]
+        + [F.format_als_row(i, "I", rng.normal(size=k))
+           for i in range(N_USERS)])
+    keys = [f"{u}-U" for u in range(N_USERS)]
+
+    georepl.publish_region_topology(
+        "chaos-geo", "us",
+        {"us": {"journal_dir": us_bus}, "eu": {"journal_dir": eu_bus}},
+        topic="models")
+    # seed the follower journal BEFORE its fleet boots, so eu workers
+    # bootstrap from a byte-identical replica of home
+    rep = georepl.JournalReplicator(us_bus, eu_bus, "models", "eu",
+                                    poll_s=0.01)
+    rep.run_until_caught_up()
+
+    sup_us = ReplicaSupervisor(
+        W, R, us_bus, "models", os.path.join(base, "us", "ports"),
+        job_group=registry.qualify_region("chaos-geo", "us"),
+        state_backend="memory",
+        check_interval_s=registry.heartbeat_interval_s(),
+        respawn_delay_s=0.1)
+    sup_eu = ReplicaSupervisor(
+        W, R, eu_bus, "models", os.path.join(base, "eu", "ports"),
+        job_group=registry.qualify_region("chaos-geo", "eu"),
+        state_backend="memory",
+        check_interval_s=registry.heartbeat_interval_s(),
+        respawn_delay_s=0.1)
+    event("chaos_region_start", workers=W, replication=R,
+          home="us", follower="eu")
+    ok = [0] * THREADS
+    errs = [0] * THREADS
+    staleness_s = []
+    lag_samples_s = []
+    stop = threading.Event()
+
+    def load(widx):
+        # region-local reads against the FOLLOWER fleet only — the home
+        # region is about to die, eu must not notice
+        c = sup_eu.client(retry=RetryPolicy(
+            attempts=6, backoff_s=0.02, max_backoff_s=0.5), timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    good = c.query_state(ALS_STATE, key) is not None
+                except Exception:
+                    good = False
+                (ok if good else errs)[widx] += 1
+
+    def stale_probe():
+        # one st=-opted client straight at an eu replica: every reply
+        # carries the follower's staleness, the wire-visibility contract
+        with QueryClient(sup_eu.host, sup_eu.ports[(0, 0)],
+                         timeout_s=10, stale=True) as qc:
+            r = random.Random(17)
+            while not stop.is_set():
+                try:
+                    qc.query_state(ALS_STATE, keys[r.randrange(len(keys))])
+                    if qc.last_staleness_s is not None:
+                        staleness_s.append(qc.last_staleness_s)
+                except Exception:
+                    pass
+                time.sleep(0.02)
+
+    def produce():
+        # rehearsal write load into the HOME journal: what the replicator
+        # must keep up with for the lag gate
+        r = np.random.default_rng(7)
+        i = 0
+        while not stop.is_set():
+            journal.append(
+                [F.format_als_row((i + j) % N_USERS, "I", r.normal(size=k))
+                 for j in range(200)], flush=False)
+            i += 200
+            time.sleep(0.02)
+
+    promoted_rec = None
+    promote_s = None
+    repointed = False
+    ctl = None
+    try:
+        sup_us.start()
+        sup_eu.start()
+        if not (sup_us.wait_all_ready(120) and sup_eu.wait_all_ready(120)):
+            event("chaos_abort", reason="a region never became ready")
+            return 2
+        rep.start()
+        ctl = georepl.RegionController("chaos-geo", "models", "eu",
+                                       replicator=rep)
+        ctl.start()
+        fwd = georepl.GeoWriteForwarder("chaos-geo", "models")
+        assert fwd.home() == "us"
+
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        threads.append(threading.Thread(target=stale_probe, daemon=True))
+        threads.append(threading.Thread(target=produce, daemon=True))
+        for t in threads:
+            t.start()
+
+        # phase 1 — rehearsal: sample replication lag under write load
+        t_end = time.time() + float(
+            os.environ.get("CHAOS_REGION_REHEARSAL_S", 3.0))
+        while time.time() < t_end:
+            lag_samples_s.append(rep.lag_seconds())
+            time.sleep(0.005)
+
+        # phase 2 — partition the replicator mid-segment, then SIGKILL
+        # the whole home region: monitor thread FIRST (the supervisor
+        # dies with its region — nothing left to respawn the fleet)
+        rep.partitioned = True
+        event("chaos_partition", mode="region", topic="models",
+              region="eu", offset=rep.offset)
+        time.sleep(0.3)
+        sup_us._stop.set()
+        if sup_us._thread is not None:
+            sup_us._thread.join(timeout=10)
+            sup_us._thread = None
+        t_kill = time.time()
+        for (shard, replica), proc in sorted(sup_us.procs.items()):
+            if proc.poll() is None:
+                event("chaos_kill", shard=shard, replica=replica,
+                      pid=proc.pid, group=sup_us.group_of(shard))
+                proc.send_signal(signal.SIGKILL)
+
+        # phase 3 — the follower controller must promote on its own
+        deadline = time.time() + 15
+        while time.time() < deadline and ctl.promoted is None:
+            time.sleep(0.01)
+        promoted_rec = ctl.promoted
+        if promoted_rec is not None:
+            promote_s = round(time.time() - t_kill, 3)
+
+        # phase 4 — write forwarding re-points to the new home and the
+        # forwarded write lands in the eu region's journal dir
+        repointed = False
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if fwd.home() == "eu":
+                fwd.submit_many([(1, 2, 3.0)], flush=True)
+                repointed = any(
+                    ".upd" in n for n in os.listdir(eu_bus))
+                break
+            time.sleep(0.05)
+        time.sleep(1.0)  # region-local reads continue over the corpse
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        stop.set()
+        event("chaos_teardown", mode="region")
+        if ctl is not None:
+            ctl.stop()
+        rep.stop()
+        sup_eu.stop()
+        sup_us.stop()
+
+    lag_p = pcts([s * 1e3 for s in lag_samples_s])
+    total_ok, total_err = sum(ok), sum(errs)
+    total = total_ok + total_err
+    summary = {
+        "mode": "region", "workers": W, "replication": R,
+        "home": "us", "follower": "eu",
+        "promoted": promoted_rec is not None,
+        "promote_s": promote_s,
+        "new_gen": (promoted_rec or {}).get("gen"),
+        "sealed_offset": ((promoted_rec or {}).get("geo") or {}).get(
+            "failover", {}).get("sealed_offset"),
+        "forwarder_repointed": repointed,
+        "queries": total, "ok": total_ok, "errors": total_err,
+        "availability": round(total_ok / total, 6) if total else None,
+        "replication_lag_ms": lag_p,
+        "lag_samples": len(lag_samples_s),
+        "staleness_s": {
+            "samples": len(staleness_s),
+            "max": round(max(staleness_s), 3) if staleness_s else None,
+            "nonzero": sum(1 for s in staleness_s if s > 0),
+        },
+        "timeline": [e for e in recent_events()
+                     if e["kind"].startswith(("chaos_", "region_",
+                                              "georepl_", "replica_"))],
+    }
+    print(json.dumps(summary, indent=1, default=str))
+    failed = (
+        total_err > 0                          # a region-local read failed
+        or promoted_rec is None                # the follower never promoted
+        or (promote_s or 99.0) >= 5.0          # promotion too slow
+        or not repointed                       # writes still chase the corpse
+        or not lag_samples_s
+        or lag_p.get("p99", 1e9) >= 250.0      # replicator fell behind
+        or not staleness_s                     # staleness never reached wire
+    )
+    return 1 if failed else 0
+
+
 def run_with_watch(mode_fn) -> int:
     """The watch arm (CHAOS_WATCH=1, default): run the mode under a live
     ``obs.watch.FleetWatcher`` and tighten the exit gate with the alert
@@ -1163,4 +1396,5 @@ if __name__ == "__main__":
                              "snapshot": snapshot_main,
                              "update": update_main,
                              "rollout": rollout_main,
-                             "autopilot": autopilot_main}.get(MODE, main)))
+                             "autopilot": autopilot_main,
+                             "region": region_main}.get(MODE, main)))

@@ -1,0 +1,610 @@
+"""Geo-distributed serving (serve/georepl.py round 15): journal
+replication byte/offset parity (rotation, folds, crash resume, lossy
+retention holes -> snapshot copy), the per-read ``st=`` staleness wire
+field (literal byte pins — untagged clients stay byte-identical, the
+HELLO accept reply stays frozen), region registry namespaces, follower
+promotion + write-forwarder re-point, and the satellite hardenings:
+ElasticClient topology-refresh retry, the registry torn-read guard, and
+truncation recovery through a foreign-topology snapshot family."""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from flink_ms_tpu.obs import metrics as obs_metrics
+from flink_ms_tpu.serve import georepl, proto, registry
+from flink_ms_tpu.serve import snapshot as sm
+from flink_ms_tpu.serve.client import QueryClient, RetryPolicy
+from flink_ms_tpu.serve.compact import compact_journal
+from flink_ms_tpu.serve.consumer import (
+    ALS_STATE,
+    MemoryStateBackend,
+    ServingJob,
+    make_backend,
+    parse_als_record,
+)
+from flink_ms_tpu.serve.elastic import ElasticClient, generation_group
+from flink_ms_tpu.serve.ha import shard_group
+from flink_ms_tpu.serve.journal import Journal, OffsetTruncatedError
+from flink_ms_tpu.serve.server import LookupServer
+from flink_ms_tpu.serve.sharded import sharded_parse
+from flink_ms_tpu.serve.table import ModelTable, _fnv1a
+from flink_ms_tpu.serve.update_plane import input_topic
+
+
+def _rows(n, start=0, keys=None):
+    keys = keys or n
+    return [f"{(start + i) % keys},I,v{start + i}" for i in range(n)]
+
+
+def _drain(j, start=0):
+    """Read EVERYTHING retained after ``start`` -> (bytes, end_offset)."""
+    out, off = b"", start
+    while True:
+        chunk, nxt = j.read_bytes_from(off)
+        if not chunk and nxt == off:
+            return out, off
+        out += chunk
+        off = nxt
+
+
+def _job(j, **kw):
+    kw.setdefault("backend", MemoryStateBackend())
+    kw.setdefault("port", 0)
+    kw.setdefault("topk_index", False)
+    kw.setdefault("poll_interval_s", 0.02)
+    return ServingJob(j, ALS_STATE, parse_als_record, kw.pop("backend"), **kw)
+
+
+def _counter_value(name, **labels):
+    snap = obs_metrics.get_registry().snapshot()
+    for c in snap.get("counters", []):
+        if c["name"] == name and all(
+            c.get("labels", {}).get(k) == v for k, v in labels.items()
+        ):
+            return c["value"]
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# journal replication: byte/offset parity, rotation, resume, folds, holes
+# ---------------------------------------------------------------------------
+
+def test_replicator_mirrors_bytes_and_offsets(tmp_path):
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    home = Journal(us, "models", segment_bytes=256)
+    for r in _rows(100):
+        home.append([r], flush=False)  # per-row: force segment rotation
+    home.sync()
+    rep = georepl.JournalReplicator(us, eu, "models", "eu", poll_s=0.01)
+    try:
+        assert rep.run_until_caught_up() == home.end_offset()
+        fol = Journal(eu, "models")
+        assert fol.end_offset() == home.end_offset()
+        assert fol.start_offset() == home.start_offset()
+        assert _drain(fol) == _drain(home)
+        # live tail: home keeps writing, the follower keeps pace
+        home.append(_rows(50, start=100))
+        rep.run_until_caught_up()
+        assert _drain(fol) == _drain(home)
+        assert rep.bytes_replicated == home.end_offset()
+        # the replicated journal is a servable journal
+        job = _job(Journal(eu, "models")).start()
+        try:
+            assert job.wait_ready(30)
+            # tail batch wrapped keys 0..49: LWW shows the tail's values
+            assert job.table.get("7-I") == "v107"
+            assert job.table.get("63-I") == "v63"
+            assert len(job.table) == 100
+        finally:
+            job.stop()
+    finally:
+        rep.stop()
+
+
+def test_replicator_resumes_across_restart(tmp_path):
+    """The replicated offset is crash-safe: a new replicator picks up at
+    the follower journal's aligned end, not at zero."""
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    home = Journal(us, "models", segment_bytes=256)
+    home.append(_rows(40))
+    rep1 = georepl.JournalReplicator(us, eu, "models", "eu", poll_s=0.01)
+    rep1.run_until_caught_up()
+    mid = rep1.offset
+    rep1.stop()  # releases the per-(region, topic) lease
+    home.append(_rows(40, start=40))
+    rep2 = georepl.JournalReplicator(us, eu, "models", "eu", poll_s=0.01)
+    try:
+        assert rep2.offset == mid  # resumed, not re-replicated
+        rep2.run_until_caught_up()
+        assert _drain(Journal(eu, "models")) == _drain(home)
+        assert rep2.bytes_replicated == home.end_offset() - mid
+    finally:
+        rep2.stop()
+
+
+def test_replicator_lease_is_exclusive(tmp_path):
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    Journal(us, "models").append(_rows(5))
+    rep = georepl.JournalReplicator(us, eu, "models", "eu")
+    try:
+        with pytest.raises(georepl.ReplicatorBusy):
+            georepl.JournalReplicator(us, eu, "models", "eu")
+        # a different region's follower is a different lease
+        rep2 = georepl.JournalReplicator(
+            us, str(tmp_path / "ap"), "models", "ap")
+        rep2.stop()
+    finally:
+        rep.stop()
+    # released on stop: the slot is reusable
+    georepl.JournalReplicator(us, eu, "models", "eu").stop()
+
+
+def test_replicator_mirrors_compaction_fold(tmp_path):
+    """A fresh follower of a compacted home receives the fold artifact
+    itself (same bytes, same offset jump), not a re-expansion of it."""
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    home = Journal(us, "models", segment_bytes=128)
+    for r in _rows(100, keys=5):
+        home.append([r], flush=False)
+    home.sync()
+    assert compact_journal(home, parse_fn=parse_als_record) is not None
+    rep = georepl.JournalReplicator(us, eu, "models", "eu", poll_s=0.01)
+    try:
+        rep.run_until_caught_up()
+        assert rep.folds_mirrored >= 1
+        assert any(".clog." in n for n in os.listdir(eu))
+        fol = Journal(eu, "models")
+        assert fol.end_offset() == home.end_offset()
+        assert _drain(fol) == _drain(home)
+        # the mirrored fold replays to the same LWW state
+        job = _job(Journal(eu, "models")).start()
+        try:
+            assert job.wait_ready(30)
+            assert len(job.table) == 5
+            assert job.table.get("3-I") == "v98"
+        finally:
+            job.stop()
+    finally:
+        rep.stop()
+
+
+def test_replicator_rereads_fold_after_lossless_truncation(tmp_path):
+    """A follower stranded mid-prefix when home compacts under it re-reads
+    the fold from its base — losslessly, shedding its partial segments."""
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    home = Journal(us, "models", segment_bytes=128)
+    for r in _rows(120, keys=6):
+        home.append([r], flush=False)
+    home.sync()
+    # replicate a PARTIAL prefix, then compact home underneath it
+    rep = georepl.JournalReplicator(us, eu, "models", "eu",
+                                    poll_s=0.01, max_bytes=64)
+    try:
+        assert rep.step() > 0
+        assert 0 < rep.offset < home.end_offset()
+        assert compact_journal(home, parse_fn=parse_als_record) is not None
+        rep.run_until_caught_up()
+        assert rep.compacted_rereads >= 1
+        assert rep.lost_bytes == 0
+        assert _drain(Journal(eu, "models")) == _drain(home)
+    finally:
+        rep.stop()
+
+
+def test_replicator_covers_retention_hole_with_snapshots(tmp_path):
+    """Lossy flavor: home retention already expired the prefix.  The
+    replicator ships home's covering snapshots alongside the retained
+    bytes so a follower consumer can still bootstrap without the hole."""
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    home = Journal(us, "models", segment_bytes=128, retain_segments=2)
+    for r in _rows(200, keys=20):
+        home.append([r], flush=False)
+    home.sync()
+    assert home.start_offset() > 0  # retention really expired the prefix
+    t = ModelTable(8)
+    for i in range(200):
+        t.put(f"{i % 20}-I", f"v{i}")
+    sm.publish(sm.snapshot_root(us, "models"), t, home.end_offset(),
+               shard=0, num_shards=1, topic="models")
+    rep = georepl.JournalReplicator(us, eu, "models", "eu", poll_s=0.01)
+    try:
+        rep.run_until_caught_up()
+        assert rep.lost_bytes == home.start_offset()
+        assert rep.snapshots_copied >= 1
+        assert sm.list_manifests(sm.snapshot_root(eu, "models"))
+        assert _drain(Journal(eu, "models"), start=home.start_offset()) \
+            == _drain(home, start=home.start_offset())
+        # follower consumer: snapshot bootstrap + retained-tail replay
+        job = _job(Journal(eu, "models")).start()
+        try:
+            assert job.wait_ready(30)
+            assert job.bootstrap_source == "snapshot"
+            assert job.table.get("19-I") == "v199"
+            assert len(job.table) == 20
+        finally:
+            job.stop()
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# staleness: the replicator status record behind ``st=``
+# ---------------------------------------------------------------------------
+
+def test_staleness_of_follower_journal(tmp_path):
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    home = Journal(us, "models")
+    home.append(_rows(10))
+    # the home region (no replicator status record) is not a follower
+    assert georepl.staleness_of(us, "models") is None
+    rep = georepl.JournalReplicator(us, eu, "models", "eu", poll_s=0.01)
+    try:
+        rep.run_until_caught_up()
+        time.sleep(0.03)  # past the status-write throttle (2 * poll_s)
+        rep.step()        # caught-up status lands on disk
+        georepl._STALENESS_CACHE.clear()
+        assert georepl.staleness_of(eu, "models") == 0.0
+        # partition: staleness grows from the last caught-up instant
+        rep.partitioned = True
+        time.sleep(0.05)
+        rep.step()
+        georepl._STALENESS_CACHE.clear()
+        s = georepl.staleness_of(eu, "models")
+        assert s is not None and s > 0.0
+        # the lag gauges roll into the fleet scrape
+        from flink_ms_tpu.obs.scrape import fleet_signals
+
+        snap = obs_metrics.get_registry().snapshot()
+        sig = fleet_signals(snap, snap, 1.0)
+        assert sig["georepl_lag_seconds"] > 0.0
+        assert sig["georepl_lag_bytes"] >= 0
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# staleness on the wire: literal byte pins (tab + B2 + client direction)
+# ---------------------------------------------------------------------------
+
+ROWS = [
+    ("7-U", "1.0;2.0;0.5;-1.0"),
+    ("10-I", "1.0;0.5;-2.0;0.25"),
+]
+
+
+def _server(staleness_fn=None):
+    table = ModelTable(2)
+    for k, v in ROWS:
+        table.put(k, v)
+    return LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0,
+                        job_id="jid", staleness_fn=staleness_fn).start()
+
+
+def _raw(port, payload):
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as sock:
+        sock.sendall(payload)
+        sock.shutdown(socket.SHUT_WR)
+        out = b""
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                return out
+            out += chunk
+
+
+def test_stale_tab_wire_bytes_pinned():
+    srv = _server(lambda: 1.5)
+    try:
+        # untagged requests: byte-identical to the frozen v1 protocol even
+        # on a server that HAS a staleness source
+        assert _raw(srv.port, b"GET\tALS_MODEL\t7-U\nPING\n") == (
+            b"V\t1.0;2.0;0.5;-1.0\nPONG\tjid\tALS_MODEL\n")
+        # a trailing st=1 buys exactly one trailing st=<seconds> field
+        assert _raw(srv.port, b"GET\tALS_MODEL\t7-U\tst=1\nPING\tst=1\n") == (
+            b"V\t1.0;2.0;0.5;-1.0\tst=1.500\n"
+            b"PONG\tjid\tALS_MODEL\tst=1.500\n")
+    finally:
+        srv.stop()
+
+
+def test_stale_reply_zero_without_staleness_source():
+    # a home-region (or pre-geo) server answers opted-in reads with 0.000
+    srv = _server()
+    try:
+        assert _raw(srv.port, b"GET\tALS_MODEL\t7-U\tst=1\n") == (
+            b"V\t1.0;2.0;0.5;-1.0\tst=0.000\n")
+    finally:
+        srv.stop()
+
+
+def test_stale_b2_hello_reply_stays_frozen():
+    """The st=1 HELLO extension binds staleness per-connection; the accept
+    reply itself must stay the frozen two-field line (old clients parse
+    it with an exact string compare)."""
+    srv = _server(lambda: 0.25)
+    try:
+        frame = proto.encode_request_frame([f"GET\t{ALS_STATE}\t7-U"])
+        out = _raw(srv.port, b"HELLO\tB2\tst=1\n" + frame)
+        assert out.startswith(b"HELLO\tB2\n")
+        res = proto.decode_reply_frame(out[len(b"HELLO\tB2\n"):], 0)
+        assert res is not None
+        assert res[0] == ["V\t1.0;2.0;0.5;-1.0\tst=0.250"]
+    finally:
+        srv.stop()
+
+
+def test_stale_client_request_bytes_pinned():
+    """Client direction of the pin: stale=True stamps st=1 as the FIRST
+    trailing extension (tenant outside it), and the reply's trailing
+    st=<seconds> is stripped into last_staleness_s."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(2)
+    port = lsock.getsockname()[1]
+    got = []
+
+    def serve_once():
+        conn, _ = lsock.accept()
+        with conn:
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+            got.append(buf)
+            conn.sendall(b"V\tx\tst=0.250\n")
+
+    for tenant, want in [
+        (None, b"GET\tALS_MODEL\t7-U\tst=1\n"),
+        ("acme", b"GET\tALS_MODEL\t7-U\tst=1\ttn=acme\n"),
+    ]:
+        t = threading.Thread(target=serve_once, daemon=True)
+        t.start()
+        with QueryClient("127.0.0.1", port, stale=True,
+                         tenant=tenant or "") as c:
+            assert c.query_state(ALS_STATE, "7-U") == "x"
+            assert c.last_staleness_s == 0.25
+        t.join(timeout=10)
+        assert got.pop() == want
+    lsock.close()
+
+
+def test_query_client_staleness_end_to_end():
+    srv = _server(lambda: 1.5)
+    try:
+        for proto_mode in ("tab", "b2"):
+            with QueryClient("127.0.0.1", srv.port, proto=proto_mode,
+                             stale=True) as c:
+                assert c.query_state(ALS_STATE, "7-U") == "1.0;2.0;0.5;-1.0"
+                assert c.last_staleness_s == 1.5
+                assert c.pipeline(
+                    [f"GET\t{ALS_STATE}\t7-U"] * 4
+                ) == ["V\t1.0;2.0;0.5;-1.0"] * 4
+                assert c.last_staleness_s == 1.5
+            # same server, untagged client: no staleness surfaced
+            with QueryClient("127.0.0.1", srv.port, proto=proto_mode) as c:
+                assert c.query_state(ALS_STATE, "7-U") == "1.0;2.0;0.5;-1.0"
+                assert c.last_staleness_s is None
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# region namespaces in the registry
+# ---------------------------------------------------------------------------
+
+def test_region_qualification_helpers(monkeypatch):
+    assert registry.qualify_region("acme::als", "eu") == "eu@@acme::als"
+    assert registry.qualify_region("eu@@x") == "eu@@x"  # idempotent
+    assert registry.qualify_region("x") == "x"          # no ambient region
+    monkeypatch.setenv("TPUMS_GEO_REGION", "ap")
+    assert registry.qualify_region("y") == "ap@@y"
+    assert registry.qualify_region("y", region="") == "y"  # explicit unscope
+    assert registry.split_region("eu@@g@g3/shard-0") == ("eu", "g@g3/shard-0")
+    assert registry.split_region("plain") == (None, "plain")
+    assert registry.region_of("eu@@x") == "eu"
+    with pytest.raises(ValueError):
+        registry.qualify_region("y", region="bad@@r")
+
+
+def test_gc_region_entries_is_structurally_isolated():
+    # three namespaces, every entry's heartbeat lease already expired
+    registry.register("eu@@g:s0r0", "127.0.0.1", 1, ALS_STATE,
+                      replica_of="eu@@g/shard-0", ttl_s=0.01)
+    registry.register("us@@g:s0r0", "127.0.0.1", 2, ALS_STATE,
+                      replica_of="us@@g/shard-0", ttl_s=0.01)
+    registry.register("plain", "127.0.0.1", 3, ALS_STATE, ttl_s=0.01)
+    time.sleep(0.05)
+    assert registry.gc_region_entries("eu") == 1
+    # file-level check (resolve() itself reaps dead entries): only the
+    # target region's entry was reachable
+    assert not os.path.exists(registry._entry_path("eu@@g:s0r0"))
+    assert os.path.exists(registry._entry_path("us@@g:s0r0"))
+    assert os.path.exists(registry._entry_path("plain"))
+    with pytest.raises(ValueError):
+        registry.gc_region_entries("")
+
+
+def test_region_topology_record_roundtrip(tmp_path):
+    rec = georepl.publish_region_topology(
+        "geo-rt", "us",
+        {"us": {"journal_dir": str(tmp_path / "us")},
+         "eu": {"journal_dir": str(tmp_path / "eu")}},
+        topic="models")
+    assert rec["gen"] == 1
+    assert georepl.home_region("geo-rt") == "us"
+    assert georepl.region_journal_dir("geo-rt") == str(tmp_path / "us")
+    assert georepl.region_journal_dir("geo-rt", "eu") == str(tmp_path / "eu")
+    # regions surface in list_regions() once a fleet registers under them
+    for region, port in (("us", 1), ("eu", 2)):
+        scoped = registry.qualify_region("geo-rt", region)
+        registry.register(f"{scoped}:s0r0", "127.0.0.1", port, ALS_STATE,
+                          replica_of=f"{scoped}/shard-0")
+    assert registry.list_regions() == ["eu", "us"]
+    assert [e["port"] for e in registry.list_region_jobs("eu")] == [2]
+
+
+# ---------------------------------------------------------------------------
+# failover: follower promotion + write-forwarder re-point (in-process)
+# ---------------------------------------------------------------------------
+
+def test_region_failover_promotes_follower(tmp_path):
+    us, eu = str(tmp_path / "us"), str(tmp_path / "eu")
+    home = Journal(us, "models")
+    home.append(_rows(50))
+    georepl.publish_region_topology(
+        "geo-fo", "us",
+        {"us": {"journal_dir": us}, "eu": {"journal_dir": eu}},
+        topic="models")
+    rep = georepl.JournalReplicator(us, eu, "models", "eu", poll_s=0.01)
+    rep.run_until_caught_up()
+    # a "home fleet": one worker entry on a short heartbeat lease
+    scoped = registry.qualify_region("geo-fo", "us")
+    registry.register(f"{scoped}:s0r0", "127.0.0.1", 1, ALS_STATE,
+                      replica_of=f"{scoped}/shard-0", ttl_s=0.25)
+    fwd = georepl.GeoWriteForwarder("geo-fo", "models")
+    assert fwd.home() == "us"
+    ctl = georepl.RegionController("geo-fo", "models", "eu",
+                                   replicator=rep, detect_misses=2,
+                                   poll_s=0.01)
+    try:
+        assert ctl.run_once() is None  # home is live: no action
+        time.sleep(0.4)                # let the home lease lapse
+        assert ctl.run_once() is None  # miss 1 of 2: still watching
+        rec = ctl.run_once()           # miss 2: promote
+        assert rec is not None and ctl.promoted
+        geo = rec["geo"]
+        assert geo["home"] == "eu"
+        assert geo["failover"]["from"] == "us"
+        assert geo["failover"]["sealed_offset"] == home.end_offset()
+        assert georepl.home_region("geo-fo") == "eu"
+        # dead home's worker entries were reaped with the promotion
+        assert not os.path.exists(registry._entry_path(f"{scoped}:s0r0"))
+        # the forwarder re-points and writes land in the NEW home
+        fwd._refresh(force=True)
+        assert fwd.home() == "eu"
+        fwd.submit_many([(1, 2, 3.0)], flush=True)
+        assert any(f"{input_topic('models', p)}.log" in os.listdir(eu)
+                   for p in range(8))  # landed in SOME eu input partition
+        assert not any(".upd" in n for n in os.listdir(us))
+        assert fwd.repoints == 1
+        # promoting the region that is already home is a no-op
+        assert ctl.failover() is None
+    finally:
+        ctl.stop()
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: ElasticClient survives registry read blips mid-traffic
+# ---------------------------------------------------------------------------
+
+def test_elastic_client_survives_unreadable_registry(tmp_path, monkeypatch):
+    j = Journal(str(tmp_path / "journal"), "als")
+    keys = [f"{i}" for i in range(20)]
+    j.append([f"{k},I,val{k}" for k in keys])
+    gg = generation_group("geo-ec", 1)
+    job = ServingJob(
+        j, ALS_STATE, sharded_parse(parse_als_record, 0, 1),
+        make_backend("memory", None),
+        host="127.0.0.1", port=0, poll_interval_s=0.01,
+        job_id=f"{gg}:s0r0", replica_of=shard_group(gg, 0),
+        replica_index=0, topk_index=False,
+        topology_group="geo-ec", generation=1,
+    ).start()
+    try:
+        assert job.wait_ready(30)
+        registry.publish_topology("geo-ec", 1)
+        errs_before = _counter_value(
+            "tpums_client_topology_refresh_errors_total", group="geo-ec")
+        # refresh on every query; the registry goes unreadable mid-traffic
+        c = ElasticClient("geo-ec", refresh_s=0.0, timeout_s=5,
+                          retry=RetryPolicy(attempts=3, backoff_s=0.01,
+                                            max_backoff_s=0.05))
+        with c:
+            assert c.query_state(ALS_STATE, "7-I") == "val7"
+            real = registry.resolve_topology
+            broken = {"on": True}
+
+            def flaky(group, strict=False):
+                if broken["on"]:
+                    raise OSError("registry dir unreadable")
+                return real(group, strict=strict)
+
+            monkeypatch.setattr(registry, "resolve_topology", flaky)
+            # every query during the outage is served from the last known
+            # generation — zero failures
+            for k in keys:
+                assert c.query_state(ALS_STATE, f"{k}-I") == f"val{k}"
+            assert _counter_value(
+                "tpums_client_topology_refresh_errors_total", group="geo-ec",
+            ) > errs_before
+            broken["on"] = False
+            assert c.query_state(ALS_STATE, "3-I") == "val3"
+    finally:
+        job.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: registry reads retry through torn writes
+# ---------------------------------------------------------------------------
+
+def test_resolve_retries_through_torn_write(monkeypatch):
+    """A reader racing a writer may see a half-written record; the shared
+    retry helper re-reads once the writer (simulated inside the backoff
+    sleep) finishes — the job is never judged missing."""
+    registry.register("torn-job", "127.0.0.1", 4321, ALS_STATE)
+    path = registry._entry_path("torn-job")
+    with open(path) as f:
+        good = f.read()
+    with open(path, "w") as f:
+        f.write(good[: len(good) // 2])  # torn: invalid JSON
+
+    def writer_finishes(_s):
+        with open(path, "w") as f:
+            f.write(good)
+
+    monkeypatch.setattr(registry.time, "sleep", writer_finishes)
+    entry = registry.resolve("torn-job")
+    assert entry is not None and entry["port"] == 4321
+    # a PERSISTENTLY torn record (writer died mid-write) reads as absent,
+    # not as a crash
+    with open(path, "w") as f:
+        f.write(good[: len(good) // 2])
+    monkeypatch.setattr(registry.time, "sleep", lambda _s: None)
+    assert registry.resolve("torn-job") is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: truncation recovery through a FOREIGN-topology family
+# ---------------------------------------------------------------------------
+
+def test_truncation_recovery_foreign_family_covers_hole(tmp_path):
+    """The covering snapshot need not match the consumer's identity: a
+    complete family published by a 2-shard fleet (different group/gen)
+    still covers a single-shard consumer's retention hole."""
+    j = Journal(str(tmp_path / "journal"), "als")
+    n, keys = 600, 60
+    for i in range(n):
+        j.append([f"{i % keys},I,v{i}"], flush=False)
+    j.sync()
+    end = j.end_offset()
+    root = sm.snapshot_root(j.dir, j.topic)
+    t0, t1 = ModelTable(8), ModelTable(8)
+    for i in range(n):
+        k = f"{i % keys}-I"
+        (t0 if _fnv1a(k) % 2 == 0 else t1).put(k, f"v{i}")
+    for s, t in ((0, t0), (1, t1)):
+        sm.publish(root, t, end, shard=s, num_shards=2,
+                   group="old-geo", gen=7, topic="als")
+    job = _job(j)
+    err = OffsetTruncatedError(0, 500, lossless=False, reason="expired")
+    assert job._recover_truncated(err) == end
+    assert len(job.table) == keys  # both foreign members loaded
+    assert job.table.get("59-I") == "v599"
+    assert job.table.get("0-I") == "v540"
