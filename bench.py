@@ -40,8 +40,8 @@ BENCH_INIT_ATTEMPTS / BENCH_INIT_BACKOFF_S (backend retry policy),
 BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
 serving_native,serving_update_plane,serving_rollout,serving_ann,
-serving_watch,serving_autopilot,serving_forensics,serving_geo;
-default all),
+serving_watch,serving_autopilot,serving_forensics,serving_geo,
+serving_arena; default all),
 BENCH_ANN_ROWS_EXACT / BENCH_ANN_ROWS_IVF / BENCH_ANN_ARM_TIMEOUT_S
 (retrieval-plane A/B arm sizes: sharded-exact question at 1M rows,
 IVF question at 10M, recall@100 >= 0.95 gate recorded),
@@ -1141,7 +1141,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "als,svm,serving,svmserve,serving_ingest,serving_ha,"
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
-        "serving_watch,serving_autopilot,serving_forensics,serving_geo"
+        "serving_watch,serving_autopilot,serving_forensics,serving_geo,"
+        "serving_arena"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1232,6 +1233,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_forensics", "run_serving_forensics_section",
          lambda f: f(small)),
         ("serving_geo", "run_serving_geo_section",
+         lambda f: f(small)),
+        ("serving_arena", "run_serving_arena_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

@@ -3471,3 +3471,170 @@ def run_serving_geo_section(small: bool) -> dict:
             os.environ["TPUMS_REGISTRY_DIR"] = saved
         shutil.rmtree(tmp, ignore_errors=True)
     return out
+
+
+def run_serving_arena_section(small: bool) -> dict:
+    """Round-16 shared-memory arena A/B (ISSUE 16): the ONE factor store
+    behind all three planes, measured against the dict + per-row-push
+    baseline.  Three subsections, each with its own degrade key:
+
+      get        native GET p50/p99 at 64 in flight against the C++
+                 server mapping the arena DIRECTLY (zero per-request
+                 Python->C++ pushes) vs the same server fed row-by-row
+                 from a dict table.  Headline:
+                 ``serving_arena_get_b2_c64_p50_us``.
+      publish    snapshot publish wall-clock at the loaded row count:
+                 dict columnar serialize vs arena quiesce copy vs arena
+                 O(1) hardlink publish.  ``serving_arena_reflink`` says
+                 whether the filesystem can reflink (FICLONE) — without
+                 it the copy arm is bandwidth-bound and only the link
+                 arm can show the O(1) win; the speedups reported are
+                 what THIS box measured, not the reflink ceiling.
+      visibility in-place arena write -> C++-reader queryable, p99 over
+                 probes (the zero-copy freshness path: no socket, no
+                 snapshot, just the seqlock row flip).
+
+    A box with fewer cores than the writer+reader+bench processes needs
+    records ``serving_arena_core_starved`` so slow numbers read as
+    "unmeasurable here", not regressions."""
+    import random
+
+    from flink_ms_tpu.serve.arena import ArenaModelTable
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve import snapshot as snapshot_mod
+    from flink_ms_tpu.serve.table import ModelTable
+
+    out: dict = {}
+    n_rows = int(os.environ.get("BENCH_ARENA_ROWS",
+                                5_000 if small else 1_000_000))
+    get_total = int(os.environ.get("BENCH_ARENA_GETS",
+                                   2_000 if small else 20_000))
+    n_probes = int(os.environ.get("BENCH_ARENA_PROBES",
+                                  50 if small else 200))
+    dim = 16
+    rng = np.random.default_rng(16)
+    tmp = tempfile.mkdtemp(prefix="bench_arena_")
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n_cpus = os.cpu_count() or 1
+    out["serving_arena_rows"] = n_rows
+    out["serving_arena_cpus"] = n_cpus
+    if n_cpus < 3:
+        out["serving_arena_core_starved"] = True
+
+    def payload(vec):
+        return ";".join(repr(round(float(x), 4)) for x in vec)
+
+    # does this filesystem reflink?  (FICLONE on a scratch pair — the
+    # honesty flag for the publish-copy arm)
+    try:
+        import fcntl
+
+        src = os.path.join(tmp, "rl-src")
+        with open(src, "wb") as f:
+            f.write(b"x" * 4096)
+        with open(src, "rb") as s, open(os.path.join(tmp, "rl-dst"),
+                                        "wb") as d:
+            fcntl.ioctl(d.fileno(), 0x40049409, s.fileno())
+        out["serving_arena_reflink"] = True
+    except OSError:
+        out["serving_arena_reflink"] = False
+
+    keys = [f"{u}-U" for u in range(n_rows)]
+    vals = [payload(rng.normal(size=dim)) for _ in range(n_rows)]
+
+    # -- ingest + native GET through the mmap ----------------------------
+    table = None
+    try:
+        from flink_ms_tpu.serve.native_store import (NativeArena,
+                                                     NativeLookupServer)
+
+        table = ArenaModelTable(8, dir=os.path.join(tmp, "arena"))
+        t0 = time.perf_counter()
+        for i in range(0, n_rows, 8192):
+            table.put_many_columns(keys[i:i + 8192], vals[i:i + 8192])
+        out["serving_arena_ingest_rows_per_s"] = round(
+            n_rows / (time.perf_counter() - t0))
+        with NativeArena(table.dir) as arena_h, \
+                NativeLookupServer(arena_h, ALS_STATE, job_id="bench-arena",
+                                   port=0) as nsrv:
+            qps, p50 = _get_loop(nsrv.port, ALS_STATE, keys,
+                                 min(get_total, 4_000), "b2")
+            out["serving_arena_get_b2_c1_qps"] = qps
+            out["serving_arena_get_b2_c1_p50_us"] = p50
+            for win in (16, 64):
+                qps, p50 = _get_pipelined(nsrv.port, ALS_STATE, keys, win,
+                                          max(get_total // win, 20), "b2")
+                out[f"serving_arena_get_b2_c{win}_qps"] = qps
+                out[f"serving_arena_get_b2_c{win}_p50_us"] = p50
+            _log(f"[bench:arena] GET b2: c1 "
+                 f"{out['serving_arena_get_b2_c1_qps']} qps, c64 "
+                 f"{out['serving_arena_get_b2_c64_qps']} qps / "
+                 f"{out['serving_arena_get_b2_c64_p50_us']} us/req p50")
+
+            # -- write -> queryable visibility through the C++ reader ----
+            vis_ms = []
+            rnd = random.Random(16)
+            for i in range(n_probes):
+                key = keys[rnd.randrange(n_rows)]
+                new_val = payload(rng.normal(size=dim))
+                t0 = time.perf_counter()
+                table.put(key, new_val)
+                deadline = t0 + 5.0
+                while time.perf_counter() < deadline:
+                    if arena_h.get(key) == new_val:
+                        vis_ms.append((time.perf_counter() - t0) * 1e3)
+                        break
+            out["serving_arena_visibility_probes"] = len(vis_ms)
+            out.update({f"serving_arena_visibility_{q}_ms": v
+                        for q, v in _pcts(vis_ms).items()})
+            _log(f"[bench:arena] visibility: {len(vis_ms)}/{n_probes} "
+                 f"probes, p99 "
+                 f"{out.get('serving_arena_visibility_p99_ms')} ms")
+    except Exception:
+        _log(traceback.format_exc())
+        out["serving_arena_get_error"] = traceback.format_exc(limit=3)
+
+    # -- publish A/B/C at the same row count -----------------------------
+    try:
+        dict_t = ModelTable(8)
+        for i in range(0, n_rows, 8192):
+            dict_t.put_many_columns(keys[i:i + 8192], vals[i:i + 8192])
+        t0 = time.perf_counter()
+        snapshot_mod.publish(os.path.join(tmp, "snap-dict"), dict_t,
+                             n_rows, shard=0, num_shards=1)
+        dict_s = time.perf_counter() - t0
+        out["serving_arena_publish_dict_ms"] = round(dict_s * 1e3, 2)
+        if table is None:
+            table = ArenaModelTable(8, dir=os.path.join(tmp, "arena"))
+            for i in range(0, n_rows, 8192):
+                table.put_many_columns(keys[i:i + 8192], vals[i:i + 8192])
+        for mode in ("copy", "link"):
+            table.publish_mode = mode
+            t0 = time.perf_counter()
+            snapshot_mod.publish(os.path.join(tmp, f"snap-{mode}"), table,
+                                 n_rows, shard=0, num_shards=1)
+            mode_s = time.perf_counter() - t0
+            out[f"serving_arena_publish_{mode}_ms"] = round(
+                mode_s * 1e3, 2)
+            out[f"serving_arena_publish_{mode}_speedup_x"] = round(
+                dict_s / max(mode_s, 1e-9), 2)
+        _log(f"[bench:arena] publish @{n_rows} rows: dict "
+             f"{out['serving_arena_publish_dict_ms']} ms, copy "
+             f"{out['serving_arena_publish_copy_ms']} ms "
+             f"({out['serving_arena_publish_copy_speedup_x']}x), link "
+             f"{out['serving_arena_publish_link_ms']} ms "
+             f"({out['serving_arena_publish_link_speedup_x']}x), "
+             f"reflink={out.get('serving_arena_reflink')}")
+    except Exception:
+        _log(traceback.format_exc())
+        out["serving_arena_publish_error"] = traceback.format_exc(limit=3)
+    finally:
+        if table is not None:
+            try:
+                table.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out

@@ -239,6 +239,20 @@ def fleet_signals(before: dict, after: dict,
          "georepl_lag_seconds": WORST follower staleness at AFTER (max
                            over ``tpums_georepl_lag_seconds`` — a fleet
                            sum of times means nothing)}
+
+    Shared-memory arena plane (round 16 — ``serve/arena.py``):
+
+        {"arena_resident_bytes": fleet-summed resident arena pages at
+                           AFTER (both the Python writer's gauge and the
+                           C++ server's METRICS splice feed this),
+         "arena_read_retries_per_s": seqlock read retries/s over the
+                           window — sustained retries mean hot-row write
+                           contention on the lock-free read path,
+         "arena_load_factor": WORST index load factor at AFTER (growth/
+                           rehash predictor),
+         "arena_publish_seconds": newest O(state) snapshot publish
+                           latency (max across workers; None until an
+                           arena snapshot has published)}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -356,6 +370,24 @@ def fleet_signals(before: dict, after: dict,
     georepl_lag_seconds = max(
         (g["value"] for g in after.get("gauges", [])
          if g["name"] == "tpums_georepl_lag_seconds"), default=0.0)
+    # shared-memory arena plane (round 16 — serve/arena.py): resident
+    # bytes SUM across workers (fleet memory footprint), seqlock read
+    # retries as a RATE (sustained retries = hot-row write contention on
+    # the lock-free read path), index load factor and publish latency as
+    # WORST-case maxes (the former predicts growth/rehash, the latter is
+    # the O(state) publish promise being kept or broken)
+    arena_resident = sum(
+        g["value"] for g in after.get("gauges", [])
+        if g["name"] == "tpums_arena_resident_bytes")
+    arena_retries = max(
+        _counter_total(after, "tpums_arena_read_retries_total")
+        - _counter_total(before, "tpums_arena_read_retries_total"), 0.0)
+    arena_load_factor = max(
+        (g["value"] for g in after.get("gauges", [])
+         if g["name"] == "tpums_arena_index_load_factor"), default=0.0)
+    arena_publish_s = max(
+        (g["value"] for g in after.get("gauges", [])
+         if g["name"] == "tpums_arena_publish_seconds"), default=None)
     return {
         **autopilot,
         "qps": requests / dt_s,
@@ -374,6 +406,10 @@ def fleet_signals(before: dict, after: dict,
         "forensics_staleness_s": forensics_staleness,
         "georepl_lag_bytes": georepl_lag_bytes,
         "georepl_lag_seconds": georepl_lag_seconds,
+        "arena_resident_bytes": arena_resident,
+        "arena_read_retries_per_s": arena_retries / dt_s,
+        "arena_load_factor": arena_load_factor,
+        "arena_publish_seconds": arena_publish_s,
         "dt_s": dt_s,
         "requests": requests,
     }

@@ -83,6 +83,13 @@ class MemoryStateBackend:
         self._snap: Optional[Tuple[int, List[dict]]] = None
 
     def snapshot(self, table: ModelTable, offset: int) -> None:
+        if not hasattr(table, "_shards"):
+            # arena table: the rows live in the mmap'd file and survive a
+            # consume-loop restart on their own — the offset marker is the
+            # whole snapshot (replay from it is LWW-idempotent)
+            table.flush()
+            self._snap = (offset, None)
+            return
         with table._lock:
             self._snap = (offset, [dict(s) for s in table._shards])
 
@@ -90,6 +97,8 @@ class MemoryStateBackend:
         if self._snap is None:
             return None
         offset, shards = self._snap
+        if shards is None:
+            return offset
         with table._lock:
             table._shards = [dict(s) for s in shards]
         return offset
@@ -175,6 +184,7 @@ class ServingJob:
         snapshots: Optional[bool] = None,
         snapshot_min_bytes: Optional[int] = None,
         compact: Optional[bool] = None,
+        table: Optional[str] = None,
     ):
         if start_from not in ("earliest", "latest"):
             raise ValueError("start_from must be earliest|latest")
@@ -194,9 +204,35 @@ class ServingJob:
         self.host = host
         self.parse_fn = parse_fn
         self.backend = backend
+        # which table implementation holds the factors (--table /
+        # TPUMS_TABLE): "dict" (default) is the in-RAM sharded ModelTable
+        # (or the backend's own durable table for rocksdb); "arena" is the
+        # shared-memory mmap arena (serve/arena.py) the C++ server and the
+        # snapshotter read zero-copy
+        if table is None:
+            table = os.environ.get("TPUMS_TABLE", "dict")
+        if table not in ("dict", "arena"):
+            raise ValueError("table must be dict|arena")
+        self.table_kind = table
+        _sf = getattr(parse_fn, "shard_filter", None)
+        self._snap_owner = (int(_sf[0]), int(_sf[1])) if _sf else (0, 1)
+        if table == "arena":
+            from .arena import ArenaModelTable
+
+            # one writer per arena (flock): the dir is disambiguated along
+            # every axis a fleet multiplies on over a shared journal —
+            # state name, worker shard, replica index, topology generation
+            arena_dir = os.path.join(
+                journal.dir,
+                "{}.arena-{}-w{}of{}-r{}-g{}".format(
+                    journal.topic, state_name, self._snap_owner[0],
+                    self._snap_owner[1], replica_index or 0,
+                    generation or 0),
+            )
+            self.table = ArenaModelTable(n_shards, dir=arena_dir)
         # the native (rocksdb-parity) backend provides its own durable table;
         # memory/fs back a plain in-RAM sharded table
-        if hasattr(backend, "make_table"):
+        elif hasattr(backend, "make_table"):
             self.table = backend.make_table(n_shards)
         else:
             self.table = ModelTable(n_shards)
@@ -236,9 +272,9 @@ class ServingJob:
         # O(state) artifact, so snapshots apply to the in-RAM tables only.
         if snapshots is None:
             snapshots = os.environ.get("TPUMS_SNAPSHOTS", "1") != "0"
-        _sf = getattr(parse_fn, "shard_filter", None)
-        self._snap_owner = (int(_sf[0]), int(_sf[1])) if _sf else (0, 1)
-        self._snapshots_on = bool(snapshots) and hasattr(self.table, "_shards")
+        self._snapshots_on = bool(snapshots) and (
+            hasattr(self.table, "_shards") or self.table_kind == "arena"
+        )
         self._snap_root = snapshot_mod.snapshot_root(journal.dir, journal.topic)
         if snapshot_min_bytes is None:
             try:
@@ -324,12 +360,22 @@ class ServingJob:
         self._stop = threading.Event()
         self._consumer_thread: Optional[threading.Thread] = None
         self._hb_thread: Optional[threading.Thread] = None
+        self._native_arena = None
         if native_server:
             # C++ epoll data plane reading the persistent store directly —
-            # requires the native (rocksdb) backend, which owns the store
+            # requires the native (rocksdb) backend, which owns the store,
+            # OR the shared-memory arena table, which the server maps
+            # read-only (zero per-row pushes; tag-dispatched handle)
             from .native_store import NativeLookupServer
 
-            if not hasattr(backend, "store"):
+            if self.table_kind == "arena":
+                from .native_store import NativeArena
+
+                self._native_arena = NativeArena(self.table.dir)
+                serve_handle = self._native_arena
+            elif hasattr(backend, "store"):
+                serve_handle = backend.store
+            else:
                 # either the wrong backend kind was requested, or rocksdb WAS
                 # requested but degraded to fs because the native build is
                 # unavailable (make_backend printed the cause)
@@ -340,7 +386,7 @@ class ServingJob:
                     "(see the warning above for the build error)"
                 )
             self.server = NativeLookupServer(
-                backend.store, state_name, job_id=self.job_id,
+                serve_handle, state_name, job_id=self.job_id,
                 host=host, port=port,
                 # ALS planes serve the full verb set natively: TOPK/TOPKV
                 # score the "-I" catalog straight from the store (the
@@ -713,6 +759,16 @@ class ServingJob:
         if self._consumer_thread:
             self._consumer_thread.join(timeout=10)
         self.server.stop()
+        if self._native_arena is not None:
+            # after server.stop(): no reader thread may touch the mapping
+            self._native_arena.close()
+        if self.table_kind == "arena" and (
+            self._consumer_thread is None
+            or not self._consumer_thread.is_alive()
+        ):
+            # releases the writer flock; a wedged consumer thread leaks the
+            # mapping instead (the flock dies with the process)
+            self.table.close()
         if hasattr(self.backend, "close"):
             # never free the native store under a still-running consumer
             # thread (use-after-free); a wedged thread leaks the handle
@@ -1105,6 +1161,7 @@ def _run_consumer_cli(params: Params, state_name: str, parse_fn) -> ServingJob:
         ),
         snapshot_min_bytes=params.get_int("snapshotMinBytes"),
         compact=params.get_bool("compact") if params.has("compact") else None,
+        table=params.get("table"),  # dict (default) | arena; TPUMS_TABLE env
     )
     print(
         f"[serve] {state_name} serving topic '{journal.topic}' on port "

@@ -399,12 +399,24 @@ class JournalReplicator:
             tmp = os.path.join(dst_root, f".georepl-{os.getpid()}-{name}")
             shutil.rmtree(tmp, ignore_errors=True)
             try:
-                shutil.copytree(src, tmp)
+                shutil.copytree(src, tmp, copy_function=self._copy_member)
                 os.rename(tmp, dst)
                 copied += 1
             except OSError:
                 shutil.rmtree(tmp, ignore_errors=True)
         return copied
+
+    @staticmethod
+    def _copy_member(src: str, dst: str) -> None:
+        """Arena members (sparse mmap images) ship reflink/hole-aware so a
+        mostly-empty slab costs its resident bytes, not its capacity;
+        everything else takes the ordinary copy."""
+        if src.endswith(".dat"):
+            from .arena import clone_file
+
+            clone_file(src, dst)
+        else:
+            shutil.copy2(src, dst)
 
     # -- lag + status record ----------------------------------------------
 

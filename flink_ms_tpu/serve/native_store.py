@@ -46,7 +46,8 @@ def _load_lib():
         native_dir = os.path.abspath(_NATIVE_DIR)
         sources = [
             os.path.join(native_dir, n)
-            for n in ("store.cpp", "lookup_server.cpp", "tpums.h", "Makefile")
+            for n in ("store.cpp", "lookup_server.cpp", "arena.cpp",
+                      "tpums.h", "tpums_internal.h", "Makefile")
         ]
         stale = not os.path.exists(_SO_PATH) or any(
             os.path.exists(src)
@@ -116,6 +117,18 @@ def _declare_abi(lib):
     lib.tpums_ingest_buf.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.tpums_arena_open.restype = ctypes.c_void_p
+    lib.tpums_arena_open.argtypes = [ctypes.c_char_p]
+    lib.tpums_arena_refresh.restype = ctypes.c_int
+    lib.tpums_arena_refresh.argtypes = [ctypes.c_void_p]
+    lib.tpums_arena_read_retries.restype = ctypes.c_uint64
+    lib.tpums_arena_read_retries.argtypes = [ctypes.c_void_p]
+    lib.tpums_arena_stats.restype = ctypes.c_int
+    lib.tpums_arena_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
     ]
     lib.tpums_server_start.restype = ctypes.c_void_p
     lib.tpums_server_start.argtypes = [
@@ -395,6 +408,91 @@ class NativeModelTable:
         for k, v in self.store.items():
             if not k.startswith("\x01"):
                 yield k, v
+
+
+class NativeArena:
+    """Read-only handle onto a shared-memory factor arena (serve/arena.py)
+    written in place by the consumer's mmap.  The handle is interchangeable
+    with a NativeStore for every READ verb — ``tpums_get``/``tpums_count``/
+    ``tpums_keys_chunk``/... dispatch on the leading handle tag — so
+    ``NativeLookupServer(NativeArena(dir), ...)`` serves GET/MGET/B2 and
+    builds TOPK/DOT indexes straight from the shared pages with zero
+    per-request (or per-row) Python→C++ pushes.  Mutating verbs fail: the
+    Python writer owns the pages.
+    """
+
+    def __init__(self, directory: str):
+        self._lib = _load_lib()
+        os.makedirs(directory, exist_ok=True)
+        self._h = self._lib.tpums_arena_open(directory.encode("utf-8"))
+        if not self._h:
+            raise OSError(f"tpums_arena_open failed for {directory}")
+        self.directory = directory
+        self._call_lock = threading.RLock()
+
+    def _live_handle(self):
+        h = self._h
+        if not h:
+            raise OSError(f"arena {self.directory} is closed")
+        return h
+
+    def refresh(self) -> bool:
+        """Force a remap check (normally implicit per read).  False while
+        no generation file exists yet (writer not started)."""
+        with self._call_lock:
+            return self._lib.tpums_arena_refresh(self._live_handle()) == 0
+
+    def get(self, key: str) -> Optional[str]:
+        k = key.encode("utf-8")
+        vlen = ctypes.c_uint32()
+        err = ctypes.c_int()
+        with self._call_lock:
+            p = self._lib.tpums_get(
+                self._live_handle(), k, len(k), ctypes.byref(vlen),
+                ctypes.byref(err),
+            )
+        if not p:
+            return None  # torn/odd slots read as missing, never as an error
+        try:
+            return ctypes.string_at(p, vlen.value).decode("utf-8")
+        finally:
+            self._lib.tpums_free_buf(p)
+
+    def __len__(self) -> int:
+        with self._call_lock:
+            return int(self._lib.tpums_count(self._live_handle()))
+
+    @property
+    def read_retries(self) -> int:
+        """Cumulative seqlock read retries (torn/odd slots observed)."""
+        with self._call_lock:
+            return int(
+                self._lib.tpums_arena_read_retries(self._live_handle()))
+
+    def stats(self) -> dict:
+        """Gauge snapshot: rows / capacity / resident_bytes / retries /
+        load_factor (all 0 while the writer has not created the arena)."""
+        vals = [ctypes.c_double(0.0) for _ in range(5)]
+        with self._call_lock:
+            rc = self._lib.tpums_arena_stats(
+                self._live_handle(), *[ctypes.byref(v) for v in vals])
+        if rc != 0:
+            raise OSError("tpums_arena_stats failed (not an arena handle?)")
+        names = ("rows", "capacity", "resident_bytes", "retries",
+                 "load_factor")
+        return {n: v.value for n, v in zip(names, vals)}
+
+    def close(self) -> None:
+        with self._call_lock:
+            if self._h:
+                self._lib.tpums_close(self._h)
+                self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class NativeLookupServer:

@@ -815,6 +815,15 @@ class UpdateWorker:
                 "update_publish", tid=tid, psid=apply_sid, t0=t_pub0,
                 dur_s=round(time.time() - t_pub0, 9),
                 worker=self.worker_index, rows=len(rows))
+        # co-located arena table: seqlock-update the shared rows in place
+        # right now — update -> queryable visibility stops round-tripping
+        # through the journal (the journal stays the durability source;
+        # the consume loop's later LWW replay of these same rows is a
+        # no-op rewrite).  Safe because the worker holds the table OBJECT
+        # (and with it the arena's writer flock), never a second mapping.
+        direct = getattr(self._table, "kind", "") == "arena"
+        direct_keys: List[str] = []
+        direct_vals: List[str] = []
         probe_key = probe_payload = None
         for row in rows:
             try:
@@ -823,9 +832,14 @@ class UpdateWorker:
                 continue
             key = f"{id_}-{typ}"
             self._overlay[key] = vec_s
+            if direct:
+                direct_keys.append(key)
+                direct_vals.append(vec_s)
             if typ == F.USER and owner_of(
                     key, self.num_workers) == self.worker_index:
                 probe_key, probe_payload = key, vec_s
+        if direct and direct_keys:
+            self._table.put_many_columns(direct_keys, direct_vals)
         if len(self._overlay) > 65536:
             self._overlay.clear()
         part.next_seq = seq_to

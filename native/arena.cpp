@@ -1,0 +1,343 @@
+// tpums shared-memory arena reader — the native half of the zero-copy
+// factor store (flink_ms_tpu/serve/arena.py owns the format and the single
+// writer; this unit maps the same file read-only and answers lookups with
+// per-row seqlock retry, no lock and no syscall on the hot path).
+//
+// File layout (little-endian; authoritative doc in serve/arena.py):
+//   [0:64)  header: "TPMA" | version u32 | capacity u64 | stride u32 |
+//           key_cap u32 | count u64 | generation u64 | retired u32 |
+//           pad u32 | mutations u64
+//   [64:..) capacity slots of ceil8(12 + key_cap + stride) bytes:
+//           seq u32 | klen u32 | vlen u32 | key[key_cap] | value[stride]
+//
+// Seqlock read: s1 = acquire-load(seq); 0 -> probe-chain end; odd -> the
+// writer is mid-row (or died there) — bounded retry, then treat the slot
+// as holding nothing and keep probing; copy, fence, re-load; s1 != s2 ->
+// torn, retry.  A reader therefore NEVER returns a torn value: a SIGKILLed
+// writer leaves an odd seq, which reads as key-missing until the respawned
+// consumer's journal replay rewrites the row.  The writer is CPython
+// storing through mmap on x86 (TSO store order); the acquire loads here
+// are the matching read-side discipline.
+//
+// Growth: the writer builds generation g+1, repoints CURRENT, then flips
+// the old header's `retired` flag.  Readers check the flag per lookup
+// (one load) and remap through CURRENT; superseded mappings stay mapped
+// until tpums_close so in-flight readers on other threads never fault.
+//
+// Handles dispatch through the public store API (tpums_get/tpums_count/
+// tpums_keys_chunk/...) via the tag in tpums_internal.h, which is what
+// lets lookup_server.cpp serve GET/MGET/B2 — and build its TOPK/DOT
+// indexes — straight from the mmap with zero per-request Python pushes.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "tpums.h"
+#include "tpums_internal.h"
+
+namespace {
+
+constexpr uint64_t kHeaderSize = 64;
+constexpr uint64_t kSlotHdr = 12;
+constexpr int kMaxSeqRetries = 64;
+
+struct Mapping {
+  uint8_t* base = nullptr;
+  size_t size = 0;
+  uint64_t capacity = 0;
+  uint32_t stride = 0;
+  uint32_t key_cap = 0;
+  uint64_t slot_size = 0;
+  std::string path;
+};
+
+struct ArenaHandle {
+  uint32_t tag = kTpumsArenaTag;
+  std::string dir;
+  std::mutex remap_mu;
+  std::atomic<Mapping*> cur{nullptr};
+  std::vector<Mapping*> superseded;  // unmapped only at close
+  std::atomic<uint64_t> retries{0};
+};
+
+uint32_t fnv1a(const char* k, uint32_t klen) {
+  uint32_t h = 0x811C9DC5u;
+  for (uint32_t i = 0; i < klen; ++i) {
+    h ^= static_cast<uint8_t>(k[i]);
+    h *= 0x01000193u;
+  }
+  return h;
+}
+
+inline uint32_t load_u32_acq(const uint8_t* p) {
+  return __atomic_load_n(reinterpret_cast<const uint32_t*>(p),
+                         __ATOMIC_ACQUIRE);
+}
+
+inline uint64_t load_u64(const uint8_t* p) {
+  return __atomic_load_n(reinterpret_cast<const uint64_t*>(p),
+                         __ATOMIC_RELAXED);
+}
+
+Mapping* map_file(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(kHeaderSize)) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                    MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  uint8_t* b = static_cast<uint8_t*>(base);
+  if (memcmp(b, "TPMA", 4) != 0) {
+    munmap(base, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  Mapping* m = new Mapping();
+  m->base = b;
+  m->size = static_cast<size_t>(st.st_size);
+  memcpy(&m->capacity, b + 8, 8);
+  memcpy(&m->stride, b + 16, 4);
+  memcpy(&m->key_cap, b + 20, 4);
+  m->slot_size = (kSlotHdr + m->key_cap + m->stride + 7) & ~7ull;
+  m->path = path;
+  if (kHeaderSize + m->capacity * m->slot_size > m->size) {
+    munmap(base, m->size);
+    delete m;
+    return nullptr;
+  }
+  return m;
+}
+
+std::string read_current(const std::string& dir) {
+  int fd = ::open((dir + "/CURRENT").c_str(), O_RDONLY);
+  if (fd < 0) return "";
+  char buf[256];
+  ssize_t n = read(fd, buf, sizeof(buf) - 1);
+  close(fd);
+  if (n <= 0) return "";
+  buf[n] = 0;
+  std::string name(buf);
+  while (!name.empty() && (name.back() == '\n' || name.back() == ' '))
+    name.pop_back();
+  return name.empty() ? "" : dir + "/" + name;
+}
+
+// The live mapping, remapping through CURRENT when the writer retired the
+// generation we hold (or nothing was mapped yet — the server can start
+// before the consumer's first row lands).
+Mapping* live_mapping(ArenaHandle* a) {
+  Mapping* m = a->cur.load(std::memory_order_acquire);
+  if (m != nullptr && load_u32_acq(m->base + 40) == 0) return m;
+  std::lock_guard<std::mutex> g(a->remap_mu);
+  m = a->cur.load(std::memory_order_acquire);
+  if (m != nullptr && load_u32_acq(m->base + 40) == 0) return m;
+  std::string path = read_current(a->dir);
+  if (path.empty() || (m != nullptr && path == m->path)) return m;
+  Mapping* fresh = map_file(path);
+  if (fresh == nullptr) return m;
+  if (m != nullptr) a->superseded.push_back(m);
+  a->cur.store(fresh, std::memory_order_release);
+  return fresh;
+}
+
+// Seqlock-copy slot `idx` into key/val.  Returns 1 on a stable row, 0 when
+// the slot is empty (chain end for lookups), -1 when it holds nothing
+// readable (mid-write/odd-stuck/torn past the retry budget).
+int read_slot(ArenaHandle* a, const Mapping* m, uint64_t idx,
+              std::string* key, std::string* val) {
+  const uint8_t* slot = m->base + kHeaderSize + idx * m->slot_size;
+  for (int t = 0; t < kMaxSeqRetries; ++t) {
+    uint32_t s1 = load_u32_acq(slot);
+    if (s1 == 0) return 0;
+    if (s1 & 1) {
+      a->retries.fetch_add(1, std::memory_order_relaxed);
+      continue;
+    }
+    uint32_t klen, vlen;
+    memcpy(&klen, slot + 4, 4);
+    memcpy(&vlen, slot + 8, 4);
+    if (klen > m->key_cap || vlen > m->stride) {
+      a->retries.fetch_add(1, std::memory_order_relaxed);
+      continue;  // header torn mid-claim
+    }
+    key->assign(reinterpret_cast<const char*>(slot + kSlotHdr), klen);
+    val->assign(reinterpret_cast<const char*>(slot + kSlotHdr + m->key_cap),
+                vlen);
+    std::atomic_thread_fence(std::memory_order_acquire);
+    uint32_t s2 = load_u32_acq(slot);
+    if (s1 == s2) return 1;
+    a->retries.fetch_add(1, std::memory_order_relaxed);
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tpums_arena_open(const char* dir) {
+  ArenaHandle* a = new ArenaHandle();
+  a->dir = dir;
+  std::string path = read_current(a->dir);
+  if (!path.empty()) {
+    Mapping* m = map_file(path);
+    if (m != nullptr) a->cur.store(m, std::memory_order_release);
+  }
+  // a missing CURRENT is not fatal: the handle attaches lazily on first
+  // read (server started before the consumer created the table)
+  return a;
+}
+
+int tpums_arena_refresh(void* h) {
+  if (!tpums_is_arena(h)) return -1;
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  return live_mapping(a) != nullptr ? 0 : -1;
+}
+
+uint64_t tpums_arena_read_retries(void* h) {
+  if (!tpums_is_arena(h)) return 0;
+  return static_cast<ArenaHandle*>(h)->retries.load(
+      std::memory_order_relaxed);
+}
+
+int tpums_arena_stats(void* h, double* rows, double* capacity,
+                      double* resident_bytes, double* retries,
+                      double* load_factor) {
+  if (!tpums_is_arena(h)) return -1;
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = live_mapping(a);
+  double r = 0, c = 0, res = 0;
+  if (m != nullptr) {
+    r = static_cast<double>(load_u64(m->base + 24));
+    c = static_cast<double>(m->capacity);
+    struct stat st;
+    if (stat(m->path.c_str(), &st) == 0)
+      res = static_cast<double>(st.st_blocks) * 512.0;
+  }
+  if (rows) *rows = r;
+  if (capacity) *capacity = c;
+  if (resident_bytes) *resident_bytes = res;
+  if (retries)
+    *retries = static_cast<double>(
+        a->retries.load(std::memory_order_relaxed));
+  if (load_factor) *load_factor = c > 0 ? r / c : 0.0;
+  return 0;
+}
+
+}  // extern "C"
+
+// -- dispatch targets (store.cpp routes arena-tagged handles here) ---------
+
+char* tpums_arena_get_impl(void* h, const char* k, uint32_t klen,
+                           uint32_t* vlen_out, int* err_out) {
+  if (err_out) *err_out = 0;
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = live_mapping(a);
+  if (m == nullptr || klen > m->key_cap) return nullptr;
+  uint64_t idx = fnv1a(k, klen) % m->capacity;
+  std::string key, val;
+  for (uint64_t probes = 0; probes < m->capacity; ++probes) {
+    int rc = read_slot(a, m, idx, &key, &val);
+    if (rc == 0) return nullptr;  // empty slot: chain end, key missing
+    if (rc == 1 && key.size() == klen && memcmp(key.data(), k, klen) == 0) {
+      char* buf = static_cast<char*>(malloc(val.size() ? val.size() : 1));
+      if (!buf) {
+        if (err_out) *err_out = 1;
+        return nullptr;
+      }
+      memcpy(buf, val.data(), val.size());
+      *vlen_out = static_cast<uint32_t>(val.size());
+      return buf;
+    }
+    // rc == -1 (odd-stuck/torn): the slot holds no readable row — keep
+    // probing; a repaired duplicate of the dead claim lives further on
+    if (++idx == m->capacity) idx = 0;
+  }
+  return nullptr;
+}
+
+uint64_t tpums_arena_count_impl(void* h) {
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = live_mapping(a);
+  return m == nullptr ? 0 : load_u64(m->base + 24);
+}
+
+int tpums_arena_keys_impl(void* h, tpums_key_cb cb, void* ctx) {
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = live_mapping(a);
+  if (m == nullptr) return 0;
+  std::string key, val;
+  for (uint64_t idx = 0; idx < m->capacity; ++idx) {
+    if (read_slot(a, m, idx, &key, &val) == 1)
+      cb(key.data(), static_cast<uint32_t>(key.size()), ctx);
+  }
+  return 0;
+}
+
+uint64_t tpums_arena_keys_chunk_impl(void* h, uint64_t* cursor,
+                                     uint64_t max_keys, tpums_key_cb cb,
+                                     void* ctx) {
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = live_mapping(a);
+  if (m == nullptr) {
+    return 0;
+  }
+  uint64_t emitted = 0;
+  uint64_t idx = *cursor;
+  std::string key, val;
+  for (; idx < m->capacity && emitted < max_keys; ++idx) {
+    if (read_slot(a, m, idx, &key, &val) == 1) {
+      cb(key.data(), static_cast<uint32_t>(key.size()), ctx);
+      ++emitted;
+    }
+  }
+  *cursor = idx;
+  return emitted;
+}
+
+uint64_t tpums_arena_log_bytes_impl(void* h) {
+  // The store's log_bytes is its index-version proxy (top-k/DOT builders
+  // pair it with count to detect churn).  In-place arena updates move
+  // neither count nor file size, so the writer bumps a header mutation
+  // counter — report that, preserving "changed bytes == changed state".
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = live_mapping(a);
+  return m == nullptr ? 0 : load_u64(m->base + 48);
+}
+
+uint64_t tpums_arena_live_bytes_impl(void* h) {
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = live_mapping(a);
+  if (m == nullptr) return 0;
+  struct stat st;
+  if (stat(m->path.c_str(), &st) != 0) return 0;
+  return static_cast<uint64_t>(st.st_blocks) * 512ull;
+}
+
+void tpums_arena_close_impl(void* h) {
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  Mapping* m = a->cur.load(std::memory_order_acquire);
+  if (m != nullptr) {
+    munmap(m->base, m->size);
+    delete m;
+  }
+  for (Mapping* old : a->superseded) {
+    munmap(old->base, old->size);
+    delete old;
+  }
+  delete a;
+}

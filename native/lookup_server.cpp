@@ -1038,7 +1038,28 @@ std::string metrics_reply(ServerState* s) {
     escape_json_into(j, kv.first);
     j += "\"},\"value\":" + std::to_string(kv.second.count) + "}";
   }
-  j += "],\"gauges\":[],\"histograms\":[";
+  // arena-backed server: the shared-store gauges + the lock-free path's
+  // retry counter ride the same snapshot (obs/scrape fleet_signals reads
+  // them off either plane — the Python writer exports the same names)
+  double a_rows, a_cap, a_res, a_retry, a_lf;
+  bool is_arena = tpums_arena_stats(s->store, &a_rows, &a_cap, &a_res,
+                                    &a_retry, &a_lf) == 0;
+  if (is_arena) {
+    if (!first) j.push_back(',');
+    first = false;
+    j += "{\"name\":\"tpums_arena_read_retries_total\",\"labels\":{},"
+         "\"value\":" + std::to_string(static_cast<uint64_t>(a_retry)) + "}";
+  }
+  j += "],\"gauges\":[";
+  if (is_arena) {
+    j += "{\"name\":\"tpums_arena_rows\",\"labels\":{},\"value\":" +
+         std::to_string(static_cast<uint64_t>(a_rows)) +
+         "},{\"name\":\"tpums_arena_resident_bytes\",\"labels\":{},"
+         "\"value\":" + std::to_string(static_cast<uint64_t>(a_res)) +
+         "},{\"name\":\"tpums_arena_index_load_factor\",\"labels\":{},"
+         "\"value\":" + format_score_d(a_lf) + "}";
+  }
+  j += "],\"histograms\":[";
   std::string le;
   for (double b : s->lat_bounds) {
     if (!le.empty()) le.push_back(',');

@@ -32,6 +32,7 @@
 #include <unistd.h>
 
 #include "tpums.h"  // signature check against the shared public API
+#include "tpums_internal.h"
 
 namespace {
 
@@ -41,6 +42,8 @@ struct Entry {
 };
 
 struct Store {
+  uint32_t tag = kTpumsStoreTag;  // handle dispatch (tpums_internal.h):
+                                  // arena handles share the read API
   std::string dir;
   std::string log_path;
   int fd = -1;
@@ -202,13 +205,15 @@ void* tpums_open(const char* dir) {
 int tpums_put(void* h, const char* k, uint32_t klen, const char* v,
               uint32_t vlen) {
   if (!h || vlen == kTombstone) return -1;
+  if (tpums_is_arena(h)) return -1;  // arena rows are written in place by
+                                     // the consumer's mmap, never pushed
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return append_record(s, k, klen, v, vlen);
 }
 
 int tpums_delete(void* h, const char* k, uint32_t klen) {
-  if (!h) return -1;
+  if (!h || tpums_is_arena(h)) return -1;
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return append_record(s, k, klen, nullptr, kTombstone);
@@ -222,7 +227,7 @@ int tpums_ingest_buf(void* h, const char* buf, uint64_t len, int mode,
   // syscalls per record (the measured ingest bottleneck).  Malformed
   // rows (and key/value-limit violations) are counted and skipped, the
   // deliberate skip-and-count policy of the serving loop.
-  if (!h || (mode != 0 && mode != 1)) return -1;
+  if (!h || tpums_is_arena(h) || (mode != 0 && mode != 1)) return -1;
   Store* s = static_cast<Store*>(h);
   uint64_t rows = 0, errs = 0;
   std::string key;  // reused across rows (ALS key is id + '-' + type)
@@ -327,6 +332,8 @@ char* tpums_get(void* h, const char* k, uint32_t klen, uint32_t* vlen_out,
                 int* err_out) {
   if (err_out) *err_out = 0;
   if (!h) return nullptr;
+  if (tpums_is_arena(h))
+    return tpums_arena_get_impl(h, k, klen, vlen_out, err_out);
   Store* s = static_cast<Store*>(h);
   // the pread must stay under the lock: compaction closes/reopens the fd
   // and relocates every offset, so a lock-free read could hit a stale
@@ -354,6 +361,7 @@ void tpums_free_buf(char* p) { free(p); }
 
 uint64_t tpums_count(void* h) {
   if (!h) return 0;
+  if (tpums_is_arena(h)) return tpums_arena_count_impl(h);
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return s->index.size();
@@ -361,6 +369,7 @@ uint64_t tpums_count(void* h) {
 
 int tpums_flush(void* h) {
   if (!h) return -1;
+  if (tpums_is_arena(h)) return 0;  // read-only mapping: nothing to sync
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return fsync(s->fd) == 0 ? 0 : -1;
@@ -371,6 +380,7 @@ int tpums_flush(void* h) {
 typedef void (*tpums_key_cb)(const char*, uint32_t, void*);
 int tpums_keys(void* h, tpums_key_cb cb, void* ctx) {
   if (!h) return -1;
+  if (tpums_is_arena(h)) return tpums_arena_keys_impl(h, cb, ctx);
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   for (const auto& kv : s->index)
@@ -389,6 +399,8 @@ int tpums_keys(void* h, tpums_key_cb cb, void* ctx) {
 uint64_t tpums_keys_chunk(void* h, uint64_t* cursor, uint64_t max_keys,
                           tpums_key_cb cb, void* ctx) {
   if (!h || !cursor) return 0;
+  if (tpums_is_arena(h))
+    return tpums_arena_keys_chunk_impl(h, cursor, max_keys, cb, ctx);
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   uint64_t nbuckets = s->index.bucket_count();
@@ -406,6 +418,7 @@ uint64_t tpums_keys_chunk(void* h, uint64_t* cursor, uint64_t max_keys,
 
 uint64_t tpums_log_bytes(void* h) {
   if (!h) return 0;
+  if (tpums_is_arena(h)) return tpums_arena_log_bytes_impl(h);
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return s->end;
@@ -413,6 +426,7 @@ uint64_t tpums_log_bytes(void* h) {
 
 uint64_t tpums_live_bytes(void* h) {
   if (!h) return 0;
+  if (tpums_is_arena(h)) return tpums_arena_live_bytes_impl(h);
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return s->live_bytes;
@@ -422,6 +436,7 @@ uint64_t tpums_live_bytes(void* h) {
 // space from overwritten rows.  Called by the backend when garbage > 50%.
 int tpums_compact(void* h) {
   if (!h) return -1;
+  if (tpums_is_arena(h)) return -1;
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   std::string tmp_path = s->log_path + ".compact";
@@ -472,6 +487,7 @@ int tpums_compact(void* h) {
 
 void tpums_close(void* h) {
   if (!h) return;
+  if (tpums_is_arena(h)) return tpums_arena_close_impl(h);
   Store* s = static_cast<Store*>(h);
   {
     std::lock_guard<std::mutex> lock(s->mu);

@@ -44,6 +44,33 @@ uint64_t tpums_live_bytes(void* h);
 int tpums_compact(void* h);
 void tpums_close(void* h);
 
+// -- shared-memory arena reader (arena.cpp) ---------------------------------
+// Opens the per-worker mmap'd factor arena written in place by the Python
+// consumer (flink_ms_tpu/serve/arena.py — seqlock-versioned fixed-stride
+// slots, open-addressing key index).  The returned handle flows through the
+// SAME read API as a store handle (tpums_get / tpums_count / tpums_keys /
+// tpums_keys_chunk / tpums_log_bytes / tpums_live_bytes / tpums_close), so
+// tpums_server_start* serves GET/MGET/B2 — and builds TOPK/DOT indexes —
+// straight from the shared pages with zero per-request pushes.  Mutating
+// verbs (put/delete/ingest/compact) fail with -1: the consumer's mmap is
+// the one writer.  Torn or writer-abandoned rows (odd seqlock) read as
+// key-missing, never as a torn value.  A missing CURRENT is not an error:
+// the handle attaches lazily once the writer creates the arena, and
+// remaps itself when the writer retires a generation (growth).
+void* tpums_arena_open(const char* dir);
+// Force a remap check (normally implicit per read); -1 on a non-arena
+// handle or when no generation file exists yet.
+int tpums_arena_refresh(void* h);
+// Cumulative seqlock read retries (torn/odd slots observed) — the lock-free
+// path's contention signal, exported as tpums_arena_read_retries_total.
+uint64_t tpums_arena_read_retries(void* h);
+// Arena gauge snapshot for METRICS; -1 on a non-arena handle (how
+// lookup_server.cpp detects it serves an arena).  Any out pointer may be
+// null.
+int tpums_arena_stats(void* h, double* rows, double* capacity,
+                      double* resident_bytes, double* retries,
+                      double* load_factor);
+
 // -- lookup server (lookup_server.cpp) --------------------------------------
 // Starts an epoll event loop on its own thread, serving the line protocol of
 // flink_ms_tpu/serve/server.py (GET/MGET/COUNT/PING/TOPK/TOPKV) from the

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Arena-vs-dict microbench: the shared-memory arena's three promises,
+measured head-to-head against the dict ModelTable (ISSUE 16).
+
+Arms per table kind:
+
+    ingest    journal-shaped rows through ``put_many_columns`` -> rows/s
+    get       point reads -> ns/row (dict: Python dict hit; arena:
+              seqlock probe) and, for the arena, the same reads again
+              through the C++ reader (the zero-copy serving path)
+    publish   one snapshot publish at the loaded row count -> ms
+              (dict: columnar serialize + crc; arena: quiesce reflink /
+              extent copy) plus the speedup ratio
+
+Parity is asserted, not assumed: after ingest, the arena's full row set
+must equal the dict table's, byte for byte.
+
+Run host-side (no accelerator needed):
+
+    python scripts/arena_profile.py [--rows 1000000] [--k 16] [--gets 200000]
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from flink_ms_tpu.core import formats as F  # noqa: E402
+from flink_ms_tpu.core.params import Params  # noqa: E402
+from flink_ms_tpu.serve import snapshot as snapshot_mod  # noqa: E402
+from flink_ms_tpu.serve.arena import ArenaModelTable  # noqa: E402
+from flink_ms_tpu.serve.table import ModelTable  # noqa: E402
+
+
+def build_rows(rows: int, k: int):
+    keys = []
+    vals = []
+    for i in range(rows):
+        typ = "I" if i % 3 else "U"
+        vec = [((i * 31 + j * 17) % 1000) / 500.0 - 1.0 for j in range(k)]
+        line = F.format_als_row(i, typ, vec)
+        id_, t, payload = line.split(",", 2)
+        keys.append(f"{id_}-{t}")
+        vals.append(payload)
+    return keys, vals
+
+
+def bench_ingest(table, keys, vals, batch: int = 65536) -> float:
+    t0 = time.perf_counter()
+    for i in range(0, len(keys), batch):
+        table.put_many_columns(keys[i:i + batch], vals[i:i + batch])
+    return time.perf_counter() - t0
+
+
+def bench_gets(get, keys, n: int) -> float:
+    step = max(len(keys) // n, 1)
+    probe = (keys[::step] * (n // max(len(keys[::step]), 1) + 1))[:n]
+    t0 = time.perf_counter()
+    for k in probe:
+        get(k)
+    return (time.perf_counter() - t0) / len(probe)
+
+
+def bench_publish(root: str, table, offset: int) -> float:
+    shutil.rmtree(root, ignore_errors=True)
+    t0 = time.perf_counter()
+    snapshot_mod.publish(root, table, offset, shard=0, num_shards=1)
+    return time.perf_counter() - t0
+
+
+def main(argv=None) -> None:
+    params = Params.from_args(sys.argv[1:] if argv is None else argv)
+    rows = params.get_int("rows", 1_000_000)
+    k = params.get_int("k", 16)
+    gets = params.get_int("gets", 200_000)
+
+    print(f"# arena_profile rows={rows} k={k} gets={gets}", flush=True)
+    keys, vals = build_rows(rows, k)
+    tmp = tempfile.mkdtemp(prefix="tpums-arena-prof-")
+    try:
+        results = {}
+        dict_t = ModelTable(8)
+        results["dict"] = {
+            "ingest_s": bench_ingest(dict_t, keys, vals),
+            "get_ns": bench_gets(dict_t.get, keys, gets) * 1e9,
+            "publish_s": bench_publish(
+                os.path.join(tmp, "snap-dict"), dict_t, rows),
+        }
+
+        arena_t = ArenaModelTable(8, dir=os.path.join(tmp, "arena"))
+        try:
+            results["arena"] = {
+                "ingest_s": bench_ingest(arena_t, keys, vals),
+                "get_ns": bench_gets(arena_t.get, keys, gets) * 1e9,
+                "publish_s": bench_publish(
+                    os.path.join(tmp, "snap-arena"), arena_t, rows),
+            }
+            # O(1) hardlink publish (TPUMS_ARENA_PUBLISH=link semantics)
+            arena_t.publish_mode = "link"
+            results["arena"]["publish_link_s"] = bench_publish(
+                os.path.join(tmp, "snap-arena-link"), arena_t, rows)
+            arena_t.publish_mode = "copy"
+            try:
+                from flink_ms_tpu.serve.native_store import NativeArena
+
+                a = NativeArena(os.path.join(tmp, "arena"))
+                try:
+                    results["arena"]["native_get_ns"] = bench_gets(
+                        a.get, keys, gets) * 1e9
+                finally:
+                    a.close()
+            except Exception as e:  # toolchain-less host: Python arms only
+                print(f"# native reader unavailable: {e}", flush=True)
+
+            # byte-level parity: the arena IS the dict table, relocated
+            mismatch = sum(
+                1 for key, val in zip(keys, vals)
+                if arena_t.get(key) != dict_t.get(key))
+            assert mismatch == 0, f"{mismatch} rows differ arena vs dict"
+            n_rows = len(arena_t)
+            assert n_rows == len(dict_t), (n_rows, len(dict_t))
+        finally:
+            arena_t.close()
+
+        for kind in ("dict", "arena"):
+            r = results[kind]
+            print(f"{kind:6s} ingest {rows / r['ingest_s']:>12,.0f} rows/s   "
+                  f"get {r['get_ns']:>8,.0f} ns/row   "
+                  f"publish {r['publish_s'] * 1e3:>10,.2f} ms"
+                  + (f"   native-get {r['native_get_ns']:,.0f} ns/row"
+                     if "native_get_ns" in r else ""))
+        d = results["dict"]["publish_s"]
+        a = results["arena"]
+        print(f"publish speedup vs dict serialize: "
+              f"copy {d / max(a['publish_s'], 1e-12):.1f}x, "
+              f"link {d / max(a['publish_link_s'], 1e-12):.1f}x (O(1))  "
+              f"[parity OK, {n_rows} rows]")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,7 +54,16 @@ Knobs (env):
                            throughout, write forwarding re-points to the
                            new home, replication lag p99 before the kill
                            stays under 250ms, and staleness is visible
-                           per-read over the wire)
+                           per-read over the wire),
+                           or "arena" (SIGKILL the shared-memory arena's
+                           single writer mid-row and mid-snapshot-publish
+                           while lock-free readers hammer the same mmap:
+                           no reader ever sees a torn row — a killed
+                           write reads as missing, never garbage — the
+                           respawn takes the kernel-released flock and
+                           its replay pass repairs every row, reader
+                           availability stays 1.0, and bootstrap walks
+                           past any mid-publish-torn snapshot member)
     CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
                            history over few keys so the fold has work)
     CHAOS_UPDATE_BATCH=200 ratings per producer tick (update mode)
@@ -1345,6 +1354,222 @@ def region_main() -> int:
     return 1 if failed else 0
 
 
+_ARENA_WRITER = r"""
+import os, random, sys, time, zlib
+
+sys.path.insert(0, sys.argv[4])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from flink_ms_tpu.serve import snapshot as snap
+from flink_ms_tpu.serve.arena import ArenaModelTable
+
+d, snaps, n_users = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+
+def val(key, n):
+    body = f"{key}|{n}"
+    return (body + "|%08x|" % (zlib.crc32(body.encode()) & 0xFFFFFFFF)
+            + "p" * 48)
+
+
+t = ArenaModelTable(4, dir=d, capacity=2048)
+# seed/REPAIR pass: rewriting every tracked key is this harness's stand-in
+# for the consumer's at-least-once journal replay — it flips any slot the
+# previous incarnation left odd (SIGKILLed mid-row) back to valid
+for u in range(n_users):
+    t.put(f"{u}-U", val(f"{u}-U", 0))
+print("READY", flush=True)
+r = random.Random(os.getpid())
+n = 0
+last_pub = 0.0
+while True:
+    k = f"{r.randrange(n_users)}-U"
+    n += 1
+    t.put(k, val(k, n))
+    if time.time() - last_pub > 0.2:
+        last_pub = time.time()
+        snap.publish(snaps, t, int(time.time() * 1000),
+                     shard=0, num_shards=1)
+"""
+
+
+def arena_main() -> int:
+    """SIGKILL the single arena writer mid-row and mid-publish while
+    lock-free readers hammer the same mmap.  Contracts under test
+    (serve/arena.py): a kill never yields a TORN row to any reader (the
+    seqlock leaves the slot odd -> reads as missing, never garbage), the
+    kernel releases the writer flock so the respawn attaches and its
+    replay pass repairs every row, reader availability stays 1.0 (zero
+    reader errors — the read plane never even notices), and the snapshot
+    chain survives mid-publish kills (a torn newest member is detected
+    structurally and bootstrap falls down to an older one)."""
+    import subprocess
+    import zlib
+
+    from flink_ms_tpu.serve import snapshot as snap
+    from flink_ms_tpu.serve.arena import Arena, current_path
+    from flink_ms_tpu.serve.table import ModelTable
+
+    base = tempfile.mkdtemp(prefix="tpums_chaos_arena_")
+    arena_dir = os.path.join(base, "arena")
+    snaps = os.path.join(base, "snaps")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-c", _ARENA_WRITER, arena_dir, snaps,
+             str(N_USERS), repo],
+            stdout=subprocess.PIPE, text=True)
+
+    def wait_ready(proc, timeout_s=60.0):
+        line = proc.stdout.readline()
+        return "READY" in line
+
+    def valid(key, v):
+        parts = v.split("|")
+        if len(parts) != 4 or parts[0] != key:
+            return False
+        body = f"{parts[0]}|{parts[1]}"
+        return parts[2] == "%08x" % (zlib.crc32(body.encode()) & 0xFFFFFFFF)
+
+    stop = threading.Event()
+    reads = [0] * THREADS
+    invalid = [0] * THREADS
+    errors = [0] * THREADS
+
+    def reader(slot):
+        # C++ reader when the toolchain is here; else the Python seqlock
+        # reader — both exercise the same torn-row contract
+        get = None
+        closer = None
+        try:
+            from flink_ms_tpu.serve.native_store import NativeArena
+
+            h = NativeArena(arena_dir)
+            get, closer = h.get, h.close
+        except Exception:
+            a = Arena(current_path(arena_dir), writable=False)
+            get, closer = a.get, a.close
+        r = random.Random(slot)
+        try:
+            while not stop.is_set():
+                key = f"{r.randrange(N_USERS)}-U"
+                try:
+                    v = get(key)
+                except Exception:
+                    errors[slot] += 1
+                    continue
+                reads[slot] += 1
+                if v is not None and not valid(key, v):
+                    invalid[slot] += 1
+        finally:
+            try:
+                closer()
+            except Exception:
+                pass
+
+    writer = spawn()
+    if not wait_ready(writer):
+        event("chaos_abort", reason="arena writer never became ready")
+        return 2
+    event("chaos_arena_start", users=N_USERS, duration_s=DURATION_S,
+          kill_every_s=KILL_EVERY_S, threads=THREADS)
+    threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+               for i in range(THREADS)]
+    for th in threads:
+        th.start()
+    kills = 0
+    respawn_ms = []
+    respawn_failed = 0
+    t_end = time.time() + DURATION_S
+    next_kill = time.time() + KILL_EVERY_S
+    try:
+        while time.time() < t_end:
+            time.sleep(0.05)
+            if writer.poll() is not None:
+                event("chaos_abort", reason="arena writer died unbidden")
+                return 2
+            if not (KILL_EVERY_S and time.time() >= next_kill):
+                continue
+            # NOT "chaos_kill": the arena writer is no fleet replica —
+            # no registry entry, no heartbeat — so the alert plane has
+            # nothing to detect and the watch wrapper's kill-detection
+            # gate must not count these (KILL_KINDS in obs/watch.py)
+            event("chaos_arena_kill", pid=writer.pid)
+            writer.send_signal(signal.SIGKILL)
+            writer.wait()
+            kills += 1
+            t_kill = time.time()
+            writer = spawn()  # flock is kernel-released: attach at once
+            if wait_ready(writer):
+                respawn_ms.append(round((time.time() - t_kill) * 1e3, 1))
+                event("chaos_arena_recovery",
+                      recovery_s=respawn_ms[-1] / 1e3)
+            else:
+                respawn_failed += 1
+            next_kill = time.time() + KILL_EVERY_S
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+    finally:
+        stop.set()
+        if writer.poll() is None:
+            writer.kill()
+            writer.wait()
+    # final sweep with a FRESH mapping: the last respawn's repair pass
+    # must have every row valid — a SIGKILLed write may only ever look
+    # missing-then-repaired, never torn
+    torn_final = 0
+    missing_final = 0
+    a = Arena(current_path(arena_dir), writable=False)
+    try:
+        for u in range(N_USERS):
+            key = f"{u}-U"
+            v = a.get(key)
+            if v is None:
+                missing_final += 1
+            elif not valid(key, v):
+                torn_final += 1
+    finally:
+        a.close()
+    # the snapshot chain must still bootstrap (mid-publish kills may
+    # have torn the NEWEST member; the structural gate walks past it)
+    corrupt_members = []
+    boot = snap.bootstrap(ModelTable(4), snaps, owner=(0, 1),
+                          on_corrupt=corrupt_members.append)
+    total_reads = sum(reads)
+    total_errors = sum(errors)
+    avail = (1.0 if total_reads and not total_errors
+             else round(1.0 - total_errors / max(total_reads +
+                                                 total_errors, 1), 6))
+    summary = {
+        "mode": "arena", "users": N_USERS, "duration_s": DURATION_S,
+        "reads": total_reads,
+        "torn_reads": sum(invalid),
+        "reader_errors": total_errors,
+        "availability": avail,
+        "kills": kills,
+        "respawn_ms": respawn_ms,
+        "respawn_failed": respawn_failed,
+        "final_missing": missing_final,
+        "final_torn": torn_final,
+        "snapshot_bootstrap_rows": (boot or {}).get("rows"),
+        "snapshot_members_skipped": len(corrupt_members),
+        "timeline": [e for e in recent_events()
+                     if e["kind"].startswith("chaos_")],
+    }
+    print(json.dumps(summary, indent=1))
+    failed = (
+        not kills                         # the chaos never happened
+        or sum(invalid) > 0               # a reader saw a torn row
+        or total_errors > 0               # availability < 1.0
+        or respawn_failed > 0             # a respawn never came back
+        or torn_final > 0                 # repair left garbage behind
+        or missing_final > 0              # repair never completed
+        or boot is None                   # the snapshot chain broke
+    )
+    return 1 if failed else 0
+
+
 def run_with_watch(mode_fn) -> int:
     """The watch arm (CHAOS_WATCH=1, default): run the mode under a live
     ``obs.watch.FleetWatcher`` and tighten the exit gate with the alert
@@ -1397,4 +1622,5 @@ if __name__ == "__main__":
                              "update": update_main,
                              "rollout": rollout_main,
                              "autopilot": autopilot_main,
-                             "region": region_main}.get(MODE, main)))
+                             "region": region_main,
+                             "arena": arena_main}.get(MODE, main)))

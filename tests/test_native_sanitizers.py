@@ -105,6 +105,106 @@ print("WORKLOAD-OK")
 """
 
 
+# The arena gate: the instrumented C++ reader — epoll server verbs,
+# direct handle calls, stats/refresh from a second thread — runs against
+# a LIVE Python writer mutating the same mmap from another process.
+# Scope honesty: tsan cannot model the uninstrumented cross-process
+# writer's stores, so this is a READER-LOOP soundness gate (the reader's
+# own threads must not race each other over the handle, the remap path,
+# or the seqlock retry loop), not a whole-protocol proof; the protocol's
+# torn-row contract is tested behaviorally in test_arena.py (forged odd
+# seq, SIGKILL post-mortem) and scripts/chaos_kill.py CHAOS_MODE=arena.
+ARENA_WORKLOAD = r"""
+import os, socket, subprocess, sys, tempfile, threading, time
+print("sanitizer-maps:", open("/proc/self/maps").read().count("san.so"),
+      file=sys.stderr)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from flink_ms_tpu.serve.native_store import NativeArena, NativeLookupServer
+
+d = tempfile.mkdtemp()
+arena_dir = os.path.join(d, "arena")
+
+WRITER = '''
+import os, sys, time
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from flink_ms_tpu.serve.arena import ArenaModelTable
+t = ArenaModelTable(4, dir=sys.argv[1], capacity=512, stride=32, key_cap=16)
+for i in range(150):
+    t.put(f"{i}-U", "0.5;1.5;2.5")
+print("READY", flush=True)
+i = 0
+grew = False
+end = time.time() + 5
+while time.time() < end:
+    t.put(f"{i % 150}-U", f"{i};{i + 1}")
+    if not grew and time.time() > end - 4:
+        t.put("big-U", "x" * 200)  # oversize value: generation flip
+        grew = True                # while readers are mid-probe
+    i += 1
+t.close()
+'''
+wenv = dict(os.environ)
+wenv.pop("LD_PRELOAD", None)  # the writer is pure Python, uninstrumented
+w = subprocess.Popen([sys.executable, "-c", WRITER, arena_dir],
+                     stdout=subprocess.PIPE, text=True, env=wenv)
+assert "READY" in w.stdout.readline()
+
+arena = NativeArena(arena_dir)
+errors = []
+with NativeLookupServer(arena, "ALS_MODEL", job_id="san-arena", port=0,
+                        topk_suffixes=("-I", "-U")) as srv:
+    def querier():
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=10) as s:
+                f = s.makefile("rb")
+                for i in range(400):
+                    s.sendall(b"GET\tALS_MODEL\t%d-U\n" % (i % 160))
+                    if f.readline()[:1] not in (b"V", b"N"):
+                        errors.append("bad reply")
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def worker_verbs():
+        # TOPK scans the whole arena (seqlock-iterates every slot) on
+        # the worker thread while the epoll thread answers GETs
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=10) as s:
+                f = s.makefile("rb")
+                for i in range(100):
+                    s.sendall(b"TOPKV\tALS_MODEL\t3\t1.0;0.5;0.25\n")
+                    if f.readline()[:1] not in (b"V", b"N", b"E"):
+                        errors.append("bad TOPKV reply")
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def direct_reader():
+        # handle-level calls race the server threads over the shared
+        # handle: get (seqlock probe + retired-remap), stats, len
+        try:
+            for i in range(400):
+                arena.get(f"{i % 160}-U")
+                if i % 16 == 0:
+                    arena.stats()
+                    len(arena)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=querier) for _ in range(3)]
+    threads += [threading.Thread(target=worker_verbs)]
+    threads += [threading.Thread(target=direct_reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+assert not errors, errors
+arena.close()
+w.wait(timeout=30)
+print("WORKLOAD-OK")
+"""
+
+
 def _runtime(name: str) -> str:
     out = subprocess.run(
         ["g++", f"-print-file-name={name}"], capture_output=True, text=True
@@ -112,7 +212,8 @@ def _runtime(name: str) -> str:
     return out if os.path.isabs(out) else ""
 
 
-def _run_gate(variant: str, runtime_so: str, extra_env: dict) -> None:
+def _run_gate(variant: str, runtime_so: str, extra_env: dict,
+              workload: str = WORKLOAD) -> None:
     lib = os.path.abspath(os.path.join(NATIVE_DIR, f"libtpums-{variant}.so"))
     build = subprocess.run(
         ["make", "-C", NATIVE_DIR, variant], capture_output=True, text=True
@@ -126,7 +227,7 @@ def _run_gate(variant: str, runtime_so: str, extra_env: dict) -> None:
         **extra_env,
     }
     proc = subprocess.run(
-        [sys.executable, "-c", WORKLOAD],
+        [sys.executable, "-c", workload],
         capture_output=True, text=True, env=env, timeout=120,
     )
     report = proc.stdout + proc.stderr
@@ -144,7 +245,8 @@ def _run_gate(variant: str, runtime_so: str, extra_env: dict) -> None:
     # top frame, which can resolve to tpums.h, an inlined frame, or a libc
     # interceptor even when the race is ours.
     for stanza in _report_stanzas(report):
-        if any(m in stanza for m in ("store.cpp", "lookup_server", "tpums")):
+        if any(m in stanza for m in
+               ("store.cpp", "lookup_server", "arena.cpp", "tpums")):
             raise AssertionError(stanza + "\n--- full report ---\n" + report)
 
 
@@ -183,4 +285,33 @@ def test_store_and_server_clean_under_asan():
     _run_gate(
         "asan", rt,
         {"ASAN_OPTIONS": "detect_leaks=0:exitcode=0:verify_asan_link_order=0"},
+    )
+
+
+@pytest.mark.slow
+def test_arena_reader_race_free_under_tsan():
+    """Instrumented C++ arena reader loop (see ARENA_WORKLOAD's scope
+    note) vs a live uninstrumented Python mmap writer."""
+    rt = _runtime("libtsan.so")
+    if not rt:
+        pytest.skip("libtsan not available")
+    _run_gate(
+        "tsan", rt,
+        {"TSAN_OPTIONS": "exitcode=0 report_thread_leaks=0"},
+        workload=ARENA_WORKLOAD,
+    )
+
+
+@pytest.mark.slow
+def test_arena_reader_clean_under_asan():
+    """The same arena reader loop under asan: the remap path (mmap/munmap
+    across generation flips) and the seqlock row copies must stay inside
+    the mapping."""
+    rt = _runtime("libasan.so")
+    if not rt:
+        pytest.skip("libasan not available")
+    _run_gate(
+        "asan", rt,
+        {"ASAN_OPTIONS": "detect_leaks=0:exitcode=0:verify_asan_link_order=0"},
+        workload=ARENA_WORKLOAD,
     )
