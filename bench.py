@@ -41,7 +41,7 @@ BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
 serving_native,serving_update_plane,serving_rollout,serving_ann,
 serving_watch,serving_autopilot,serving_forensics,serving_geo,
-serving_arena; default all),
+serving_arena,serving_arena_ingest; default all),
 BENCH_ANN_ROWS_EXACT / BENCH_ANN_ROWS_IVF / BENCH_ANN_ARM_TIMEOUT_S
 (retrieval-plane A/B arm sizes: sharded-exact question at 1M rows,
 IVF question at 10M, recall@100 >= 0.95 gate recorded),
@@ -1142,7 +1142,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
         "serving_watch,serving_autopilot,serving_forensics,serving_geo,"
-        "serving_arena"
+        "serving_arena,serving_arena_ingest"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1235,6 +1235,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_geo", "run_serving_geo_section",
          lambda f: f(small)),
         ("serving_arena", "run_serving_arena_section",
+         lambda f: f(small)),
+        ("serving_arena_ingest", "run_serving_arena_ingest_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

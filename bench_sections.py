@@ -3564,14 +3564,26 @@ def run_serving_arena_section(small: bool) -> dict:
             out["serving_arena_get_b2_c1_qps"] = qps
             out["serving_arena_get_b2_c1_p50_us"] = p50
             for win in (16, 64):
+                frames = max(get_total // win, 20)
+                io0 = nsrv.io_stats()
                 qps, p50 = _get_pipelined(nsrv.port, ALS_STATE, keys, win,
-                                          max(get_total // win, 20), "b2")
+                                          frames, "b2")
+                io1 = nsrv.io_stats()
                 out[f"serving_arena_get_b2_c{win}_qps"] = qps
                 out[f"serving_arena_get_b2_c{win}_p50_us"] = p50
+                # round-17 batched socket loop: reply-path syscalls the
+                # server itself counted, per B2 frame served
+                out[f"serving_arena_get_b2_c{win}_syscalls_per_frame"] = \
+                    round((io1["reply_syscalls"] - io0["reply_syscalls"])
+                          / frames, 2)
+            out["serving_arena_uring"] = bool(io1["uring"])
             _log(f"[bench:arena] GET b2: c1 "
                  f"{out['serving_arena_get_b2_c1_qps']} qps, c64 "
                  f"{out['serving_arena_get_b2_c64_qps']} qps / "
-                 f"{out['serving_arena_get_b2_c64_p50_us']} us/req p50")
+                 f"{out['serving_arena_get_b2_c64_p50_us']} us/req p50, "
+                 f"{out['serving_arena_get_b2_c64_syscalls_per_frame']} "
+                 f"reply syscalls/frame "
+                 f"(uring={out['serving_arena_uring']})")
 
             # -- write -> queryable visibility through the C++ reader ----
             vis_ms = []
@@ -3636,5 +3648,149 @@ def run_serving_arena_section(small: bool) -> dict:
                 table.close()
             except Exception:
                 pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def run_serving_arena_ingest_section(small: bool) -> dict:
+    """Round-17 native write plane A/B (ISSUE 17): the SAME columnar
+    batches through the pure-Python seqlock writer (TPUMS_ARENA_BATCH=0)
+    vs the C++ batch writer, on the same arena geometry.  Two regimes:
+
+      cold    bulk load at BENCH_ARENA_INGEST_ROWS (1M full-scale) in
+              8192-row batches — the bootstrap/journal-replay shape.
+              Headline: ``serving_arena_ingest_cold_speedup_x`` with the
+              ``serving_arena_ingest_10x_gate`` acceptance bit recorded
+              honestly (what THIS box measured, pass or fail).
+      drip    64-row in-place update batches — the steady-state update
+              plane shape, where per-batch fixed costs dominate.
+
+    The arena lives on /dev/shm when it fits (it is a SHARED-MEMORY
+    arena — disk-backed tmp adds writeback throttling both arms pay but
+    neither would see in production; ``serving_arena_ingest_shm`` says
+    which medium this run measured) and both arms run with
+    TPUMS_ARENA_PREFAULT=1 so first-touch faults — identical kernel
+    work in either arm — don't drown the writer A/B.  Both arms finish
+    with byte-identical arena files
+    (``serving_arena_ingest_byte_parity``) — the speedup is only worth
+    reporting if the fast path writes the exact same bytes.  A box where
+    writer + bench share one core records
+    ``serving_arena_ingest_core_starved``."""
+    import random
+
+    from flink_ms_tpu.serve.arena import ArenaModelTable
+
+    out: dict = {}
+    n_rows = int(os.environ.get("BENCH_ARENA_INGEST_ROWS",
+                                20_000 if small else 1_000_000))
+    drip_batches = int(os.environ.get("BENCH_ARENA_DRIP_BATCHES",
+                                      50 if small else 2_000))
+    drip_n = 64
+    dim = 16
+    rng = np.random.default_rng(17)
+    shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    if shm_dir is not None:
+        try:  # both arena files plus slack must fit in the tmpfs
+            need = 4 * n_rows * 300
+            if shutil.disk_usage(shm_dir).free < need:
+                shm_dir = None
+        except OSError:
+            shm_dir = None
+    out["serving_arena_ingest_shm"] = shm_dir is not None
+    tmp = tempfile.mkdtemp(prefix="bench_arena_ingest_", dir=shm_dir)
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n_cpus = os.cpu_count() or 1
+    out["serving_arena_ingest_rows"] = n_rows
+    out["serving_arena_ingest_cpus"] = n_cpus
+    if n_cpus < 2:
+        out["serving_arena_ingest_core_starved"] = True
+
+    def payload(vec):
+        return ";".join(repr(round(float(x), 4)) for x in vec)
+
+    keys = [f"{u}-U" for u in range(n_rows)]
+    vals = [payload(rng.normal(size=dim)) for _ in range(n_rows)]
+    rnd = random.Random(17)
+    drips = []
+    for b in range(drip_batches):
+        dk = [keys[rnd.randrange(n_rows)] for _ in range(drip_n)]
+        drips.append((dk, [payload(rng.normal(size=dim)) for _ in dk]))
+
+    # pre-size the geometry like bootstrap does from a snapshot: the A/B
+    # question is the write plane, not the (identical-in-both-arms)
+    # grow-and-rehash cost that would otherwise dominate at 1M rows
+    cap = 1 << max(12, (int(n_rows / 0.8)).bit_length())
+    stride = 1 << max(6, max(len(v) for v in vals).bit_length())
+    out["serving_arena_ingest_capacity"] = cap
+    out["serving_arena_ingest_stride"] = stride
+
+    def run_arm(native: bool):
+        prev = {k: os.environ.get(k)
+                for k in ("TPUMS_ARENA_BATCH", "TPUMS_ARENA_PREFAULT")}
+        os.environ["TPUMS_ARENA_BATCH"] = "1" if native else "0"
+        os.environ["TPUMS_ARENA_PREFAULT"] = "1"
+        t0 = time.perf_counter()
+        try:
+            t = ArenaModelTable(
+                8, dir=os.path.join(tmp, "n" if native else "p"),
+                capacity=cap, stride=stride)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        out[f"serving_arena_ingest_{'native' if native else 'python'}"
+            f"_create_s"] = round(time.perf_counter() - t0, 3)
+        try:
+            if native and t._writer_h is None:
+                out["serving_arena_ingest_native_unavailable"] = True
+            t0 = time.perf_counter()
+            for i in range(0, n_rows, 8192):
+                t.put_many_columns(keys[i:i + 8192], vals[i:i + 8192])
+            cold_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for dk, dv in drips:
+                t.put_many_columns(list(dk), list(dv))
+            drip_s = time.perf_counter() - t0
+            t.flush()
+            return cold_s, drip_s, t.arena.path
+        finally:
+            t.close()
+
+    try:
+        cold_n, drip_n_s, path_n = run_arm(True)
+        cold_p, drip_p_s, path_p = run_arm(False)
+        out["serving_arena_ingest_native_rows_per_s"] = round(
+            n_rows / cold_n)
+        out["serving_arena_ingest_python_rows_per_s"] = round(
+            n_rows / cold_p)
+        out["serving_arena_ingest_cold_speedup_x"] = round(
+            cold_p / max(cold_n, 1e-9), 2)
+        out["serving_arena_ingest_10x_gate"] = (
+            out["serving_arena_ingest_cold_speedup_x"] >= 10.0)
+        total_drip = drip_batches * drip_n
+        out["serving_arena_drip_native_rows_per_s"] = round(
+            total_drip / max(drip_n_s, 1e-9))
+        out["serving_arena_drip_python_rows_per_s"] = round(
+            total_drip / max(drip_p_s, 1e-9))
+        out["serving_arena_drip_speedup_x"] = round(
+            drip_p_s / max(drip_n_s, 1e-9), 2)
+        with open(path_n, "rb") as fn_, open(path_p, "rb") as fp_:
+            out["serving_arena_ingest_byte_parity"] = (
+                fn_.read() == fp_.read())
+        _log(f"[bench:arena-ingest] cold @{n_rows}: native "
+             f"{out['serving_arena_ingest_native_rows_per_s']} rows/s vs "
+             f"python {out['serving_arena_ingest_python_rows_per_s']} "
+             f"({out['serving_arena_ingest_cold_speedup_x']}x, 10x gate "
+             f"{'PASS' if out['serving_arena_ingest_10x_gate'] else 'FAIL'}"
+             f"), drip {out['serving_arena_drip_speedup_x']}x, "
+             f"byte_parity={out['serving_arena_ingest_byte_parity']}")
+    except Exception:
+        _log(traceback.format_exc())
+        out["serving_arena_ingest_error"] = traceback.format_exc(limit=3)
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out

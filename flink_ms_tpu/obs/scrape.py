@@ -253,6 +253,22 @@ def fleet_signals(before: dict, after: dict,
          "arena_publish_seconds": newest O(state) snapshot publish
                            latency (max across workers; None until an
                            arena snapshot has published)}
+
+    Native write plane (round 17 — ``native/arena.cpp`` batch writer +
+    CAS updates; both the Python writer's registry and the C++ server's
+    METRICS splice of the ``writer.stats`` sidecar feed these):
+
+        {"arena_batch_rows_per_s": rows applied by the native columnar
+                           batch writer/s over the window — the write
+                           path's throughput signal; a fall to ~0 while
+                           ingest backlog grows means the native writer
+                           degraded to the Python path,
+         "arena_cas_success_per_s": in-place CAS swaps/s over the window
+                           (the update plane writing at hardware speed),
+         "arena_cas_retry_per_s": failed CAS compares/s — sustained
+                           retries mean update workers are losing races
+                           to the ingest writer and falling back to LWW
+                           re-puts}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -388,6 +404,19 @@ def fleet_signals(before: dict, after: dict,
     arena_publish_s = max(
         (g["value"] for g in after.get("gauges", [])
          if g["name"] == "tpums_arena_publish_seconds"), default=None)
+    # native write plane (round 17): batch-writer and CAS counter DELTAS
+    # as rates — write-path regressions (native writer degraded, update
+    # plane losing CAS races) surface as rate shifts the watch plane can
+    # alert on
+    batch_rows = max(
+        _counter_total(after, "tpums_arena_batch_rows_total")
+        - _counter_total(before, "tpums_arena_batch_rows_total"), 0.0)
+    cas_success = max(
+        _counter_total(after, "tpums_arena_cas_success_total")
+        - _counter_total(before, "tpums_arena_cas_success_total"), 0.0)
+    cas_retry = max(
+        _counter_total(after, "tpums_arena_cas_retry_total")
+        - _counter_total(before, "tpums_arena_cas_retry_total"), 0.0)
     return {
         **autopilot,
         "qps": requests / dt_s,
@@ -410,6 +439,9 @@ def fleet_signals(before: dict, after: dict,
         "arena_read_retries_per_s": arena_retries / dt_s,
         "arena_load_factor": arena_load_factor,
         "arena_publish_seconds": arena_publish_s,
+        "arena_batch_rows_per_s": batch_rows / dt_s,
+        "arena_cas_success_per_s": cas_success / dt_s,
+        "arena_cas_retry_per_s": cas_retry / dt_s,
         "dt_s": dt_s,
         "requests": requests,
     }

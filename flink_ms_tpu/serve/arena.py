@@ -48,14 +48,31 @@ live rows, repoints CURRENT, then sets the old header's ``retired`` flag
 — attached readers see the flag on their next lookup and remap through
 CURRENT (``tpums_arena_refresh``).
 
+Batched writes go through the native plane when the toolchain is
+available: ``put_many_columns`` encodes the whole batch into contiguous
+columnar blobs OUTSIDE the table lock, then hands them to the C++
+``tpums_arena_put_batch`` (``native/arena.cpp``) — one FFI call and zero
+Python bytecode per row, byte-parity-exact with ``put_bytes``.  Growth
+falls back to the Python path for the blocking row, then resumes
+natively.  ``cas_many_columns`` is the update plane's in-place
+compare-and-swap (``tpums_arena_cas_floats``): same seqlock discipline,
+value drift reported back for an LWW re-put instead of clobbered.
+
 Knobs: ``TPUMS_ARENA_CAPACITY`` (slots, default 65536),
 ``TPUMS_ARENA_STRIDE`` (max value bytes, default 256),
-``TPUMS_ARENA_KEYCAP`` (max key bytes, default 48); selection is
-``--table arena`` / ``TPUMS_TABLE=arena`` on the consumer CLI.
+``TPUMS_ARENA_KEYCAP`` (max key bytes, default 48),
+``TPUMS_ARENA_BATCH=0`` (disable the native batch writer),
+``TPUMS_ARENA_CAS=0`` (update plane re-puts rows instead of CAS),
+``TPUMS_ARENA_PREFAULT=1`` (bulk-populate the writer mapping at attach
+— bootstrap replay then never stalls on first-touch faults);
+selection is ``--table arena`` / ``TPUMS_TABLE=arena`` on the consumer
+CLI (the default for sharded/HA/elastic fleets — ``TPUMS_TABLE=dict``
+opts out).
 """
 
 from __future__ import annotations
 
+import ctypes
 import errno
 import json
 import mmap
@@ -63,9 +80,23 @@ import os
 import struct
 import threading
 import time
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from .table import _fnv1a, _fnv1a_batch
+
+try:  # SIMD newline guard for the columnar blobs (bytes.count restarts
+    # memchr at every match — ~1 GB/s on 100-byte rows; the vectorized
+    # compare-and-sum runs at memory bandwidth)
+    import numpy as _np
+except Exception:  # pragma: no cover - numpy ships with the jax stack
+    _np = None
+
+
+def _nl_count(b: bytes) -> int:
+    if _np is not None and len(b) >= 4096:
+        return int((_np.frombuffer(b, _np.uint8) == 10).sum())
+    return b.count(b"\n")
+
 
 MAGIC = b"TPMA"
 VERSION = 1
@@ -141,6 +172,16 @@ class Arena:
             raise ValueError(
                 f"{path}: short arena file ({size} bytes for capacity "
                 f"{self.capacity})")
+        if writable and os.environ.get("TPUMS_ARENA_PREFAULT") == "1":
+            # bulk-populate the mapping at attach time: hash-distributed
+            # inserts otherwise take a first-touch fault on nearly every
+            # row, and prefetch can't hide a fault the way it hides a
+            # cache miss.  One kernel pass here is far cheaper than a
+            # million faults during bootstrap replay.
+            try:
+                self.mm.madvise(getattr(mmap, "MADV_POPULATE_WRITE", 23))
+            except (AttributeError, OSError, ValueError):
+                pass  # kernel < 5.14: faults amortize as before
 
     # -- header fields (count/retired are live, re-read per call) ---------
 
@@ -453,7 +494,31 @@ class _LazyCounter:
         self._c.inc(n)
 
 
+class _LazyHistogram:
+    """Same deferred-registry trick as ``_LazyCounter`` for histograms."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._h = None
+
+    def observe(self, v: float) -> None:
+        if self._h is None:
+            from ..obs.metrics import get_registry
+
+            self._h = get_registry().histogram(self._name)
+        self._h.observe(v)
+
+
 _RETRIES = _LazyCounter("tpums_arena_read_retries_total")
+# write-plane counters: batch rows/seconds through the native writer and
+# CAS outcomes; the C++ writer mirrors them into the <dir>/writer.stats
+# sidecar so the native METRICS verb exports the same names from server
+# processes that never run Python on the write path
+_BATCH_ROWS = _LazyCounter("tpums_arena_batch_rows_total")
+_BATCH_SECONDS = _LazyCounter("tpums_arena_batch_put_seconds_total")
+_BATCH_HIST = _LazyHistogram("tpums_arena_batch_put_seconds")
+_CAS_SUCCESS = _LazyCounter("tpums_arena_cas_success_total")
+_CAS_RETRY = _LazyCounter("tpums_arena_cas_retry_total")
 
 
 # -- directory-level open/create ------------------------------------------
@@ -605,8 +670,39 @@ class ArenaModelTable:
                 stride or default_stride(),
                 key_cap or default_key_cap(), 0)
             _write_current(self.dir, gen_filename(0))
+        # Native batch writer (native/arena.cpp tpums_arena_put_batch):
+        # maps the SAME generation file read-write and applies whole
+        # columnar batches with zero Python bytecode per row.  Optional —
+        # no toolchain (or TPUMS_ARENA_BATCH=0) leaves the pure-Python
+        # path serving every write.  Reopened after every growth flip.
+        self._writer_h: Optional[int] = None
+        self._writer_lib = None
+        self._native_batch = \
+            os.environ.get("TPUMS_ARENA_BATCH", "1") != "0"
+        self._reopen_native_writer()
         self._last_gauge_ts = 0.0
         self._publish_gauges()
+
+    def _reopen_native_writer(self) -> None:
+        """(Re)attach the C++ batch writer to the live generation file.
+        Any failure — no compiler, stale lib without the writer ABI —
+        degrades silently to the Python write path."""
+        if self._writer_h is not None:
+            self._writer_lib.tpums_arena_writer_close(self._writer_h)
+            self._writer_h = None
+        if not self._native_batch:
+            return
+        try:
+            from .native_store import _load_lib
+
+            lib = _load_lib()
+            h = lib.tpums_arena_writer_open(
+                self.arena.path.encode("utf-8"), self.dir.encode("utf-8"))
+        except Exception:
+            return
+        if h:
+            self._writer_lib = lib
+            self._writer_h = h
 
     @staticmethod
     def _acquire_writer_lock(dir_: str) -> int:
@@ -654,22 +750,144 @@ class ArenaModelTable:
             return
         if not isinstance(keys, list):
             keys = list(keys)
-        if hashes is None and n >= 32:
-            hashes = _fnv1a_batch(keys)
+        if not isinstance(values, list):
+            values = list(values)
+        # ALL encoding happens before the lock: the writer lock bounds
+        # reader-visible seqlock windows and publish quiesce time, so it
+        # must cover memory stores only — never per-row utf-8 encodes.
+        kbuf = vbuf = None
+        if self._writer_h is not None:
+            kbuf = "\n".join(keys).encode("utf-8")
+            vbuf = "\n".join(values).encode("utf-8")
+            if (_nl_count(kbuf) != n - 1
+                    or _nl_count(vbuf) != n - 1):
+                # embedded newline in a row: the columnar framing can't
+                # carry it — per-row path below
+                kbuf = vbuf = None
+        if kbuf is None:
+            kbs = [k.encode("utf-8") for k in keys]
+            vbs = [v.encode("utf-8") for v in values]
+            if hashes is None and n >= 32:
+                hashes = _fnv1a_batch(keys)
+            hs = hashes.tolist() if hasattr(hashes, "tolist") else hashes
         with self._lock:
-            if hashes is None:
-                for key, value in zip(keys, values):
-                    self._put_locked(key.encode("utf-8"),
-                                     value.encode("utf-8"))
+            if kbuf is not None:
+                self._put_batch_locked(kbuf, vbuf, n)
+            elif hs is None:
+                for kb, vb in zip(kbs, vbs):
+                    self._put_locked(kb, vb)
             else:
-                hs = hashes.tolist() if hasattr(hashes, "tolist") else hashes
-                for key, value, h in zip(keys, values, hs):
-                    self._put_locked(key.encode("utf-8"),
-                                     value.encode("utf-8"), h)
+                for kb, vb, h in zip(kbs, vbs, hs):
+                    self._put_locked(kb, vb, h)
             self.puts += n
             self.version += 1
             self._notify_locked(keys)
             self._maybe_gauges()
+
+    def _put_batch_locked(self, kbuf: bytes, vbuf: bytes, n: int) -> None:
+        """Apply a columnar batch through the C++ writer, falling back to
+        the Python path for any row that needs growth (which rebuilds the
+        file and reopens the native handle), then resuming natively."""
+        lib = self._writer_lib
+        t0 = time.perf_counter()
+        native_rows = 0
+        remaining = n
+        while remaining > 0:
+            mk = ctypes.c_uint32(0)
+            mv = ctypes.c_uint32(0)
+            applied = int(lib.tpums_arena_put_batch(
+                self._writer_h, kbuf, len(kbuf), vbuf, len(vbuf),
+                remaining, ctypes.byref(mk), ctypes.byref(mv)))
+            if applied < 0:
+                raise OSError("tpums_arena_put_batch failed")
+            if mk.value > self._max_klen:
+                self._max_klen = mk.value
+            if mv.value > self._max_vlen:
+                self._max_vlen = mv.value
+            native_rows += applied
+            remaining -= applied
+            if remaining == 0:
+                break
+            # row `applied` needs growth: put it through the Python path
+            # (grows + reopens the native writer), resume with the rest
+            kbs = kbuf.split(b"\n")
+            vbs = vbuf.split(b"\n")
+            self._put_locked(kbs[applied], vbs[applied])
+            remaining -= 1
+            if remaining == 0:
+                break
+            kbuf = b"\n".join(kbs[applied + 1:])
+            vbuf = b"\n".join(vbs[applied + 1:])
+            if self._writer_h is None:
+                # native writer did not survive the reopen: finish in
+                # Python rather than spinning on applied == 0
+                for kb, vb in zip(kbs[applied + 1:], vbs[applied + 1:]):
+                    self._put_locked(kb, vb)
+                remaining = 0
+        dt = time.perf_counter() - t0
+        if native_rows:
+            _BATCH_ROWS.inc(native_rows)
+            _BATCH_SECONDS.inc(dt)
+            _BATCH_HIST.observe(dt)
+
+    def cas_many_columns(self, keys: Sequence[str],
+                         expected: Sequence[Optional[str]],
+                         values: Sequence[str]) -> List[int]:
+        """In-place compare-and-swap of whole value payloads: row ``i``
+        flips to ``values[i]`` iff the stored bytes still equal
+        ``expected[i]`` (seqlock odd/even preserved, so concurrent
+        readers never see a torn row).  Returns the indices that did NOT
+        swap — key missing, value drifted, ``expected[i] is None``, or
+        geometry overflow — which the caller repairs with an LWW re-put.
+        Swapped rows move puts/version and fire listeners like a put."""
+        n = len(keys)
+        if n == 0:
+            return []
+        kbs = [k.encode("utf-8") for k in keys]
+        ebs = [e.encode("utf-8") if e is not None else None
+               for e in expected]
+        vbs = [v.encode("utf-8") for v in values]
+        failed: List[int] = []
+        swapped: List[str] = []
+        retries = 0
+        with self._lock:
+            lib, h = self._writer_lib, self._writer_h
+            for i in range(n):
+                eb = ebs[i]
+                if eb is None:
+                    failed.append(i)
+                    continue
+                if h is not None:
+                    rc = lib.tpums_arena_cas_floats(
+                        h, kbs[i], len(kbs[i]), eb, len(eb),
+                        vbs[i], len(vbs[i]))
+                else:
+                    # Python fallback: the table lock already excludes
+                    # every other writer, so read-compare-put IS atomic
+                    cur = self.arena.get_bytes(kbs[i])
+                    if (cur is not None
+                            and cur.encode("utf-8") == eb):
+                        self._put_locked(kbs[i], vbs[i])
+                        rc = 1
+                    else:
+                        rc = 0
+                if rc == 1:
+                    swapped.append(keys[i])
+                elif rc == 0:
+                    retries += 1
+                    failed.append(i)
+                else:
+                    failed.append(i)
+            if swapped:
+                self.puts += len(swapped)
+                self.version += 1
+                self._notify_locked(swapped)
+                self._maybe_gauges()
+        if swapped:
+            _CAS_SUCCESS.inc(len(swapped))
+        if retries:
+            _CAS_RETRY.inc(retries)
+        return failed
 
     def _notify_locked(self, keys) -> None:
         for fn, batch_fn in zip(self._listeners, self._batch_listeners):
@@ -731,6 +949,7 @@ class ArenaModelTable:
         _write_current(self.dir, gen_filename(gen))
         old.retire()  # attached readers remap through CURRENT
         self.arena = new
+        self._reopen_native_writer()  # the old mapping is dead weight now
         try:
             os.unlink(old.path)  # live mappings keep the inode alive
         except OSError:
@@ -869,6 +1088,9 @@ class ArenaModelTable:
 
     def close(self) -> None:
         with self._lock:
+            if self._writer_h is not None:
+                self._writer_lib.tpums_arena_writer_close(self._writer_h)
+                self._writer_h = None
             self.arena.flush()
             self.arena.close()
             try:

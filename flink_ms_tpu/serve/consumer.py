@@ -205,16 +205,27 @@ class ServingJob:
         self.parse_fn = parse_fn
         self.backend = backend
         # which table implementation holds the factors (--table /
-        # TPUMS_TABLE): "dict" (default) is the in-RAM sharded ModelTable
-        # (or the backend's own durable table for rocksdb); "arena" is the
-        # shared-memory mmap arena (serve/arena.py) the C++ server and the
-        # snapshotter read zero-copy
+        # TPUMS_TABLE): "dict" is the in-RAM sharded ModelTable (or the
+        # backend's own durable table for rocksdb); "arena" is the
+        # shared-memory mmap arena (serve/arena.py) the C++ server and
+        # the snapshotter read zero-copy.  Fleet members — sharded
+        # (shard_filter), HA replicas (replica_of), elastic topologies
+        # (topology_group/generation) — DEFAULT to arena now that its
+        # write path is native (ROADMAP item 1); TPUMS_TABLE=dict opts
+        # out.  Standalone jobs and make_table backends (rocksdb owns
+        # its durable table) keep their existing default.
+        _sf = getattr(parse_fn, "shard_filter", None)
         if table is None:
-            table = os.environ.get("TPUMS_TABLE", "dict")
+            table = os.environ.get("TPUMS_TABLE")
+        if table is None:
+            fleet = (_sf is not None or replica_of is not None
+                     or topology_group is not None
+                     or generation is not None)
+            table = "arena" if fleet and not hasattr(
+                backend, "make_table") else "dict"
         if table not in ("dict", "arena"):
             raise ValueError("table must be dict|arena")
         self.table_kind = table
-        _sf = getattr(parse_fn, "shard_filter", None)
         self._snap_owner = (int(_sf[0]), int(_sf[1])) if _sf else (0, 1)
         if table == "arena":
             from .arena import ArenaModelTable

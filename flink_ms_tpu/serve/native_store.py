@@ -130,6 +130,26 @@ def _declare_abi(lib):
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
     ]
+    lib.tpums_arena_write_stats.restype = ctypes.c_int
+    lib.tpums_arena_write_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.tpums_arena_writer_open.restype = ctypes.c_void_p
+    lib.tpums_arena_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tpums_arena_writer_close.argtypes = [ctypes.c_void_p]
+    lib.tpums_arena_put_batch.restype = ctypes.c_longlong
+    lib.tpums_arena_put_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.tpums_arena_cas_floats.restype = ctypes.c_int
+    lib.tpums_arena_cas_floats.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+    ]
     lib.tpums_server_start.restype = ctypes.c_void_p
     lib.tpums_server_start.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -153,6 +173,12 @@ def _declare_abi(lib):
     lib.tpums_server_port.argtypes = [ctypes.c_void_p]
     lib.tpums_server_requests.restype = ctypes.c_uint64
     lib.tpums_server_requests.argtypes = [ctypes.c_void_p]
+    lib.tpums_server_io_stats.restype = ctypes.c_int
+    lib.tpums_server_io_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int),
+    ]
     lib.tpums_server_stop.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -482,6 +508,19 @@ class NativeArena:
                  "load_factor")
         return {n: v.value for n, v in zip(names, vals)}
 
+    def write_stats(self) -> Optional[dict]:
+        """Write-plane counters from the ``writer.stats`` sidecar the native
+        batch writer maintains (batch rows/seconds, CAS outcomes), or None
+        while no native writer has ever run against this arena."""
+        vals = [ctypes.c_double(0.0) for _ in range(4)]
+        with self._call_lock:
+            rc = self._lib.tpums_arena_write_stats(
+                self._live_handle(), *[ctypes.byref(v) for v in vals])
+        if rc != 0:
+            return None
+        names = ("batch_rows", "batch_seconds", "cas_success", "cas_retry")
+        return {n: v.value for n, v in zip(names, vals)}
+
     def close(self) -> None:
         with self._call_lock:
             if self._h:
@@ -584,6 +623,27 @@ class NativeLookupServer:
     @property
     def requests(self) -> int:
         return int(self._lib.tpums_server_requests(self._h)) if self._h else 0
+
+    def io_stats(self) -> dict:
+        """Reply-path syscall accounting for the batched socket loop:
+        ``recv_calls`` / ``reply_syscalls`` / ``reply_bytes`` cumulative
+        counters plus ``uring`` (whether the io_uring backend passed its
+        runtime probe).  The syscalls-per-frame tests read deltas from here
+        instead of strace."""
+        if not self._h:
+            return {"recv_calls": 0, "reply_syscalls": 0, "reply_bytes": 0,
+                    "uring": False}
+        recv = ctypes.c_uint64(0)
+        reply = ctypes.c_uint64(0)
+        rbytes = ctypes.c_uint64(0)
+        uring = ctypes.c_int(0)
+        self._lib.tpums_server_io_stats(
+            self._h, ctypes.byref(recv), ctypes.byref(reply),
+            ctypes.byref(rbytes), ctypes.byref(uring))
+        return {"recv_calls": int(recv.value),
+                "reply_syscalls": int(reply.value),
+                "reply_bytes": int(rbytes.value),
+                "uring": bool(uring.value)}
 
     def start(self) -> "NativeLookupServer":
         return self  # started in __init__; method mirrors LookupServer's API

@@ -525,6 +525,11 @@ class UpdateWorker:
         self._cache: Dict[str, Tuple[Optional[str], float]] = {}
         self._last_reads: Dict[str, Optional[str]] = {}
         self._recording = False
+        # co-located arena: swap factor bytes in place with a native CAS
+        # against the value this batch READ, instead of re-putting whole
+        # rows — a failed CAS (value drifted under us) falls back to the
+        # LWW re-put.  TPUMS_ARENA_CAS=0 keeps the re-put path.
+        self._cas_enabled = os.environ.get("TPUMS_ARENA_CAS", "1") != "0"
         self.stats = {
             "applied": 0, "batches": 0, "conflicts": 0, "replayed_rows": 0,
             "remote_keys": 0, "cache_hits": 0, "local_hits": 0,
@@ -839,7 +844,20 @@ class UpdateWorker:
                     key, self.num_workers) == self.worker_index:
                 probe_key, probe_payload = key, vec_s
         if direct and direct_keys:
-            self._table.put_many_columns(direct_keys, direct_vals)
+            if self._cas_enabled and hasattr(self._table,
+                                             "cas_many_columns"):
+                # expected = the value each update step READ; a mismatch
+                # means another writer got there first and the journal's
+                # LWW replay is the truth — re-put only the failures
+                expected = [self._last_reads.get(k) for k in direct_keys]
+                failed = self._table.cas_many_columns(
+                    direct_keys, expected, direct_vals)
+                if failed:
+                    self._table.put_many_columns(
+                        [direct_keys[i] for i in failed],
+                        [direct_vals[i] for i in failed])
+            else:
+                self._table.put_many_columns(direct_keys, direct_vals)
         if len(self._overlay) > 65536:
             self._overlay.clear()
         part.next_seq = seq_to

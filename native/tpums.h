@@ -70,6 +70,46 @@ uint64_t tpums_arena_read_retries(void* h);
 int tpums_arena_stats(void* h, double* rows, double* capacity,
                       double* resident_bytes, double* retries,
                       double* load_factor);
+// Write-plane counter snapshot from the <dir>/writer.stats sidecar the
+// native batch writer maintains (batch rows/seconds, CAS outcomes) — how
+// the METRICS verb exports tpums_arena_batch_rows_total and friends
+// without a Python push.  -1 on a non-arena handle or before any native
+// writer has created the sidecar (the handle re-probes per call).  Any
+// out pointer may be null.
+int tpums_arena_write_stats(void* h, double* batch_rows,
+                            double* batch_seconds, double* cas_success,
+                            double* cas_retry);
+
+// -- shared-memory arena writer (arena.cpp) ---------------------------------
+// The native half of ArenaModelTable's write path.  A writer handle maps
+// ONE generation file read-write; the Python table keeps the flock, the
+// CURRENT pointer, growth/rehash, and the table lock (callers MUST hold
+// it — there is exactly one writer), and reopens the handle after every
+// generation flip.  Row bytes are parity-exact with Arena.put_bytes:
+// same seqlock claim order, same seq values, same untouched value tails.
+void* tpums_arena_writer_open(const char* path, const char* dir);
+void tpums_arena_writer_close(void* h);
+// Upsert a columnar batch: kbuf/vbuf are '\n'-joined key/value bytes
+// (n-1 separators; rows may not contain '\n' — the caller guards).  Stops
+// EARLY at the first row that would need growth (oversize key/value or
+// load-factor ceiling) and returns the applied prefix length; the caller
+// grows, reopens, and resumes from there.  Returns -1 on malformed blobs
+// or a bad handle.  *max_klen_out/*max_vlen_out (may be null) get the
+// largest key/value over the applied prefix, feeding the Python side's
+// observed-size growth geometry.
+long long tpums_arena_put_batch(void* h, const char* kbuf,
+                                uint64_t kbuf_len, const char* vbuf,
+                                uint64_t vbuf_len, uint64_t n,
+                                uint32_t* max_klen_out,
+                                uint32_t* max_vlen_out);
+// Compare-and-swap the value bytes of one row in place (seqlock odd/even
+// preserved, so concurrent readers never see a torn row).  Returns 1 on
+// swap, 0 when the current value differs from `expect` (counted as a CAS
+// retry — the caller's LWW re-put is the repair), -1 when the key is
+// missing or any length exceeds the arena geometry.
+int tpums_arena_cas_floats(void* h, const char* k, uint32_t klen,
+                           const char* expect, uint32_t explen,
+                           const char* newv, uint32_t newlen);
 
 // -- lookup server (lookup_server.cpp) --------------------------------------
 // Starts an epoll event loop on its own thread, serving the line protocol of
@@ -112,6 +152,15 @@ void tpums_server_set_trace(void* srv, const char* path,
                             long long max_bytes, int keep);
 int tpums_server_port(void* srv);
 uint64_t tpums_server_requests(void* srv);
+// Reply-path syscall accounting for the batched socket loop: recv()
+// invocations, send-side syscalls (sendmsg calls, or io_uring_enter
+// submissions — one per batch of dirty connections), bytes sent, and
+// whether the io_uring backend passed its runtime probe (0 = epoll +
+// scatter-gather sendmsg fallback; TPUMS_URING=0 forces it).  The
+// syscalls-per-frame tests read deltas from here instead of strace.
+int tpums_server_io_stats(void* srv, uint64_t* recv_calls,
+                          uint64_t* reply_syscalls, uint64_t* reply_bytes,
+                          int* uring_active);
 // Stops the loop, closes all connections, joins the thread, frees the handle.
 void tpums_server_stop(void* srv);
 

@@ -15,6 +15,10 @@
 
 constexpr uint32_t kTpumsStoreTag = 0x53544F52u;  // "STOR"
 constexpr uint32_t kTpumsArenaTag = 0x4152454Eu;  // "AREN"
+// Arena WRITER handles (tpums_arena_writer_open) never dispatch through
+// the store read API; the distinct tag keeps a writer handle passed to a
+// reader verb (or vice versa) an explicit error instead of a crash.
+constexpr uint32_t kTpumsArenaWriterTag = 0x41575254u;  // "AWRT"
 
 struct TpumsTaggedHandle {
   uint32_t tag;

@@ -1382,9 +1382,23 @@ r = random.Random(os.getpid())
 n = 0
 last_pub = 0.0
 while True:
-    k = f"{r.randrange(n_users)}-U"
     n += 1
-    t.put(k, val(k, n))
+    mode = n % 3
+    if mode == 0:  # single-row Python seqlock write
+        k = f"{r.randrange(n_users)}-U"
+        t.put(k, val(k, n))
+    elif mode == 1:  # native columnar batch (C++ writer when built)
+        ks = [f"{r.randrange(n_users)}-U" for _ in range(32)]
+        t.put_many_columns(ks, [val(k, n) for k in ks])
+    else:  # CAS in place; drift falls back to LWW re-put like the
+        # update plane's repair path
+        ks = [f"{r.randrange(n_users)}-U" for _ in range(8)]
+        exp = [t.get(k) for k in ks]
+        vals = [val(k, n) for k in ks]
+        failed = t.cas_many_columns(ks, exp, vals)
+        if failed:
+            t.put_many_columns([ks[i] for i in failed],
+                               [vals[i] for i in failed])
     if time.time() - last_pub > 0.2:
         last_pub = time.time()
         snap.publish(snaps, t, int(time.time() * 1000),
@@ -1394,7 +1408,9 @@ while True:
 
 def arena_main() -> int:
     """SIGKILL the single arena writer mid-row and mid-publish while
-    lock-free readers hammer the same mmap.  Contracts under test
+    lock-free readers hammer the same mmap.  The writer alternates
+    single Python puts, native C++ columnar batches, and CAS-in-place
+    updates so every write path faces the kill.  Contracts under test
     (serve/arena.py): a kill never yields a TORN row to any reader (the
     seqlock leaves the slot odd -> reads as missing, never garbage), the
     kernel releases the writer flock so the respawn attaches and its

@@ -492,6 +492,277 @@ def test_update_worker_writes_arena_in_place(tmp_path):
         t.close()
 
 
+# -- native write plane (round 17) -------------------------------------------
+
+def test_batch_writer_byte_parity_fuzz(tmp_path, monkeypatch):
+    """The C++ batch writer must be byte-for-byte the Python writer: the
+    same randomized batch sequence (inserts, in-place updates, growth
+    triggered mid-batch by load factor / oversize rows) produces an
+    IDENTICAL arena file either way — seqlock values, untouched value
+    tails, header counters and all."""
+    rng = random.Random(17)
+    pool = [f"{rng.randrange(3000)}-{'UI'[rng.randrange(2)]}"
+            for _ in range(2500)]
+    batches = []
+    for _ in range(30):
+        n = rng.randrange(1, 200)
+        ks = [rng.choice(pool) for _ in range(n)]
+        vs = [";".join(f"{rng.uniform(-9, 9):.4f}"
+                       for _ in range(rng.randrange(1, 6)))
+              for _ in range(n)]
+        batches.append((ks, vs))
+    # one batch straddles a geometry flip: an oversize value mid-batch
+    # forces the native path's grow-and-resume fallback
+    batches.insert(10, ([f"g{i}" for i in range(50)],
+                        ["x" * 300 if i == 25 else f"v{i}"
+                         for i in range(50)]))
+
+    def build(native: bool) -> bytes:
+        monkeypatch.setenv("TPUMS_ARENA_BATCH", "1" if native else "0")
+        t = ArenaModelTable(4, dir=str(tmp_path / f"n{int(native)}"),
+                            capacity=256, stride=32, key_cap=16)
+        try:
+            assert (t._writer_h is not None) == native
+            for ks, vs in batches:
+                t.put_many_columns(list(ks), list(vs))
+            t.flush()
+            path = t.arena.path
+        finally:
+            t.close()
+        with open(path, "rb") as f:
+            return f.read()
+
+    native_bytes = build(True)
+    assert native_bytes == build(False)
+    assert len(native_bytes) > ar.HEADER_SIZE
+
+
+def test_put_many_columns_newline_rows_fall_back(tmp_path):
+    """Rows with embedded newlines can't ride the '\\n'-joined columnar
+    blobs — the per-row path must absorb them transparently."""
+    t = ArenaModelTable(2, dir=str(tmp_path / "a"))
+    try:
+        t.put_many_columns(["a", "b"], ["line1\nline2", "plain"])
+        assert t.get("a") == "line1\nline2"
+        assert t.get("b") == "plain"
+    finally:
+        t.close()
+
+
+def test_cas_many_columns_semantics(tmp_path):
+    t = ArenaModelTable(2, dir=str(tmp_path / "a"))
+    try:
+        t.put_many_columns(["k1", "k2", "k3"], ["a", "b", "c"])
+        v0 = t.version
+        failed = t.cas_many_columns(
+            ["k1", "k2", "missing", "k3"],
+            ["a", "WRONG", "x", None],
+            ["a2", "b2", "x2", "c2"])
+        assert failed == [1, 2, 3]  # drift, missing key, None expected
+        assert t.get("k1") == "a2"  # swapped in place
+        assert t.get("k2") == "b"   # drift NOT clobbered — LWW is caller's
+        assert t.get("k3") == "c"
+        assert t.version == v0 + 1 and t.puts >= 4
+    finally:
+        t.close()
+
+
+def test_cas_vs_put_sgd_batch_parity(tmp_path):
+    """Applying an online/sgd.py vectorized batch through CAS-in-place
+    must land the exact same state (same file bytes) as the re-put path
+    the update plane used before."""
+    from flink_ms_tpu.online.sgd import SGDStep
+
+    rng = random.Random(99)
+    seeds = {}
+    for i in range(40):
+        seeds[f"{i}-U"] = ";".join(
+            f"{rng.uniform(-1, 1):.4f}" for _ in range(4))
+        seeds[f"{i}-I"] = ";".join(
+            f"{rng.uniform(-1, 1):.4f}" for _ in range(4))
+    ratings = [(rng.randrange(40), rng.randrange(40), rng.uniform(1, 5))
+               for _ in range(120)]  # repeated keys exercise CAS drift
+
+    def make(name):
+        t = ArenaModelTable(2, dir=str(tmp_path / name), capacity=1024)
+        t.put_many_columns(list(seeds), [seeds[k] for k in seeds])
+        return t
+
+    ta, tb = make("cas"), make("put")
+    mean = "0.5;0.5;0.5;0.5"
+    step = SGDStep(ta.get, mean, mean,
+                   lookup_many=lambda ks: [ta.get(k) for k in ks])
+    rows = step.process_batch(ratings)
+    updates = []
+    for row in rows:
+        id_, typ, vec = row.split(",", 2)
+        updates.append((f"{id_}-{typ}", vec))
+    keys = [k for k, _ in updates]
+    vals = [v for _, v in updates]
+    # CAS path: expected = the value on disk BEFORE this batch (what the
+    # update worker recorded at read time); intra-batch repeats drift and
+    # fall back to the LWW re-put, exactly like the worker does
+    expected = [ta.get(k) for k in keys]
+    failed = ta.cas_many_columns(keys, expected, vals)
+    if failed:
+        ta.put_many_columns([keys[i] for i in failed],
+                            [vals[i] for i in failed])
+    tb.put_many_columns(keys, vals)
+    try:
+        assert dict(ta.items()) == dict(tb.items())
+        ta.flush()
+        tb.flush()
+        with open(ta.arena.path, "rb") as fa, \
+                open(tb.arena.path, "rb") as fb:
+            assert fa.read() == fb.read()
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_native_metrics_includes_write_plane_counters(tmp_path):
+    """The C++ METRICS verb splices the writer.stats sidecar counters, so
+    server processes export the write plane without any Python push."""
+    t = ArenaModelTable(2, dir=str(tmp_path / "a"))
+    try:
+        if t._writer_h is None:
+            pytest.skip("native batch writer unavailable (no toolchain)")
+        t.put_many_columns([f"k{i}" for i in range(128)], ["v"] * 128)
+        failed = t.cas_many_columns(["k1", "k2"], ["v", "nope"],
+                                    ["w1", "w2"])
+        assert failed == [1]
+        a = NativeArena(str(tmp_path / "a"))
+        try:
+            ws = a.write_stats()
+            assert ws is not None and ws["batch_rows"] >= 128
+            assert ws["cas_success"] >= 1 and ws["cas_retry"] >= 1
+            with NativeLookupServer(a, ALS_STATE, job_id="jid",
+                                    port=0) as srv:
+                reply = _raw(srv.port, b"METRICS\n").decode()
+            snap = json.loads(reply[2:])
+            counters = {c["name"]: c["value"] for c in snap["counters"]}
+            assert counters["tpums_arena_batch_rows_total"] >= 128
+            assert counters["tpums_arena_batch_put_seconds_total"] > 0
+            assert counters["tpums_arena_cas_success_total"] >= 1
+            assert counters["tpums_arena_cas_retry_total"] >= 1
+        finally:
+            a.close()
+    finally:
+        t.close()
+
+
+def test_b2_64get_frame_reply_syscall_budget(tmp_path):
+    """Acceptance: a 64-GET B2 frame costs <= 4 reply-path syscalls with
+    io_uring; the epoll + scatter-gather sendmsg fallback must still beat
+    64 per-reply send() calls by >= 8x.  Counted through the server's own
+    io accounting (tpums_server_io_stats) — strace is unavailable in the
+    CI sandbox."""
+    from flink_ms_tpu.serve import proto
+
+    t = ArenaModelTable(4, dir=str(tmp_path / "a"))
+    try:
+        keys = [f"{i}-U" for i in range(64)]
+        t.put_many_columns(keys, [f"{i}.5" for i in range(64)])
+
+        def read_frame(s, buf):
+            while True:
+                res = proto.decode_reply_frame(buf, 0)
+                if res is not None:
+                    return res[0], buf[res[1]:]
+                chunk = s.recv(1 << 20)
+                assert chunk, "server closed mid-frame"
+                buf += chunk
+
+        with NativeLookupServer(NativeArena(str(tmp_path / "a")),
+                                ALS_STATE, job_id="jid", port=0) as srv:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=10) as s:
+                f = s.makefile("rb")
+                s.sendall(b"HELLO\tB2\n")
+                assert f.readline() == b"HELLO\tB2\n"
+                # warm the connection: the first frame pays one-time costs
+                s.sendall(proto.encode_request_frame(["PING"]))
+                _, rest = read_frame(s, b"")
+                before = srv.io_stats()
+                s.sendall(proto.encode_request_frame(
+                    [f"GET\t{ALS_STATE}\t{k}" for k in keys]))
+                texts, _ = read_frame(s, rest)
+                after = srv.io_stats()
+        assert len(texts) == 64
+        assert all(x.startswith("V\t") for x in texts)
+        delta = after["reply_syscalls"] - before["reply_syscalls"]
+        if after["uring"]:
+            assert delta <= 4, f"{delta} reply syscalls with io_uring"
+        else:
+            # skip reason for the <=4 budget: io_uring unavailable on
+            # this kernel (TPUMS_URING=0 or probe failed) — hold the
+            # fallback to the >=8x-vs-per-reply-send bound instead
+            assert delta <= 8, f"{delta} reply syscalls on sendmsg fallback"
+    finally:
+        t.close()
+
+
+_KILL_BATCH_WRITER = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from flink_ms_tpu.serve.arena import ArenaModelTable
+t = ArenaModelTable(2, dir={dir!r}, capacity=1024)
+assert t._writer_h is not None, "native batch writer required"
+keys = [f"k{{i}}" for i in range(64)]
+t.put_many_columns(keys, [f"v{{i}}" for i in range(64)])
+t.flush()
+print("SEEDED", flush=True)
+i = 0
+while True:  # hot native batch + CAS loop until SIGKILLed mid-call
+    vals = [f"update-{{i}}-{{j}}" for j in range(64)]
+    t.put_many_columns(keys, vals)
+    t.cas_many_columns(["k7"], [vals[7]], [f"cas-{{i}}"])
+    i += 1
+"""
+
+
+def test_sigkill_mid_native_batch_no_torn_rows(tmp_path):
+    """SIGKILL during the C++ batch writer / CAS hot loop: every row
+    post-mortem is a VALID value from some write (or missing via an
+    odd-stuck seq) — never interleaved garbage — and a respawned writer
+    repairs the arena."""
+    adir = str(tmp_path / "a")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _KILL_BATCH_WRITER.format(repo=repo, dir=adir)],
+        stdout=subprocess.PIPE)
+    try:
+        assert proc.stdout.readline().strip() == b"SEEDED"
+        a = NativeArena(adir)
+        try:
+            time.sleep(0.1)  # let the native hot loop spin
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            for j in range(64):
+                v = a.get(f"k{j}")
+                ok = (
+                    v is None or v == f"v{j}"
+                    or (v.startswith("update-") and v.endswith(f"-{j}"))
+                    or (j == 7 and v.startswith("cas-"))
+                )
+                assert ok, f"torn row k{j}: {v!r}"
+        finally:
+            a.close()
+        t = ArenaModelTable(2, dir=adir)
+        try:
+            t.put_many_columns([f"k{j}" for j in range(64)],
+                               ["repaired"] * 64)
+            for j in range(64):
+                assert t.get(f"k{j}") == "repaired"
+        finally:
+            t.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
 # -- crash semantics (SIGKILL the writer process) ----------------------------
 
 _KILL_WRITER = r"""

@@ -222,7 +222,17 @@ def test_sharded_columnar_ownership_matches_scalar(tmp_path):
                     lambda: job.ingest_stats()["offset"]
                     >= journal.end_offset())
                 assert job.ingest_stats()["path"] == mode
-                slices[(mode, w)] = [dict(s) for s in job.table._shards]
+                # sharded fleet members default to the arena table now —
+                # rebuild the per-shard view through the table contract
+                # instead of reaching into dict-table internals
+                t = job.table
+                if hasattr(t, "_shards"):
+                    slices[(mode, w)] = [dict(s) for s in t._shards]
+                else:
+                    shards = [dict() for _ in range(t.n_shards)]
+                    for k, v in t.items():
+                        shards[t.shard_of(k)][k] = v
+                    slices[(mode, w)] = shards
             finally:
                 job.stop()
     for w in range(2):

@@ -145,6 +145,10 @@ t.close()
 '''
 wenv = dict(os.environ)
 wenv.pop("LD_PRELOAD", None)  # the writer is pure Python, uninstrumented
+# ... and must STAY pure Python: letting it open the native batch writer
+# would dlopen the instrumented lib into an interpreter without the
+# sanitizer runtime preloaded (asan aborts on the mismatched allocator)
+wenv["TPUMS_ARENA_BATCH"] = "0"
 w = subprocess.Popen([sys.executable, "-c", WRITER, arena_dir],
                      stdout=subprocess.PIPE, text=True, env=wenv)
 assert "READY" in w.stdout.readline()
@@ -201,6 +205,118 @@ with NativeLookupServer(arena, "ALS_MODEL", job_id="san-arena", port=0,
 assert not errors, errors
 arena.close()
 w.wait(timeout=30)
+print("WORKLOAD-OK")
+"""
+
+
+# The write-plane gate (round 17): unlike ARENA_WORKLOAD's uninstrumented
+# cross-process Python writer, BOTH sides of this race are instrumented
+# C++ in ONE process — the batch writer + CAS updater (ctypes straight
+# into tpums_arena_put_batch/tpums_arena_cas_floats, GIL released per
+# call) against the reader loop (epoll server verbs + direct handle
+# reads).  This is the real seqlock proof: tsan models every access pair
+# (claim/close seq stores, per-byte payload copies, header count/
+# mutations, the writer.stats sidecar fetch_adds vs the METRICS splice).
+# The arena is seeded before any thread starts and sized never to grow,
+# so the single-writer contract holds without the Python table lock.
+ARENA_WRITE_WORKLOAD = r"""
+import ctypes, os, socket, sys, tempfile, threading
+print("sanitizer-maps:", open("/proc/self/maps").read().count("san.so"),
+      file=sys.stderr)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+os.environ["TPUMS_ARENA_BATCH"] = "0"  # seed via pure Python (pre-race)
+from flink_ms_tpu.serve.arena import ArenaModelTable
+from flink_ms_tpu.serve.native_store import (
+    NativeArena, NativeLookupServer, _load_lib)
+
+d = tempfile.mkdtemp()
+arena_dir = os.path.join(d, "arena")
+table = ArenaModelTable(4, dir=arena_dir, capacity=4096, stride=64,
+                        key_cap=16)
+keys = [f"{i}-U" for i in range(200)]
+table.put_many_columns(keys, ["0.5;1.5;2.5"] * len(keys))
+# the table object only holds the writer flock from here on: every
+# racing write below goes through the instrumented C++ writer handle
+lib = _load_lib()
+wh = lib.tpums_arena_writer_open(table.arena.path.encode(),
+                                 arena_dir.encode())
+assert wh, "writer open failed"
+
+errors = []
+stop = threading.Event()
+
+def native_writer():
+    kb64 = "\n".join(keys[:64]).encode()
+    k0 = keys[0].encode()
+    i = 0
+    mk = ctypes.c_uint32(0)
+    mv = ctypes.c_uint32(0)
+    while not stop.is_set():
+        vals = [f"{i};{j}" for j in range(64)]
+        vbuf = "\n".join(vals).encode()
+        n = lib.tpums_arena_put_batch(wh, kb64, len(kb64), vbuf, len(vbuf),
+                                      64, ctypes.byref(mk), ctypes.byref(mv))
+        if n != 64:
+            errors.append(f"put_batch applied {n}")
+            return
+        e0 = vals[0].encode()
+        # CAS the row just written (single writer: must swap) ...
+        if lib.tpums_arena_cas_floats(wh, k0, len(k0), e0, len(e0),
+                                      b"9;9", 3) != 1:
+            errors.append("cas swap failed")
+            return
+        # ... then against a stale expect (must report a retry, not swap)
+        if lib.tpums_arena_cas_floats(wh, k0, len(k0), b"stale", 5,
+                                      b"8;8", 3) != 0:
+            errors.append("stale cas did not miss")
+            return
+        i += 1
+
+arena = NativeArena(arena_dir)
+with NativeLookupServer(arena, "ALS_MODEL", job_id="san-wr", port=0,
+                        topk_suffixes=("-I", "-U")) as srv:
+    def querier():
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=10) as s:
+                f = s.makefile("rb")
+                for i in range(400):
+                    s.sendall(b"GET\tALS_MODEL\t%d-U\n" % (i % 200))
+                    if f.readline()[:1] not in (b"V", b"N"):
+                        errors.append("bad reply")
+                    if i % 50 == 0:
+                        # METRICS reads the writer.stats sidecar the
+                        # writer thread is fetch_add-ing right now
+                        s.sendall(b"METRICS\n")
+                        if not f.readline().startswith(b"J\t"):
+                            errors.append("bad METRICS reply")
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def direct_reader():
+        try:
+            for i in range(400):
+                arena.get(f"{i % 200}-U")
+                if i % 16 == 0:
+                    arena.stats()
+                    len(arena)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    wt = threading.Thread(target=native_writer)
+    threads = [threading.Thread(target=querier) for _ in range(3)]
+    threads += [threading.Thread(target=direct_reader) for _ in range(2)]
+    wt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    wt.join()
+assert not errors, errors
+lib.tpums_arena_writer_close(wh)
+arena.close()
+table.close()
 print("WORKLOAD-OK")
 """
 
@@ -299,6 +415,36 @@ def test_arena_reader_race_free_under_tsan():
         "tsan", rt,
         {"TSAN_OPTIONS": "exitcode=0 report_thread_leaks=0"},
         workload=ARENA_WORKLOAD,
+    )
+
+
+@pytest.mark.slow
+def test_arena_batch_writer_race_free_under_tsan():
+    """Instrumented C++ batch writer + CAS updater racing the instrumented
+    C++ reader loop in one process — the full seqlock access-pair proof
+    (see ARENA_WRITE_WORKLOAD's note)."""
+    rt = _runtime("libtsan.so")
+    if not rt:
+        pytest.skip("libtsan not available")
+    _run_gate(
+        "tsan", rt,
+        {"TSAN_OPTIONS": "exitcode=0 report_thread_leaks=0"},
+        workload=ARENA_WRITE_WORKLOAD,
+    )
+
+
+@pytest.mark.slow
+def test_arena_batch_writer_clean_under_asan():
+    """The batch writer's memchr row walk and the CAS probe loop must stay
+    inside the mapping (and the writer.stats sidecar inside its 64 bytes)
+    under asan."""
+    rt = _runtime("libasan.so")
+    if not rt:
+        pytest.skip("libasan not available")
+    _run_gate(
+        "asan", rt,
+        {"ASAN_OPTIONS": "detect_leaks=0:exitcode=0:verify_asan_link_order=0"},
+        workload=ARENA_WRITE_WORKLOAD,
     )
 
 
