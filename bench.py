@@ -41,7 +41,7 @@ BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
 serving_native,serving_update_plane,serving_rollout,serving_ann,
 serving_watch,serving_autopilot,serving_forensics,serving_geo,
-serving_arena,serving_arena_ingest; default all),
+serving_arena,serving_arena_ingest,serving_edge; default all),
 BENCH_ANN_ROWS_EXACT / BENCH_ANN_ROWS_IVF / BENCH_ANN_ARM_TIMEOUT_S
 (retrieval-plane A/B arm sizes: sharded-exact question at 1M rows,
 IVF question at 10M, recall@100 >= 0.95 gate recorded),
@@ -887,6 +887,9 @@ _COMPACT_KEYS = (
     "serving_geo_repl_lag_p50_ms", "serving_geo_repl_lag_p99_ms",
     "serving_geo_stale_reads", "serving_geo_staleness_max_s",
     "serving_geo_failover_ms", "serving_geo_errors", "serving_geo_ok",
+    "serving_edge_overhead_p99_us", "serving_edge_coalesce_hit_rate",
+    "serving_edge_hedge_p999_ratio", "serving_edge_idle_kb_per_conn",
+    "serving_edge_core_starved", "serving_edge_errors", "serving_edge_ok",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1142,7 +1145,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
         "serving_watch,serving_autopilot,serving_forensics,serving_geo,"
-        "serving_arena,serving_arena_ingest"
+        "serving_arena,serving_arena_ingest,serving_edge"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1237,6 +1240,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_arena", "run_serving_arena_section",
          lambda f: f(small)),
         ("serving_arena_ingest", "run_serving_arena_ingest_section",
+         lambda f: f(small)),
+        ("serving_edge", "run_serving_edge_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

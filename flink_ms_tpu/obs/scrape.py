@@ -269,12 +269,34 @@ def fleet_signals(before: dict, after: dict,
                            retries mean update workers are losing races
                            to the ingest writer and falling back to LWW
                            re-puts}
+
+    Edge proxy tier (round 18 — ``serve/edge.py``; proxies register in
+    the registry like workers, so ``scrape_fleet`` reaches them through
+    the same METRICS verb):
+
+        {"edge_open_connections": fleet-summed downstream connections
+                           held open at AFTER (the tier's fan-in),
+         "edge_coalesce_per_s": in-flight GET coalesce hits/s over the
+                           window (requests answered WITHOUT an upstream
+                           round trip),
+         "edge_hedges_per_s": hedged requests fired/s,
+         "edge_hedge_wins_per_s": hedges whose backup reply won/s —
+                           fired without wins means the trigger is too
+                           twitchy; wins without fires is impossible,
+         "edge_shed_per_s": edge-side admission sheds/s (refused before
+                           any upstream bytes),
+         "edge_p99_s":     through-proxy p99 over the proxy's own query
+                           verbs at AFTER (same log-bucket ladder as the
+                           server's, so edge overhead is one
+                           subtraction; None when no proxy served)}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
                    1e-9)
     b_h = {(h["name"], tuple(sorted(h.get("labels", {}).items()))): h
            for h in _query_hists(before)}
+    b_all = {(h["name"], tuple(sorted(h.get("labels", {}).items()))): h
+             for h in before.get("histograms", [])}
     requests = 0
     window = None  # delta histogram across all query verbs
     for h in _query_hists(after):
@@ -417,6 +439,53 @@ def fleet_signals(before: dict, after: dict,
     cas_retry = max(
         _counter_total(after, "tpums_arena_cas_retry_total")
         - _counter_total(before, "tpums_arena_cas_retry_total"), 0.0)
+    # edge proxy tier (round 18 — serve/edge.py): open downstream
+    # connections SUM across proxies (the tier's fan-in), coalesce hits /
+    # hedges / edge sheds as RATES (tail management doing work vs. sitting
+    # idle), and the through-proxy p99 from the proxy's own latency ladder
+    # (same log buckets as the server's, so direct-vs-edge overhead is one
+    # subtraction)
+    edge_conns = sum(
+        g["value"] for g in after.get("gauges", [])
+        if g["name"] == "tpums_edge_open_connections")
+    edge_coalesce = max(
+        _counter_total(after, "tpums_edge_coalesce_hits_total")
+        - _counter_total(before, "tpums_edge_coalesce_hits_total"), 0.0)
+    edge_hedges = max(
+        _counter_total(after, "tpums_edge_hedges_total")
+        - _counter_total(before, "tpums_edge_hedges_total"), 0.0)
+    edge_hedge_wins = max(
+        sum(c["value"] for c in after.get("counters", [])
+            if c["name"] == "tpums_edge_hedges_total"
+            and c.get("labels", {}).get("result") == "won")
+        - sum(c["value"] for c in before.get("counters", [])
+              if c["name"] == "tpums_edge_hedges_total"
+              and c.get("labels", {}).get("result") == "won"), 0.0)
+    edge_shed = max(
+        _counter_total(after, "tpums_edge_shed_total")
+        - _counter_total(before, "tpums_edge_shed_total"), 0.0)
+    edge_window = None  # delta histogram across the proxy's query verbs
+    for h in after.get("histograms", []):
+        if h["name"] != "tpums_edge_latency_seconds":
+            continue
+        if h.get("labels", {}).get("verb") in _NON_QUERY_VERBS:
+            continue
+        k = (h["name"], tuple(sorted(h.get("labels", {}).items())))
+        prev = b_all.get(k, {"counts": [0] * len(h["counts"]),
+                             "count": 0, "sum": 0.0})
+        dc = h["count"] - prev["count"]
+        if dc <= 0:
+            continue
+        dcounts = [a - b for a, b in zip(h["counts"], prev["counts"])]
+        if edge_window is None:
+            edge_window = {"name": "edge_window", "le": list(h["le"]),
+                           "counts": dcounts, "count": dc,
+                           "sum": h["sum"] - prev["sum"]}
+        elif edge_window["le"] == list(h["le"]):
+            edge_window["counts"] = [a + b for a, b in
+                                     zip(edge_window["counts"], dcounts)]
+            edge_window["count"] += dc
+            edge_window["sum"] += h["sum"] - prev["sum"]
     return {
         **autopilot,
         "qps": requests / dt_s,
@@ -442,6 +511,13 @@ def fleet_signals(before: dict, after: dict,
         "arena_batch_rows_per_s": batch_rows / dt_s,
         "arena_cas_success_per_s": cas_success / dt_s,
         "arena_cas_retry_per_s": cas_retry / dt_s,
+        "edge_open_connections": edge_conns,
+        "edge_coalesce_per_s": edge_coalesce / dt_s,
+        "edge_hedges_per_s": edge_hedges / dt_s,
+        "edge_hedge_wins_per_s": edge_hedge_wins / dt_s,
+        "edge_shed_per_s": edge_shed / dt_s,
+        "edge_p99_s": (snapshot_quantile(edge_window, 99)
+                       if edge_window else None),
         "dt_s": dt_s,
         "requests": requests,
     }

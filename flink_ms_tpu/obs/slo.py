@@ -71,6 +71,11 @@ DISRUPTIVE_KINDS = frozenset({
     # kinds under the rollout controller's event prefix)
     "rollout_scale_start", "rollout_cutover", "rollout_drained",
     "rollout_scale_abort", "rollout_verified", "rollout_rollback",
+    # edge proxy tier (serve/edge.py): a fired hedge, an edge-side
+    # admission shed and a client rotating to a surviving proxy are all
+    # deliberate tail/failure management — attributable, never paged as
+    # unexplained
+    "edge_hedge", "edge_shed", "proxy_reconnect",
 })
 
 DEFAULT_ATTRIBUTION_WINDOW_S = 5.0

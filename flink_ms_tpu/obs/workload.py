@@ -599,6 +599,7 @@ _TIMELINE_KINDS = (
     "replica_respawn", "autoscale_decision",
     "rollout_scale_start", "rollout_cutover", "rollout_drained",
     "rollout_scale_abort", "rollout_verified", "rollout_rollback",
+    "edge_hedge", "edge_shed", "proxy_reconnect",
 )
 
 # query verbs an abusive tenant replays (UPDATE rides the journal/update
@@ -653,6 +654,7 @@ def run_rehearsal(
     watch_rules=None,
     watch_canary=None,
     watch_interval_s: float = 0.5,
+    edge: int = 0,
 ) -> dict:
     """The closed loop: elastic sharded group + open-loop zipfian mixed-verb
     engine + autoscaler + one chaos kill, all acting on the same fleet,
@@ -679,6 +681,14 @@ def run_rehearsal(
     probes live model quality) and the report gains an ``"alerts"``
     section — the live incident timeline with per-kill detection latency
     and attribution, instead of only the terminal SLO post-mortem.
+
+    With ``edge > 0`` that many edge proxies (``serve/edge.py``) are
+    spawned in front of the fleet and EVERY client thread becomes an
+    ``EdgeClient`` — the full verb mix runs through the proxy tier
+    (multiplexing, coalescing, hedging, edge admission), and the SLO
+    attribution must still come out clean: ``edge_hedge``/``edge_shed``/
+    ``proxy_reconnect`` are timeline events, never unattributed errors.
+    In attach mode the proxies must already be registered for the group.
     """
     from . import slo as obs_slo
     from .scrape import scrape_fleet
@@ -722,6 +732,7 @@ def run_rehearsal(
     ctl = None
     autoscaler = None
     watcher = None
+    edge_procs: list = []
     sampler_stop = threading.Event()
     scrapes: List[Tuple[float, dict]] = []
 
@@ -764,6 +775,10 @@ def run_rehearsal(
                                   extra_args=extra_args)
             ctl.scale_to(shards, replicas=replication)
             live_group = group
+            if edge > 0:
+                from ..serve.edge import spawn_edge_procs
+                edge_procs, _ = spawn_edge_procs(
+                    live_group, edge, os.path.join(base, "edge_ports"))
             if autoscale != "off":
                 # trip on the burst, not the ramp: threshold above the
                 # per-shard peak rate but below the per-shard burst rate
@@ -784,20 +799,37 @@ def run_rehearsal(
             kill = False
             autoscale = "off"
 
-        def client_factory():
-            from ..serve.elastic import ElasticClient
-            return ElasticClient(
-                live_group, timeout_s=10.0,
-                retry=RetryPolicy(attempts=6, backoff_s=0.02,
-                                  max_backoff_s=0.5))
+        if edge > 0:
+            # every worker thread talks to the proxy tier: one thin
+            # connection, no shard/generation knowledge client-side
+            def client_factory():
+                from ..serve.edge import EdgeClient
+                return EdgeClient(
+                    live_group, timeout_s=10.0,
+                    retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                      max_backoff_s=0.5))
+        else:
+            def client_factory():
+                from ..serve.elastic import ElasticClient
+                return ElasticClient(
+                    live_group, timeout_s=10.0,
+                    retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                      max_backoff_s=0.5))
 
         client_factories = None
         if abusive_qps > 0:
             def abusive_factory():
-                from ..serve.elastic import ElasticClient
                 # tenant= rides the wire (tab: trailing tn= field; B2:
                 # HELLO-bound); sheds come back as "E\tover quota"
                 # RuntimeErrors, which the HA client does NOT failover on
+                if edge > 0:
+                    from ..serve.edge import EdgeClient
+                    return EdgeClient(
+                        live_group, timeout_s=10.0,
+                        retry=RetryPolicy(attempts=6, backoff_s=0.02,
+                                          max_backoff_s=0.5),
+                        tenant=ABUSIVE_TENANT)
+                from ..serve.elastic import ElasticClient
                 return ElasticClient(
                     live_group, timeout_s=10.0,
                     retry=RetryPolicy(attempts=6, backoff_s=0.02,
@@ -923,6 +955,7 @@ def run_rehearsal(
                 "zipf_exponent": zipf_exponent,
                 "seed": seed,
                 "abusive_qps": abusive_qps,
+                "edge": edge,
             },
         )
         if alerts_section is not None:
@@ -943,6 +976,12 @@ def run_rehearsal(
         if autoscaler is not None:
             try:
                 autoscaler.stop()
+            except Exception:
+                pass
+        if edge_procs:
+            try:
+                from ..serve.edge import stop_edge_procs
+                stop_edge_procs(edge_procs)
             except Exception:
                 pass
         if ctl is not None:
@@ -991,6 +1030,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         zipf_exponent=float(params.get("zipf", "1.1")),
         abusive_qps=float(params.get("abusiveQps", "0")),
         watch=params.get_int("watch", 0) != 0,
+        edge=params.get_int("edge", 0),
     )
     sys.stderr.write(obs_slo.human_summary(report) + "\n")
     out = {

@@ -116,6 +116,13 @@ class QueryClient:
         if stale is None:
             stale = os.environ.get("TPUMS_GEO_STALE_READS", "0") != "0"
         self.stale = bool(stale)
+        # the exact wire field a staleness-opted request carries.  The
+        # default (``st=1``) is the frozen opt-in every server accepts;
+        # the edge proxy (serve/edge.py) additionally understands a
+        # numeric bound (``st=<seconds>``) here, which ``EdgeClient``
+        # installs — workers themselves never see the numeric form
+        # because the proxy strips it before routing upstream.
+        self._stale_ext = wire_proto.STALE_EXT
         self.last_staleness_s: Optional[float] = None
         self._sock: Optional[socket.socket] = None
         self._rfile = None
@@ -146,7 +153,7 @@ class QueryClient:
             if self._want_b2_trace:
                 hello += f"\t{wire_proto.TRACE_EXT}"
             if self.stale:
-                hello += f"\t{wire_proto.STALE_EXT}"
+                hello += f"\t{self._stale_ext}"
             sock.sendall(hello.encode("utf-8") + b"\n")
             line = self._rfile.readline()
             if not line:
@@ -198,7 +205,7 @@ class QueryClient:
         # byte-identical to the seed protocol.
         line = request
         if self.stale:
-            line = f"{line}\t{wire_proto.STALE_EXT}"
+            line = f"{line}\t{self._stale_ext}"
         if self.tenant is not None:
             line = f"{line}\t{admission_ctl.TENANT_FIELD}{self.tenant}"
         data = line.encode("utf-8") + b"\n"
@@ -394,7 +401,7 @@ class QueryClient:
         if self.stale:
             # tab plane: staleness per request, stamped FIRST so the
             # server's pops (tid, tenant, stale) compose
-            ssuffix = f"\t{wire_proto.STALE_EXT}"
+            ssuffix = f"\t{self._stale_ext}"
             requests = [req + ssuffix for req in requests]
         if self.tenant is not None:
             # tab plane: tenant per request (before the tid, same order as

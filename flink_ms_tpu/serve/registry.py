@@ -485,6 +485,27 @@ def list_region_jobs(region: Optional[str], gc: bool = True) -> List[dict]:
     return [e for e in list_jobs(gc=gc) if _entry_region(e) == region]
 
 
+# ---------------------------------------------------------------------------
+# edge-proxy namespace (serve/edge.py)
+# ---------------------------------------------------------------------------
+
+EDGE_PREFIX = "edge/"
+
+
+def edge_group(group: str, region: Optional[str] = None) -> str:
+    """The registry replica-group carrying a serving group's EDGE PROXY
+    endpoints — ``edge/<region>@@<tenant>::<group>``.
+
+    Proxies register under it with ``replica_of=edge_group(g)`` (one
+    entry per proxy, ``replica=<index>``) and re-register on the
+    heartbeat cadence like any worker, so ``resolve_replicas`` is the
+    one discovery path clients, smokes and the scraper all share.
+    Distinct from the group's shard topology record: the edge tier is
+    stateless and has no generations — proxies follow the data plane's
+    topology record, they never appear in it."""
+    return f"{EDGE_PREFIX}{qualify_region(qualify_group(group), region)}"
+
+
 def gc_region_entries(region: str) -> int:
     """Reap DEAD worker entries of ONE region -> count reaped.  Same
     structural-isolation statement as ``gc_tenant_entries``: only entries

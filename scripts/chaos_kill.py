@@ -63,7 +63,14 @@ Knobs (env):
                            respawn takes the kernel-released flock and
                            its replay pass repairs every row, reader
                            availability stays 1.0, and bootstrap walks
-                           past any mid-publish-torn snapshot member)
+                           past any mid-publish-torn snapshot member),
+                           or "edge" (run mixed tab/B2 load through the
+                           edge proxy tier, SIGSTOP-then-SIGKILL one
+                           upstream replica and SIGKILL one proxy:
+                           hedged requests mask the stalled replica,
+                           the mark-down/retry path absorbs its death,
+                           clients rotate to the surviving proxy, and
+                           no client ever sees an error)
     CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
                            history over few keys so the fold has work)
     CHAOS_UPDATE_BATCH=200 ratings per producer tick (update mode)
@@ -1586,6 +1593,189 @@ def arena_main() -> int:
     return 1 if failed else 0
 
 
+def edge_main() -> int:
+    """SIGKILL one upstream replica AND one edge proxy under sustained
+    mixed tab/B2 load through the proxy tier (serve/edge.py).  The
+    replica dies realistically — SIGSTOPped first (a stalling process
+    looks exactly like a tail-latency event, which is what hedging
+    exists for), then SIGKILLed mid-stall.  Contracts under test: zero
+    client-visible errors through both kills; the stalled replica is
+    masked by hedged requests to its HA sibling (``tpums_edge_hedges
+    _total{result=fired}`` moves at the proxies) and its death by the
+    proxy's mark-down-and-retry path; the supervisor respawns it; and
+    when a proxy itself dies, its clients rotate to the survivor
+    (``EdgeClient`` reconnect) and traffic keeps flowing."""
+    from flink_ms_tpu.serve.edge import (
+        EdgeClient, spawn_edge_procs, stop_edge_procs,
+    )
+    from flink_ms_tpu.serve.elastic import ScaleController
+
+    base = tempfile.mkdtemp(prefix="tpums_chaos_edge_")
+    os.environ.setdefault(
+        "TPUMS_REGISTRY_DIR", tempfile.mkdtemp(prefix="tpums_chaos_reg_"))
+    journal, keys = seed_journal(base)
+    replication = max(R, 2)  # the hedge needs a sibling to win on
+
+    ctl = ScaleController("chaos-edge", journal.dir, "models",
+                          port_dir=os.path.join(base, "ports"),
+                          ready_timeout_s=180)
+    event("chaos_edge_start", workers=W, replication=replication,
+          proxies=2)
+    ok = [0] * THREADS
+    errs = [0] * THREADS
+    err_sample = []
+    stop = threading.Event()
+
+    def load(widx):
+        c = EdgeClient(
+            "chaos-edge", prefer=widx,
+            proto=("b2" if widx % 2 else "tab"),
+            retry=RetryPolicy(attempts=8, backoff_s=0.02,
+                              max_backoff_s=0.5),
+            timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    if r.random() * 100.0 < TOPK_PCT:
+                        good = c.topk(ALS_STATE, key[:-2],
+                                      TOPK_K) is not None
+                    else:
+                        good = c.query_state(ALS_STATE, key) is not None
+                except Exception as e:
+                    good = False
+                    if len(err_sample) < 8:
+                        err_sample.append((key, repr(e)))
+                (ok if good else errs)[widx] += 1
+
+    def edge_counters(ports):
+        """Sum the hedge/reconnect counters across the live proxies."""
+        fired = reconnects = 0
+        for port in ports:
+            try:
+                with EdgeClient(endpoints=[("127.0.0.1", port)],
+                                timeout_s=5) as mc:
+                    snap = mc.metrics()
+            except Exception:
+                continue
+            for c in snap.get("counters", []):
+                if c.get("name") == "tpums_edge_hedges_total" and \
+                        c.get("labels", {}).get("result") == "fired":
+                    fired += c.get("value", 0)
+                elif c.get("name") == "tpums_edge_upstream_reconnects_total":
+                    reconnects += c.get("value", 0)
+        return fired, reconnects
+
+    def wait_recovered(sup, shard, replica, old_pid, timeout_s=60.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            members = registry.resolve_replicas(sup.group_of(shard))
+            if any(e.get("replica") == replica and e.get("ready")
+                   and e.get("pid") not in (None, old_pid)
+                   for e in members):
+                return True
+            time.sleep(0.05)
+        return False
+
+    procs = []
+    try:
+        ctl.scale_to(W, replicas=replication)
+        procs, ports = spawn_edge_procs(
+            "chaos-edge", 2, os.path.join(base, "edge_ports"),
+            env={
+                # fast hedge trigger so the stall window below is ample:
+                # arm after 16 latency samples per shard, fire at p90
+                # (floor 2ms) — a stopped replica trips this immediately
+                "TPUMS_EDGE_HEDGE_WARMUP": "16",
+                "TPUMS_EDGE_HEDGE_PCT": "90",
+                "TPUMS_EDGE_HEDGE_MIN_MS": "2.0",
+            })
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)  # warm the proxies' per-shard latency windows
+
+        fired0, reconn0 = edge_counters(ports)
+
+        # phase 1 — the upstream replica: SIGSTOP (the stall hedging
+        # must mask), then SIGKILL mid-stall (the death the mark-down
+        # path must absorb).  Only with its sibling ready, or errors
+        # would be expected rather than contract-violating.
+        sup = ctl.active_supervisor
+        victim_sr = (0, 0)
+        proc = sup.procs.get(victim_sr)
+        stalled = killed_replica = False
+        if proc is not None and proc.poll() is None and any(
+                e.get("replica") != victim_sr[1] and e.get("ready")
+                for e in registry.resolve_replicas(
+                    sup.group_of(victim_sr[0]))):
+            event("chaos_stall", shard=victim_sr[0],
+                  replica=victim_sr[1], pid=proc.pid)
+            proc.send_signal(signal.SIGSTOP)
+            stalled = True
+            time.sleep(1.0)  # hedges fire against the frozen replica
+            event("chaos_kill", shard=victim_sr[0],
+                  replica=victim_sr[1], pid=proc.pid,
+                  group=sup.group_of(victim_sr[0]))
+            proc.send_signal(signal.SIGKILL)
+            killed_replica = True
+        recovered = killed_replica and wait_recovered(
+            sup, victim_sr[0], victim_sr[1],
+            proc.pid if proc else None)
+        fired1, reconn1 = edge_counters(ports)
+
+        # phase 2 — the proxy: plain SIGKILL; its clients must rotate
+        # to the survivor and keep being served
+        ok_before = sum(ok)
+        event("chaos_kill", proxy=0, pid=procs[0].pid,
+              group=registry.edge_group("chaos-edge"))
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        time.sleep(2.0)
+        ok_after = sum(ok)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        total_ok, total_err = sum(ok), sum(errs)
+        summary = {
+            "mode": "edge", "workers": W, "replication": replication,
+            "proxies": 2,
+            "queries": total_ok + total_err,
+            "ok": total_ok, "errors": total_err,
+            "error_sample": err_sample,
+            "availability": round(
+                total_ok / max(total_ok + total_err, 1), 6),
+            "replica_stalled": stalled,
+            "replica_killed": killed_replica,
+            "replica_recovered": recovered,
+            "hedges_fired": round(fired1 - fired0),
+            "upstream_reconnects": round(reconn1 - reconn0),
+            "proxy_killed": procs[0].poll() is not None,
+            "ok_through_proxy_kill": ok_after - ok_before,
+            "timeline": [e for e in recent_events()
+                         if e["kind"].startswith(("chaos_", "edge_",
+                                                  "replica_"))],
+        }
+        print(json.dumps(summary, indent=1, default=str))
+        failed = (
+            total_err > 0                   # a client saw the chaos
+            or not killed_replica           # kill 1 never landed
+            or not recovered                # the respawn never came back
+            or fired1 - fired0 <= 0         # hedging never masked the stall
+            or procs[0].poll() is None      # kill 2 never landed
+            or ok_after - ok_before <= 0    # survivors absorbed nobody
+        )
+        return 1 if failed else 0
+    finally:
+        stop.set()
+        event("chaos_teardown", mode="edge")
+        stop_edge_procs(procs)
+        ctl.stop(drop_topology=True)
+
+
 def run_with_watch(mode_fn) -> int:
     """The watch arm (CHAOS_WATCH=1, default): run the mode under a live
     ``obs.watch.FleetWatcher`` and tighten the exit gate with the alert
@@ -1639,4 +1829,5 @@ if __name__ == "__main__":
                              "rollout": rollout_main,
                              "autopilot": autopilot_main,
                              "region": region_main,
-                             "arena": arena_main}.get(MODE, main)))
+                             "arena": arena_main,
+                             "edge": edge_main}.get(MODE, main)))

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""End-to-end smoke for the edge proxy tier (serve/edge.py): bring up a
+2-shard x 2-replica fleet fronted by 2 proxy processes, drive mixed
+tab/B2 client threads through the proxies, SIGKILL one proxy mid-load,
+and assert the contract the tier exists for —
+
+- zero unattributed client errors: every query either succeeds or is
+  transparently retried; a client pinned to the killed proxy rotates to
+  the survivor (``proxy_reconnect``) instead of surfacing the death;
+- full verb surface through the front door: GET/MGET/TOPK all answer
+  through the proxy with the same payloads a direct client sees.
+
+    python scripts/edge_smoke.py [env knobs below]
+
+Knobs (env):
+    SMOKE_USERS=120        model rows per side
+    SMOKE_THREADS=4        closed-loop client threads (alternating tab/B2)
+    SMOKE_SETTLE_S=1.5     load time before and after the proxy kill
+    TPUMS_HEARTBEAT_S / TPUMS_REPLICA_TTL_S: liveness cadence (defaults
+                           here: 0.25 / 1.5)
+
+Exit code 0 on success, 1 on any failed check.
+"""
+
+import json
+import os
+import random
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPUMS_HEARTBEAT_S", "0.25")
+os.environ.setdefault("TPUMS_REPLICA_TTL_S", "1.5")
+os.environ.setdefault("TPUMS_REGISTRY_DIR",
+                      tempfile.mkdtemp(prefix="tpums_edge_smoke_reg_"))
+
+from flink_ms_tpu.core import formats as F  # noqa: E402
+from flink_ms_tpu.serve.client import RetryPolicy  # noqa: E402
+from flink_ms_tpu.serve.consumer import ALS_STATE  # noqa: E402
+from flink_ms_tpu.serve.edge import (  # noqa: E402
+    EdgeClient, spawn_edge_procs, stop_edge_procs,
+)
+from flink_ms_tpu.serve.elastic import ScaleController  # noqa: E402
+from flink_ms_tpu.serve.journal import Journal  # noqa: E402
+
+N_USERS = int(os.environ.get("SMOKE_USERS", 120))
+THREADS = int(os.environ.get("SMOKE_THREADS", 4))
+SETTLE_S = float(os.environ.get("SMOKE_SETTLE_S", 1.5))
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="tpums_edge_smoke_")
+    journal = Journal(os.path.join(base, "bus"), "models")
+    rng = np.random.default_rng(7)
+    k = 4
+    journal.append(
+        [F.format_als_row(u, "U", rng.normal(size=k))
+         for u in range(N_USERS)]
+        + [F.format_als_row(i, "I", rng.normal(size=k))
+           for i in range(N_USERS)]
+    )
+    keys = [f"{u}-U" for u in range(N_USERS)] \
+        + [f"{i}-I" for i in range(N_USERS)]
+
+    checks = []
+
+    def check(name, ok, detail=""):
+        checks.append((name, bool(ok)))
+        print(f"  [{'ok' if ok else 'FAIL'}] {name}"
+              + (f" — {detail}" if detail and not ok else ""))
+
+    ok_counts = [0] * THREADS
+    errors = []
+    stop = threading.Event()
+
+    def load(widx):
+        # half the threads speak the frozen tab protocol, half negotiate
+        # B2 — both must ride the proxy (and the kill) identically
+        c = EdgeClient(
+            "edge-smoke", prefer=widx,
+            proto=("b2" if widx % 2 else "tab"),
+            retry=RetryPolicy(attempts=8, backoff_s=0.02,
+                              max_backoff_s=0.5),
+            timeout_s=5)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    if r.random() < 0.2:
+                        got = c.query_states(
+                            ALS_STATE,
+                            [keys[r.randrange(len(keys))]
+                             for _ in range(4)])
+                        if any(v is None for v in got):
+                            errors.append((widx, "mget", "miss"))
+                        else:
+                            ok_counts[widx] += 1
+                    elif r.random() < 0.1:
+                        if c.topk(ALS_STATE, str(r.randrange(N_USERS)),
+                                  5) is None:
+                            errors.append((widx, "topk", "miss"))
+                        else:
+                            ok_counts[widx] += 1
+                    elif c.query_state(ALS_STATE, key) is None:
+                        errors.append((widx, key, "miss"))
+                    else:
+                        ok_counts[widx] += 1
+                except Exception as e:  # noqa: BLE001 - the gate itself
+                    errors.append((widx, key, repr(e)))
+
+    ctl = ScaleController("edge-smoke", journal.dir, "models",
+                          port_dir=os.path.join(base, "ports"),
+                          ready_timeout_s=120)
+    procs = []
+    try:
+        t0 = time.time()
+        rec = ctl.scale_to(2, replicas=2)
+        check("fleet up: gen1, 2 shards x 2 replicas",
+              rec["gen"] == 1 and rec["shards"] == 2)
+        procs, ports = spawn_edge_procs(
+            "edge-smoke", 2, os.path.join(base, "edge_ports"))
+        check("2 proxies registered", len(ports) == 2, str(ports))
+
+        probe = EdgeClient("edge-smoke", timeout_s=10)
+        vals = probe.query_states(ALS_STATE, keys)
+        check("full coverage through proxy",
+              all(v is not None for v in vals),
+              f"{sum(v is None for v in vals)} missing")
+
+        threads = [threading.Thread(target=load, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        for t in threads:
+            t.start()
+        time.sleep(SETTLE_S)
+
+        # SIGKILL one proxy under load: its clients must rotate to the
+        # survivor (retry loop -> proxy_reconnect), never error out
+        victim = procs[0]
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+        check("proxy killed", victim.poll() is not None)
+        time.sleep(SETTLE_S * 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        mid_ok = sum(ok_counts)
+        check("zero unattributed client errors", not errors,
+              f"{len(errors)} errors, first: {errors[:3]}")
+        check("load ran through the kill", mid_ok > 0)
+        # the survivor absorbed the dead proxy's clients: queries kept
+        # succeeding after the kill via the remaining endpoint
+        post = EdgeClient("edge-smoke", timeout_s=10)
+        v = post.query_state(ALS_STATE, keys[0])
+        check("survivor serves after kill", v is not None)
+        post.close()
+        probe.close()
+        print(json.dumps({
+            "queries_ok": mid_ok,
+            "errors": len(errors),
+            "total_s": round(time.time() - t0, 2),
+        }, indent=1))
+    finally:
+        stop.set()
+        stop_edge_procs(procs)
+        ctl.stop(drop_topology=True)
+
+    failed = [n for n, ok_ in checks if not ok_]
+    print(("SMOKE PASS" if not failed else f"SMOKE FAIL: {failed}"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
