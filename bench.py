@@ -41,7 +41,8 @@ BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
 serving_native,serving_update_plane,serving_rollout,serving_ann,
 serving_watch,serving_autopilot,serving_forensics,serving_geo,
-serving_arena,serving_arena_ingest,serving_edge; default all),
+serving_arena,serving_arena_ingest,serving_edge,serving_profiler;
+default all),
 BENCH_ANN_ROWS_EXACT / BENCH_ANN_ROWS_IVF / BENCH_ANN_ARM_TIMEOUT_S
 (retrieval-plane A/B arm sizes: sharded-exact question at 1M rows,
 IVF question at 10M, recall@100 >= 0.95 gate recorded),
@@ -890,6 +891,10 @@ _COMPACT_KEYS = (
     "serving_edge_overhead_p99_us", "serving_edge_coalesce_hit_rate",
     "serving_edge_hedge_p999_ratio", "serving_edge_idle_kb_per_conn",
     "serving_edge_core_starved", "serving_edge_errors", "serving_edge_ok",
+    "serving_profiler_top_frame", "serving_profiler_top_share",
+    "serving_profiler_diff_ok", "serving_profiler_alert_fired",
+    "serving_profiler_page_names_frame", "serving_profiler_replicas",
+    "serving_profiler_native_stacks", "serving_profiler_ok",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1145,7 +1150,7 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
         "serving_watch,serving_autopilot,serving_forensics,serving_geo,"
-        "serving_arena,serving_arena_ingest,serving_edge"
+        "serving_arena,serving_arena_ingest,serving_edge,serving_profiler"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1242,6 +1247,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_arena_ingest", "run_serving_arena_ingest_section",
          lambda f: f(small)),
         ("serving_edge", "run_serving_edge_section",
+         lambda f: f(small)),
+        ("serving_profiler", "run_serving_profiler_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

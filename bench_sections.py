@@ -4162,3 +4162,223 @@ def run_serving_edge_section(small: bool) -> dict:
             os.environ["TPUMS_REGISTRY_DIR"] = saved
         shutil.rmtree(tmp, ignore_errors=True)
     return out
+
+# ---------------------------------------------------------------------------
+# continuous-profiling section: hot-frame attribution, CPU paging, fleet merge
+# ---------------------------------------------------------------------------
+
+def run_serving_profiler_section(small: bool) -> dict:
+    """Continuous-profiling efficacy (obs/profiler.py + obs/profdiff.py +
+    the watch plane's profile attach), the round-19 acceptance demo:
+
+    1. **injected hot function** — a synthetic busy loop burns CPU under
+       ``prof_stage("bench_hot")`` between two profiler snapshots; the
+       ``profdiff`` regression diff must rank that frame **#1** with
+       >= 90% delta-share (the CPU-gated sampler keeps the fleet's
+       parked threads out of the denominator).
+    2. **CPU alert carries the frame** — a watch-plane rate rule over
+       ``tpums_process_cpu_seconds_total`` must fire on the burn AND its
+       page must carry ``profile_top_frames`` naming the hot frame — the
+       page NAMES the regressing code, not just the number.
+    3. **fleet merge** — the PROFILE scrapes of two Python replicas and
+       one native lookup server fold into ONE artifact (associative
+       merge) holding both planes' cost: Python sampled stacks plus
+       ``native;<verb>`` self-time.
+
+    The hot-path overhead bar for the profiler lives in
+    scripts/obs_overhead_ab.py (<= 3% GET p50, ABAB), not here.
+    """
+    import math
+
+    from flink_ms_tpu.core import formats as F
+    from flink_ms_tpu.obs import profdiff as PD
+    from flink_ms_tpu.obs import profiler as P
+    from flink_ms_tpu.obs.rules import Rule
+    from flink_ms_tpu.obs.scrape import scrape_fleet_profiles
+    from flink_ms_tpu.obs.watch import FleetWatcher
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import (ALS_STATE, ServingJob,
+                                             make_backend,
+                                             parse_als_record)
+    from flink_ms_tpu.serve.journal import Journal
+    from flink_ms_tpu.serve.native_store import (NativeLookupServer,
+                                                 NativeStore)
+
+    n_users = 200 if small else 1_000
+    hot_s = float(os.environ.get("BENCH_PROF_HOT_S", 1.2))
+
+    tmp = tempfile.mkdtemp(prefix="tpums_prof_bench_")
+    saved = {k: os.environ.get(k)
+             for k in ("TPUMS_REGISTRY_DIR", "TPUMS_PROF", "TPUMS_PROF_HZ",
+                       "TPUMS_PROF_DIR", "TPUMS_PROF_FLUSH_S")}
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    os.environ["TPUMS_PROF"] = "1"
+    os.environ["TPUMS_PROF_HZ"] = "97"       # denser for a short bench
+    os.environ["TPUMS_PROF_DIR"] = os.path.join(tmp, "prof")
+    os.environ["TPUMS_PROF_FLUSH_S"] = "0.2"
+    P.stop_profiler()  # fresh instance picks up the bench knobs
+    out: dict = {}
+    jobs = []
+    nstore = nsrv = None
+    watcher = None
+    try:
+        rng = np.random.default_rng(0)
+        rows = [F.format_als_row(u, "U", rng.normal(size=4))
+                for u in range(n_users)]
+        for r in range(2):                   # two Python replicas
+            journal = Journal(os.path.join(tmp, f"bus{r}"), "models")
+            journal.append(rows)
+            # slow poll: the replicas idle during the burn, and a 10ms
+            # journal poll burns enough real CPU on a 1-core box to
+            # dilute the hot frame's delta-share
+            jobs.append(ServingJob(
+                journal, ALS_STATE, parse_als_record,
+                make_backend("memory", None),
+                host="127.0.0.1", port=0, poll_interval_s=0.25,
+            ).start())
+        for job in jobs:
+            assert job.wait_ready(120)
+        nstore = NativeStore(os.path.join(tmp, "nstore"))
+        for u in range(20):
+            nstore.put(f"{u}-U", "0.5;1.5;0.25;-1.0")
+        nsrv = NativeLookupServer(nstore, ALS_STATE, job_id="bench-native",
+                                  port=0).__enter__()
+        prof = P.get_profiler()
+        assert prof is not None and prof.running
+
+        # warm both planes so every replica has stacks / verb self-time
+        qrng = np.random.default_rng(1)
+        for job in jobs:
+            with QueryClient("127.0.0.1", job.port, timeout_s=600) as c:
+                c.topk(ALS_STATE, "1", 5)   # block through the jit warm
+                for _ in range(50):
+                    c.query_state(ALS_STATE,
+                                  f"{int(qrng.integers(0, n_users))}-U")
+        with QueryClient("127.0.0.1", nsrv.port, timeout_s=30) as c:
+            for _ in range(200):
+                c.query_state(ALS_STATE, f"{int(qrng.integers(0, 20))}-U")
+
+        # rate = increase / window_s (not elapsed), so the window must be
+        # about the burn length for a short burst to clear the bar
+        rule = Rule(name="bench_cpu_regression", kind="threshold",
+                    series=P.CPU_SECONDS_SERIES, mode="rate",
+                    window_s=3.0, op=">", value=0.5, severity="page")
+        watcher = FleetWatcher(interval_s=0.1, rules=[rule],
+                               scope="bench_profiler")
+        # settle: any straggling background compile (the replicas' topk
+        # warm threads) dilutes the hot frame's delta-share on 1 core
+        deadline = time.monotonic() + 30.0
+        quiet = 0
+        while quiet < 2 and time.monotonic() < deadline:
+            c0 = P._process_cpu_s()
+            time.sleep(0.25)
+            quiet = quiet + 1 if P._process_cpu_s() - c0 < 0.05 else 0
+
+        prof.flush()           # publish the CPU counter pre-burn
+        watcher.tick()         # baseline scrape: rate + profile prev
+
+        # -- 1. the injected hot function ------------------------------
+        def _burn(stop: float) -> float:
+            x = 0.0
+            while time.perf_counter() < stop:
+                x += math.sqrt(x + 1.0)
+            return x
+
+        base = prof.snapshot()
+        with P.prof_stage("bench_hot"):
+            _burn(time.perf_counter() + hot_s)
+        prof.flush()           # publish the burned CPU immediately
+        cur = prof.snapshot()
+
+        rep = PD.diff_profiles(base, cur)
+        frames = rep["frames"]
+        top = frames[0] if frames else {}
+        out["serving_profiler_samples"] = cur["samples"] - base["samples"]
+        out["serving_profiler_top_frame"] = top.get("frame")
+        out["serving_profiler_top_share"] = top.get("delta_share")
+        out["serving_profiler_diff_ok"] = bool(
+            str(top.get("frame", "")).endswith("._burn")
+            and top.get("delta_share", 0.0) >= 0.9)
+        _log(f"[bench:profiler] #1 frame {top.get('frame')} "
+             f"({100 * (top.get('delta_share') or 0):.0f}% of the gap, "
+             f"+{(top.get('delta_s') or 0):.2f}s)")
+
+        # -- 2. the CPU page names the frame ---------------------------
+        fired = None
+        for _ in range(20):
+            trs = watcher.tick()
+            fired = next((t for t in trs
+                          if t["kind"] == "alert_firing"
+                          and t["rule"] == rule.name), None)
+            if fired:
+                break
+            time.sleep(0.05)
+        paged = [str(f.get("frame", ""))
+                 for f in (fired or {}).get("profile_top_frames") or []]
+        out["serving_profiler_alert_fired"] = fired is not None
+        out["serving_profiler_page_frames"] = len(paged)
+        out["serving_profiler_page_names_frame"] = any(
+            f.endswith("._burn") for f in paged)
+        _log(f"[bench:profiler] CPU alert fired={fired is not None} "
+             f"page_frames={paged[:3]}")
+
+        # -- 3. fleet merge across planes ------------------------------
+        fleet = scrape_fleet_profiles()
+        native_prof = P.scrape_profile("127.0.0.1", nsrv.port)
+        merged = P.merge_profiles([fleet["fleet"]]
+                                  + ([native_prof] if native_prof else []))
+        native_keys = [k for k in merged["stacks"] if k.startswith("native;")]
+        python_keys = [k for k in merged["stacks"]
+                       if not k.startswith("native;")]
+        out["serving_profiler_replicas"] = fleet["scraped"]
+        out["serving_profiler_native_stacks"] = len(native_keys)
+        out["serving_profiler_merged_planes"] = merged["meta"]["planes"]
+        out["serving_profiler_merge_ok"] = (
+            fleet["scraped"] >= 2 and len(native_keys) >= 1
+            and len(python_keys) >= 1)
+        artifact = os.path.join(os.environ["TPUMS_PROF_DIR"],
+                                P.ARTIFACT_NAME)
+        out["serving_profiler_artifact"] = os.path.exists(artifact)
+        out["serving_profiler_ok"] = (
+            out["serving_profiler_diff_ok"]
+            and out["serving_profiler_alert_fired"]
+            and out["serving_profiler_page_names_frame"]
+            and out["serving_profiler_merge_ok"]
+            and out["serving_profiler_artifact"])
+        _log(f"[bench:profiler] replicas={fleet['scraped']} "
+             f"native_stacks={len(native_keys)} "
+             f"planes={merged['meta']['planes']} "
+             f"ok={out['serving_profiler_ok']}")
+    except Exception:
+        _log(traceback.format_exc())
+        out["serving_profiler_error"] = traceback.format_exc(limit=3)
+        out["serving_profiler_ok"] = False
+    finally:
+        if watcher is not None:
+            try:
+                watcher.stop()
+            except Exception:
+                pass
+        if nsrv is not None:
+            try:
+                nsrv.__exit__(None, None, None)
+            except Exception:
+                pass
+        if nstore is not None:
+            try:
+                nstore.close()
+            except Exception:
+                pass
+        for job in jobs:
+            try:
+                job.stop()
+            except Exception:
+                pass
+        P.stop_profiler()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out

@@ -114,6 +114,25 @@ LATENCY_BUCKETS_S: Tuple[float, ...] = log_buckets(1e-6, 100.0, 16)
 # Batch-size style ladder (1 .. 64k, 8/decade is plenty for integers).
 SIZE_BUCKETS: Tuple[float, ...] = log_buckets(1.0, 65536.0, 8)
 
+# Metric-name hygiene contract, enforced by a tier-1 lint
+# (tests/test_metric_hygiene.py) that walks the live registry after an
+# end-to-end smoke: every series name matches NAME_PATTERN, counters end
+# in ``_total``, and label KEYS come from this closed vocabulary.  Label
+# keys are schema — dashboards, recording rules, and the fleet merge all
+# join on them — so adding one is a deliberate act here, not a drive-by
+# in an instrument call.  (Label VALUES stay free-form.)
+NAME_PATTERN = r"^tpums_[a-z0-9_]+$"
+LABEL_VOCABULARY = frozenset({
+    "verb",     # wire verb (GET/MGET/TOPK/...)
+    "state",    # model state / table name
+    "tenant",   # admission-control tenant id
+    "kind",     # generic discriminator (event kind, rollout kind, ...)
+    "result",   # outcome discriminator (won/lost/fired/...)
+    "pid",      # per-process series that must NOT sum across a fleet
+    "topic",    # journal/georepl topic
+    "region",   # geo region id
+})
+
 
 # ---------------------------------------------------------------------------
 # instruments

@@ -394,4 +394,36 @@ def default_rules() -> List[Rule]:
              series="tpums_model_live_mse", mode="latest",
              op=">", value=2.0, for_s=0.0, severity="warn",
              description="live held-out MSE above drift threshold"),
+        # newer-plane baselines (round 19): these signals have existed in
+        # fleet_signals since rounds 15-17 but nothing paged on them —
+        # sustained CAS retries mean update workers are losing races to
+        # the ingest writer (LWW re-put churn), seqlock read retries mean
+        # hot-row write contention on the lock-free read path, and
+        # follower lag is the staleness bound georepl promises readers
+        Rule(name="arena_cas_retry_storm", kind="threshold",
+             series="tpums_arena_cas_retry_total", mode="rate",
+             window_s=60.0, op=">", value=100.0, for_s=30.0,
+             severity="warn",
+             description="arena CAS retries sustained above 100/s — "
+                         "update plane losing races to the ingest writer"),
+        Rule(name="arena_read_retry_storm", kind="threshold",
+             series="tpums_arena_read_retries_total", mode="rate",
+             window_s=60.0, op=">", value=1000.0, for_s=30.0,
+             severity="warn",
+             description="seqlock read retries sustained above 1000/s — "
+                         "hot-row write contention on the lock-free path"),
+        Rule(name="georepl_follower_lag", kind="threshold",
+             series="tpums_georepl_lag_seconds", mode="latest",
+             op=">", value=30.0, for_s=30.0, severity="page",
+             description="follower region trailing its leader by >30s"),
+        # continuous-profiling plane (round 19): a CPU regression pages,
+        # and the page carries profdiff's top-delta frames (the watcher
+        # diffs its previous profiler snapshot against the current one),
+        # closing the chain alert -> stage -> frames
+        Rule(name="process_cpu_regression", kind="threshold",
+             series="tpums_process_cpu_seconds_total", mode="rate",
+             window_s=60.0, op=">", value=0.9, for_s=30.0,
+             severity="warn",
+             description="process burning >0.9 CPU cores sustained — "
+                         "see attached profile_top_frames"),
     ]

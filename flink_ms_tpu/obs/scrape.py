@@ -30,9 +30,10 @@ from typing import Dict, List, Optional
 from ..serve import registry
 from .metrics import (LATENCY_BUCKETS_S, merge_snapshots, render_prometheus,
                       snapshot_quantile)
+from .profiler import merge_profiles, scrape_profile
 
 __all__ = ["scrape_endpoint", "scrape_fleet", "fleet_signals",
-           "snapshot_quantile", "main"]
+           "scrape_fleet_profiles", "snapshot_quantile", "main"]
 
 # scrape fan-out width: enough that one wedged endpoint can't stretch the
 # scrape past ~one timeout even on a wide fleet, small enough that a
@@ -160,9 +161,58 @@ def scrape_fleet(timeout_s: float = 2.0) -> dict:
     }
 
 
+def scrape_fleet_profiles(timeout_s: float = 2.0) -> dict:
+    """``scrape_fleet`` for the continuous-profiling plane: one PROFILE
+    round-trip per live registry entry, merged with the associative
+    ``profiler.merge_profiles`` fold (per-stack seconds sum — exactly how
+    METRICS snapshots merge through ``merge_snapshots``).
+
+    Returns::
+
+        {"replicas": [{"job_id", "host", "port", "profile"|None}, ...],
+         "fleet":    merged profile (Python sample-seconds and native
+                     per-verb CPU self-time in ONE stacks dict),
+         "scraped": N, "unreachable": M, "scrape_duration_s": ...}
+
+    A replica that answers METRICS but not PROFILE (pre-profiler build)
+    counts unreachable here but is NOT an error — the fleet profile is
+    simply missing that plane until its next rollout."""
+    t_start = time.time()
+    entries = registry.list_jobs()
+
+    def poll(entry: dict) -> Optional[dict]:
+        return scrape_profile(entry.get("host", "localhost"),
+                              entry["port"], timeout_s=timeout_s)
+
+    if entries:
+        with ThreadPoolExecutor(
+                max_workers=min(len(entries), _SCRAPE_POOL_MAX),
+                thread_name_prefix="tpums-profscrape") as pool:
+            polled = list(pool.map(poll, entries))
+    else:
+        polled = []
+
+    replicas = []
+    profiles = []
+    for entry, prof in zip(entries, polled):
+        replicas.append({"job_id": entry.get("job_id"),
+                         "host": entry.get("host"),
+                         "port": entry.get("port"),
+                         "profile": prof})
+        if prof is not None:
+            profiles.append(prof)
+    return {
+        "replicas": replicas,
+        "fleet": merge_profiles(profiles),
+        "scraped": len(profiles),
+        "unreachable": len(entries) - len(profiles),
+        "scrape_duration_s": round(time.time() - t_start, 6),
+    }
+
+
 # verbs that are plumbing, not user traffic — excluded from the qps signal
 # so a scrape/health poller can't talk an autoscaler into scaling out
-_NON_QUERY_VERBS = frozenset({"HEALTH", "METRICS", "PING"})
+_NON_QUERY_VERBS = frozenset({"HEALTH", "METRICS", "PING", "PROFILE"})
 
 
 def _query_hists(snapshot: dict) -> List[dict]:
@@ -289,6 +339,21 @@ def fleet_signals(before: dict, after: dict,
                            verbs at AFTER (same log-bucket ladder as the
                            server's, so edge overhead is one
                            subtraction; None when no proxy served)}
+
+    Continuous-profiling plane (round 19 — ``obs/profiler.py``; the
+    sampler's flush publishes these, so they ride the normal METRICS
+    scrape even though the stacks themselves travel over PROFILE):
+
+        {"prof_samples_per_s": profiler thread-samples/s across the fleet
+                           over the window (~hz x threads x replicas when
+                           healthy; 0 means the profiler is off or dead),
+         "process_cpu_per_s": fleet CPU-seconds burned per wall second
+                           over the window (getrusage user+sys deltas —
+                           i.e. cores actually busy; the watch plane's
+                           CPU-regression rule rates the same counter),
+         "native_self_cpu_per_s": CPU-seconds/s spent inside native verb
+                           handlers + the native arena write plane (the
+                           C++ share of the same picture)}
     """
     if dt_s is None:
         dt_s = max(float(after.get("ts", 0)) - float(before.get("ts", 0)),
@@ -464,6 +529,21 @@ def fleet_signals(before: dict, after: dict,
     edge_shed = max(
         _counter_total(after, "tpums_edge_shed_total")
         - _counter_total(before, "tpums_edge_shed_total"), 0.0)
+    # continuous-profiling plane (round 19 — obs/profiler.py): sampler
+    # liveness and process CPU as RATES; the native handler/write-plane
+    # self-time counters give the C++ share of the same CPU picture
+    prof_samples = max(
+        _counter_total(after, "tpums_prof_samples_total")
+        - _counter_total(before, "tpums_prof_samples_total"), 0.0)
+    process_cpu = max(
+        _counter_total(after, "tpums_process_cpu_seconds_total")
+        - _counter_total(before, "tpums_process_cpu_seconds_total"), 0.0)
+    native_self = max(
+        (_counter_total(after, "tpums_native_self_seconds_total")
+         + _counter_total(after, "tpums_arena_write_cpu_seconds_total"))
+        - (_counter_total(before, "tpums_native_self_seconds_total")
+           + _counter_total(before, "tpums_arena_write_cpu_seconds_total")),
+        0.0)
     edge_window = None  # delta histogram across the proxy's query verbs
     for h in after.get("histograms", []):
         if h["name"] != "tpums_edge_latency_seconds":
@@ -518,6 +598,9 @@ def fleet_signals(before: dict, after: dict,
         "edge_shed_per_s": edge_shed / dt_s,
         "edge_p99_s": (snapshot_quantile(edge_window, 99)
                        if edge_window else None),
+        "prof_samples_per_s": prof_samples / dt_s,
+        "process_cpu_per_s": process_cpu / dt_s,
+        "native_self_cpu_per_s": native_self / dt_s,
         "dt_s": dt_s,
         "requests": requests,
     }

@@ -74,6 +74,52 @@ class _TraceLocal(threading.local):
 
 
 _local = _TraceLocal()
+
+# Cross-thread stage registry for the sampling profiler (obs/profiler.py).
+# The span stack above is thread-LOCAL (only the owning thread can read
+# it), but the profiler samples from its own timer thread, so spans
+# additionally publish their stage *kind* here, keyed by thread ident.
+# Mutation discipline: each thread touches only its own ident's list, the
+# sampler only reads — under the GIL that makes the plain dict safe, and a
+# rare torn read costs one mis-attributed sample, never corruption.  Cost
+# rides the TRACED path only (span enter/exit); untraced requests never
+# touch it.
+_thread_stages: Dict[int, List[str]] = {}
+
+
+def push_stage(kind: str) -> None:
+    """Mark this thread as inside ``kind`` for the profiler's sampler.
+    Span enter does this automatically; bare call sites (benches, the
+    server dispatch choke point) may use ``profiler.prof_stage``."""
+    ident = threading.get_ident()
+    stack = _thread_stages.get(ident)
+    if stack is None:
+        stack = _thread_stages[ident] = []
+    stack.append(kind)
+
+
+def pop_stage() -> None:
+    ident = threading.get_ident()
+    stack = _thread_stages.get(ident)
+    if stack:
+        stack.pop()
+        if not stack:
+            _thread_stages.pop(ident, None)
+
+
+def thread_stages() -> Dict[int, str]:
+    """Sampler view: thread ident -> innermost active stage name.  Copies
+    under the GIL; threads that are outside any stage are absent."""
+    out: Dict[int, str] = {}
+    for ident, stack in list(_thread_stages.items()):
+        try:
+            if stack:
+                out[ident] = stack[-1]
+        except IndexError:  # racing pop on the owner thread
+            continue
+    return out
+
+
 _ring_lock = threading.Lock()
 _ring: Deque[dict] = deque(maxlen=_RING_CAP)
 _file_lock = threading.Lock()
@@ -205,6 +251,7 @@ class span:
         if stack is None:
             stack = _local.spans = []
         stack.append(self.sid)
+        push_stage(self.kind)
         self._t0 = time.time()
         return self
 
@@ -212,6 +259,7 @@ class span:
         if self.sid is None:
             return
         _local.spans.pop()
+        pop_stage()
         if exc_type is not None:
             self.fields.setdefault("error", repr(exc))
         event(self.kind, tid=self.tid, sid=self.sid, psid=self._psid,

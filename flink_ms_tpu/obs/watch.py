@@ -55,11 +55,13 @@ import numpy as np
 
 from ..serve import registry as job_registry
 from . import forensics
+from . import profdiff
 from . import tracing
 from .metrics import get_registry, render_prometheus
+from .profiler import CPU_SECONDS_SERIES
 from .rules import (RulesEngine, attribute_alerts, default_rules,
                     load_rules)
-from .scrape import scrape_fleet
+from .scrape import scrape_fleet, scrape_fleet_profiles
 from .slo import DEFAULT_ATTRIBUTION_WINDOW_S, DISRUPTIVE_KINDS
 from .tsdb import SeriesStore
 
@@ -250,7 +252,8 @@ class FleetWatcher:
                  scrape_timeout_s: Optional[float] = None,
                  publish: bool = True,
                  attribution_window_s: float =
-                 DEFAULT_ATTRIBUTION_WINDOW_S):
+                 DEFAULT_ATTRIBUTION_WINDOW_S,
+                 profile_attach: bool = True):
         self.interval_s = (
             _env_float("TPUMS_WATCH_INTERVAL_S", DEFAULT_INTERVAL_S, 0.05)
             if interval_s is None else max(float(interval_s), 0.05))
@@ -273,6 +276,11 @@ class FleetWatcher:
             if scrape_timeout_s is None else float(scrape_timeout_s))
         self.publish = publish
         self.attribution_window_s = attribution_window_s
+        # continuous-profiling attachment: each tick keeps the fleet
+        # profile so a CPU/quantile firing can be diffed prev-vs-now and
+        # page WITH the top-delta frames (profdiff), not just the number
+        self.profile_attach = profile_attach
+        self._prof_prev: Optional[dict] = None
         self.ticks = 0
         self.last_scrape: Optional[dict] = None
         self.last_error: Optional[str] = None
@@ -306,8 +314,18 @@ class FleetWatcher:
                 self.store.observe("tpums_model_staleness_seconds",
                                    p["staleness_s"], ts=now)
         transitions = self.engine.evaluate(self.store, now=now)
+        prof_cur = None
+        if self.profile_attach:
+            try:
+                prof_cur = scrape_fleet_profiles(
+                    timeout_s=self.scrape_timeout_s)["fleet"]
+            except Exception as e:  # noqa: BLE001 - never kill the tick
+                self.last_error = f"profile scrape: {e}"
         if transitions:
             self._attach_forensics(transitions, scrape)
+            self._attach_profile(transitions, prof_cur)
+        if prof_cur is not None:
+            self._prof_prev = prof_cur
         if self.publish:
             summary = self.engine.summary()
             reg = get_registry()
@@ -349,6 +367,34 @@ class FleetWatcher:
                 self.last_error = f"forensics: {e}"
                 continue
             tr.update(ctx)
+
+    def _attach_profile(self, transitions: List[dict],
+                        prof_cur: Optional[dict]) -> None:
+        """Enrich CPU-regression and latency-quantile firings with the
+        profiling plane: diff the PREVIOUS tick's fleet profile against
+        this tick's and attach the top-delta frames, so the page names
+        the code that got hot (``profile_top_frames``), completing the
+        alert -> stage (forensics) -> frames (profdiff) chain.  First
+        tick has no baseline; the firing still pages, just unframed."""
+        if prof_cur is None or self._prof_prev is None:
+            return
+        rules = {r.name: r for r in self.engine.rules}
+        frames: Optional[List[dict]] = None
+        for tr in transitions:
+            rule = rules.get(tr.get("rule"))
+            if (tr.get("kind") != "alert_firing" or rule is None
+                    or rule.kind != "threshold"
+                    or (rule.mode != "quantile"
+                        and rule.series != CPU_SECONDS_SERIES)):
+                continue
+            if frames is None:
+                try:
+                    frames = profdiff.top_frames(self._prof_prev, prof_cur)
+                except (ValueError, TypeError) as e:
+                    self.last_error = f"profdiff: {e}"
+                    return
+            if frames:
+                tr["profile_top_frames"] = frames
 
     def _run(self) -> None:
         while not self._stop.is_set():

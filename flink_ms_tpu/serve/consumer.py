@@ -38,6 +38,7 @@ from typing import Callable, List, Optional, Tuple
 from ..core.formats import CHUNK_ALS, CHUNK_SVM, split_journal_chunk
 from ..core.params import Params
 from ..obs import metrics as obs_metrics
+from ..obs import profiler as obs_profiler
 from ..obs import tracing as obs_tracing
 from . import snapshot as snapshot_mod
 from .journal import Journal, OffsetTruncatedError
@@ -473,6 +474,10 @@ class ServingJob:
                 file=sys.stderr,
             )
         self.server.start()
+        # continuous profiling is part of serving (Google-Wide-Profiling
+        # stance): the process-wide sampler starts with the first job and
+        # is shared by all of them; TPUMS_PROF=0 is the kill switch
+        obs_profiler.ensure_started()
         # announce jobId -> endpoint so clients resolve this job without
         # explicit port wiring (the reference's JobManager lookup,
         # QueryClientHelper.java:82-92; best-effort by design), with a
@@ -988,8 +993,8 @@ class ServingJob:
                     self._bootstrap_t0 = None
                     self._obs_bootstrap_s.observe(self.bootstrap_seconds)
                     obs_metrics.get_registry().counter(
-                        "tpums_bootstrap_source", state=self.state_name,
-                        source=self.bootstrap_source).inc()
+                        "tpums_bootstrap_total", state=self.state_name,
+                        kind=self.bootstrap_source).inc()
                 self._heartbeat_now()
                 self._obs_ready_flips.inc()
                 obs_tracing.event(

@@ -86,6 +86,7 @@ from .elastic import generation_group
 from .ha import resolve_shard_endpoints
 from .sharded import owner_of
 from ..obs import metrics as obs_metrics
+from ..obs import profiler as obs_profiler
 from ..obs import tracing as obs_tracing
 
 __all__ = [
@@ -611,6 +612,9 @@ class EdgeProxy:
         self._local_journal: Optional[str] = None
         self._topic: Optional[str] = None
         self._inflight_gets: Dict[tuple, "asyncio.Future"] = {}
+        # leader's upstream tid per in-flight coalesce key, so waiters'
+        # traces can link to the ONE upstream span answering them all
+        self._inflight_tids: Dict[tuple, Optional[str]] = {}
         self._last_shed_event = 0.0
         self._server: Optional[asyncio.AbstractServer] = None
         self._bg: list = []
@@ -622,6 +626,9 @@ class EdgeProxy:
     def start(self) -> "EdgeProxy":
         if self._thread is not None:
             return self
+        # the proxy serves traffic, so it profiles like a worker
+        # (TPUMS_PROF=0 kills it fleet-wide)
+        obs_profiler.ensure_started()
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._run_loop, daemon=True,
@@ -894,6 +901,21 @@ class EdgeProxy:
     async def _serve_parts(self, parts: List[str], conn: _Conn) -> str:
         t0 = time.perf_counter()
         tid = obs_tracing.pop_tid(parts)
+        # Edge proxy span: a traced request gets ONE ``edge_proxy`` span
+        # parented under the client's rpc span, and every upstream leg
+        # carries ``trace_id/proxy_sid`` — so worker ``server_reply``
+        # spans parent under the PROXY span, not directly under the
+        # client, and the trace tree shows the extra hop instead of
+        # silently eliding the tier that routed/coalesced/hedged it.
+        # The downstream echo keeps the RAW incoming tid (the client's
+        # exact-suffix unstamp depends on it); untraced traffic carries
+        # no extra field in either direction — byte-identical, test-pinned.
+        up_tid = None
+        trace_id = psid = proxy_sid = None
+        if tid is not None:
+            trace_id, psid = obs_tracing.split_tid(tid)
+            proxy_sid = obs_tracing.new_span_id()
+            up_tid = obs_tracing.wire_tid(trace_id, proxy_sid)
         if conn.binary:
             tenant = conn.tenant
             stale, bound = conn.stale, conn.bound
@@ -906,7 +928,7 @@ class EdgeProxy:
         st_val = 0.0
         try:
             reply, st_val = await self._dispatch(
-                verb, parts, tenant, bound, tid)
+                verb, parts, tenant, bound, up_tid)
         except asyncio.CancelledError:
             raise
         except (ConnectionError, OSError) as e:
@@ -915,8 +937,15 @@ class EdgeProxy:
         except Exception as e:
             reg.counter("tpums_edge_errors_total", verb=verb or "?").inc()
             reply = f"E\tproxy error: {e}"
+        dt = time.perf_counter() - t0
         reg.histogram("tpums_edge_latency_seconds",
-                      verb=verb or "?").observe(time.perf_counter() - t0)
+                      verb=verb or "?").observe(dt)
+        if tid is not None:
+            obs_tracing.event("edge_proxy", tid=trace_id, sid=proxy_sid,
+                              psid=psid, t0=time.time() - dt,
+                              dur_s=round(dt, 9), verb=verb or "?",
+                              proxy=self._job_id,
+                              ok=not reply.startswith("E"))
         if stale:
             # staleness rides BEFORE the tid echo, mirroring the server
             reply = f"{reply}\t{proto.STALE_FIELD}{st_val:.3f}"
@@ -931,6 +960,8 @@ class EdgeProxy:
             return f"PONG\t{self._job_id}\t", 0.0
         if verb == "METRICS" and len(parts) == 1:
             return self._metrics_reply(), 0.0
+        if verb == "PROFILE" and len(parts) == 1:
+            return self._profile_reply(), 0.0
         if verb == proto.HELLO_VERB:
             return "E\tbad request", 0.0
         expect = proto.FIELD_COUNTS.get(verb)
@@ -999,18 +1030,31 @@ class EdgeProxy:
         if fut is not None:
             obs_metrics.get_registry().counter(
                 "tpums_edge_coalesce_hits_total").inc()
+            if tid is not None:
+                # the waiter's trace sent NO upstream bytes — link it to
+                # the leader's one in-flight upstream span so the trace
+                # still explains where its answer came from instead of
+                # showing a request that apparently answered itself
+                w_trace, w_psid = obs_tracing.split_tid(tid)
+                obs_tracing.event(
+                    "edge_coalesce_link", tid=w_trace,
+                    sid=obs_tracing.new_span_id(), psid=w_psid,
+                    upstream=self._inflight_tids.get(ck),
+                    state=state, key=key)
             # shield: one downstream waiter hanging up must not cancel
             # the shared upstream fetch under everyone else
             return await asyncio.shield(fut)
         fut = asyncio.ensure_future(
             self._keyed(fleet, key, line, tid, hedge=True))
         self._inflight_gets[ck] = fut
+        self._inflight_tids[ck] = tid
         fut.add_done_callback(lambda f, ck=ck: self._uncoalesce(ck, f))
         return await asyncio.shield(fut)
 
     def _uncoalesce(self, ck: tuple, fut) -> None:
         if self._inflight_gets.get(ck) is fut:
             del self._inflight_gets[ck]
+            self._inflight_tids.pop(ck, None)
         _swallow(fut)
 
     async def _keyed(self, fleet: _Fleet, key: str, line: str,
@@ -1066,9 +1110,13 @@ class EdgeProxy:
                         port=ep.port, alt_port=alt.port,
                         delay_s=round(delay, 6))
                     hedged = asyncio.ensure_future(alt.request(line, tid))
-                    res = await self._first_win(fleet, ep, alt, primary,
-                                                hedged)
-                    fleet.lat[shard].add(time.perf_counter() - t0)
+                    res, hedged_won = await self._first_win(
+                        fleet, ep, alt, primary, hedged)
+                    dt = time.perf_counter() - t0
+                    fleet.lat[shard].add(dt)
+                    if tid is not None:
+                        self._hedge_leg_spans(tid, shard, dt, hedged_won,
+                                              ep, alt)
                     return res
         try:
             res = await primary
@@ -1078,12 +1126,31 @@ class EdgeProxy:
         fleet.lat[shard].add(time.perf_counter() - t0)
         return res
 
+    def _hedge_leg_spans(self, tid: str, shard: int, dur_s: float,
+                         hedged_won: bool, ep: _Endpoint,
+                         alt: _Endpoint) -> None:
+        """One span per hedge leg, parented under the PROXY span (the
+        upstream tid carries its sid), marked won/lost — a hedged trace
+        shows BOTH upstream attempts and which one answered, instead of
+        one mystery leg whose latency matches neither worker."""
+        trace_id, psid = obs_tracing.split_tid(tid)
+        t0_wall = time.time() - dur_s
+        for leg, port, won in (("primary", ep.port, not hedged_won),
+                               ("backup", alt.port, hedged_won)):
+            obs_tracing.event(
+                "edge_hedge_leg", tid=trace_id,
+                sid=obs_tracing.new_span_id(), psid=psid,
+                t0=t0_wall, dur_s=round(dur_s, 9), leg=leg,
+                shard=shard, port=port,
+                result="won" if won else "lost")
+
     async def _first_win(self, fleet: _Fleet, ep: _Endpoint,
                          alt: _Endpoint, primary, hedged
-                         ) -> Tuple[str, float]:
+                         ) -> Tuple[Tuple[str, float], bool]:
         """First successful reply wins; the loser's reply (the pipeline
         cannot un-send it) is drained and discarded, never delivered —
-        the no-double-delivery contract."""
+        the no-double-delivery contract.  Returns the winning reply plus
+        whether the BACKUP leg won (the caller records per-leg spans)."""
         pending = {primary, hedged}
         winner = None
         first_exc: Optional[Exception] = None
@@ -1110,7 +1177,7 @@ class EdgeProxy:
                     _swallow(f)
                 else:
                     f.add_done_callback(_swallow)
-        return winner.result()
+        return winner.result(), winner is hedged
 
     async def _mget(self, fleet: _Fleet, state: str, keys_csv: str,
                     tid: Optional[str]) -> Tuple[str, float]:
@@ -1267,6 +1334,16 @@ class EdgeProxy:
             return "J\t" + obs_metrics.snapshot_to_json_line(snap)
         except Exception as e:
             return f"E\tmetrics failed: {e}"
+
+    def _profile_reply(self) -> str:
+        """PROFILE at the proxy: the edge's own event-loop samples, so a
+        fleet flamegraph shows proxy CPU next to worker CPU."""
+        try:
+            return obs_profiler.profile_reply_line(
+                meta={"job_id": self._job_id, "port": self.port,
+                      "plane": "edge"})
+        except Exception as e:
+            return f"E\tprofile failed: {e}"
 
 
 class EdgeClient(QueryClient):

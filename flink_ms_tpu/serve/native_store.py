@@ -136,6 +136,16 @@ def _declare_abi(lib):
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_double),
     ]
+    try:
+        # profiling plane (PR 19+): absent from older .so builds — every
+        # caller treats the missing symbol as "no CPU data", like a
+        # sidecar that predates the write_cpu_ns field
+        lib.tpums_arena_write_cpu_seconds.restype = ctypes.c_int
+        lib.tpums_arena_write_cpu_seconds.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ]
+    except AttributeError:
+        pass
     lib.tpums_arena_writer_open.restype = ctypes.c_void_p
     lib.tpums_arena_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.tpums_arena_writer_close.argtypes = [ctypes.c_void_p]
@@ -520,6 +530,19 @@ class NativeArena:
             return None
         names = ("batch_rows", "batch_seconds", "cas_success", "cas_retry")
         return {n: v.value for n, v in zip(names, vals)}
+
+    def write_cpu_seconds(self) -> Optional[float]:
+        """Thread-CPU seconds the native write plane burned (sidecar
+        write_cpu_ns) — the fleet profile's ``native;arena_writer`` row;
+        None while no native writer has run or the .so predates the
+        export."""
+        fn = getattr(self._lib, "tpums_arena_write_cpu_seconds", None)
+        if fn is None:
+            return None
+        val = ctypes.c_double(0.0)
+        with self._call_lock:
+            rc = fn(self._live_handle(), ctypes.byref(val))
+        return val.value if rc == 0 else None
 
     def close(self) -> None:
         with self._call_lock:

@@ -111,6 +111,7 @@ from typing import Dict, Optional
 
 from ..core.formats import RangePayloadCache, gather_sorted, sort_dedup_last
 from ..obs import metrics as obs_metrics
+from ..obs import profiler as obs_profiler
 from ..obs import tracing as obs_tracing
 from . import admission as admission_ctl
 from . import proto
@@ -486,6 +487,16 @@ class LookupServer:
         if verb == "METRICS" and len(parts) == 1:
             return self._finish(verb, tid, t0, self._metrics_reply(),
                                 echo=echo_tid, stale=stale)
+        if verb == "PROFILE" and len(parts) == 1:
+            # the profiling plane's scrape verb: the process profiler's
+            # folded stacks as one P\t<json> line (the METRICS pattern
+            # applied to profiles — obs/profiler.py)
+            return self._finish(verb, tid, t0, self._profile_reply(),
+                                echo=echo_tid, stale=stale)
+        # sampler stage attribution rides the span stack (span enter/exit
+        # push/pop the stage) — no per-dispatch stage mark here; even a
+        # gated push/pop pair costs ~0.7us, past the 3% hot-path bar.
+        # Untraced requests fold under the "-" stage by design.
         reply = self._handle(parts, burst)
         if isinstance(reply, _DeferredReply):
             reply.post = lambda rendered, resolver: self._finish(
@@ -669,6 +680,18 @@ class LookupServer:
             return "J\t" + obs_metrics.snapshot_to_json_line(snap)
         except Exception as e:
             return f"E\tmetrics failed: {e}"
+
+    def _profile_reply(self) -> str:
+        """The PROFILE verb: the process profiler's stage-keyed folded
+        stacks as ONE ``P\\t<json>`` line.  Always answers — with the
+        profiler off the stacks are empty but the line still parses, so
+        fleet scrapes see 'no samples', not an error."""
+        try:
+            return obs_profiler.profile_reply_line(
+                meta={"job_id": self.job_id, "port": self.port,
+                      "plane": "python"})
+        except Exception as e:
+            return f"E\tprofile failed: {e}"
 
     def _handle(self, parts, burst: int = 1):
         """Verb dispatch over already-split fields (tid removed)."""

@@ -243,12 +243,16 @@ int read_slot(ArenaHandle* a, const Mapping* m, uint64_t idx,
 // header (its 64 bytes are fully spoken for) in a fixed 64-byte file the
 // C++ server maps read-only for the METRICS verb.
 //   [0:4) "TPWS" | [4:8) version u32 | [8:16) batch_rows u64 |
-//   [16:24) batch_ns u64 | [24:32) cas_success u64 | [32:40) cas_retry u64
+//   [16:24) batch_ns u64 | [24:32) cas_success u64 | [32:40) cas_retry u64 |
+//   [40:48) write_cpu_ns u64 (thread-CPU burned in put_batch/cas_floats —
+//   the profiling plane's "native;arena_writer" row; old sidecars read as
+//   0 here, which every consumer treats as "no data")
 constexpr uint64_t kStatsSize = 64;
 constexpr size_t kStatsBatchRows = 8;
 constexpr size_t kStatsBatchNs = 16;
 constexpr size_t kStatsCasSuccess = 24;
 constexpr size_t kStatsCasRetry = 32;
+constexpr size_t kStatsWriteCpuNs = 40;
 
 uint8_t* map_stats(const std::string& dir, bool writable) {
   std::string p = dir + "/writer.stats";
@@ -285,6 +289,26 @@ inline void stats_add(uint8_t* stats, size_t off, uint64_t delta) {
     __atomic_fetch_add(reinterpret_cast<uint64_t*>(stats + off), delta,
                        __ATOMIC_RELAXED);
 }
+
+// Scope guard accumulating this thread's CPU ns into the sidecar's
+// write_cpu_ns counter — the arena writer's contribution to the
+// continuous-profiling plane.  The negative-nsec case is safe under the
+// same modular-uint64 arithmetic the batch_ns accumulation relies on.
+struct WriteCpuSection {
+  uint8_t* stats;
+  struct timespec c0;
+  explicit WriteCpuSection(uint8_t* st) : stats(st) {
+    if (stats != nullptr) clock_gettime(CLOCK_THREAD_CPUTIME_ID, &c0);
+  }
+  ~WriteCpuSection() {
+    if (stats == nullptr) return;
+    struct timespec c1;
+    clock_gettime(CLOCK_THREAD_CPUTIME_ID, &c1);
+    stats_add(stats, kStatsWriteCpuNs,
+              static_cast<uint64_t>(c1.tv_sec - c0.tv_sec) * 1000000000ull +
+                  static_cast<uint64_t>(c1.tv_nsec - c0.tv_nsec));
+  }
+};
 
 struct ArenaWriter {
   uint32_t tag = kTpumsArenaWriterTag;
@@ -430,6 +454,28 @@ int tpums_arena_write_stats(void* h, double* batch_rows,
   return 0;
 }
 
+int tpums_arena_write_cpu_seconds(void* h, double* cpu_s) {
+  // separate export (not a fifth out-param on tpums_arena_write_stats):
+  // that ABI is frozen — Python ctypes bindings and the C++ METRICS
+  // splice both load it by signature, and old .so / new caller mixes must
+  // keep working during a rolling rebuild
+  if (!tpums_is_arena(h)) return -1;
+  ArenaHandle* a = static_cast<ArenaHandle*>(h);
+  uint8_t* st = a->wstats.load(std::memory_order_acquire);
+  if (st == nullptr) {
+    std::lock_guard<std::mutex> g(a->remap_mu);
+    st = a->wstats.load(std::memory_order_relaxed);
+    if (st == nullptr) {
+      st = map_stats(a->dir, /*writable=*/false);
+      if (st == nullptr) return -1;  // no native writer yet — retry later
+      a->wstats.store(st, std::memory_order_release);
+    }
+  }
+  if (cpu_s)
+    *cpu_s = static_cast<double>(load_u64(st + kStatsWriteCpuNs)) / 1e9;
+  return 0;
+}
+
 // -- writer plane exports ---------------------------------------------------
 
 void* tpums_arena_writer_open(const char* path, const char* dir) {
@@ -481,6 +527,7 @@ long long tpums_arena_put_batch(void* h, const char* kbuf,
                                 uint32_t* max_vlen_out) {
   ArenaWriter* w = as_writer(h);
   if (w == nullptr || kbuf == nullptr || vbuf == nullptr) return -1;
+  WriteCpuSection cpu(w->stats);
   struct timespec t0;
   clock_gettime(CLOCK_MONOTONIC, &t0);
   const char* kp = kbuf;
@@ -554,6 +601,7 @@ int tpums_arena_cas_floats(void* h, const char* k, uint32_t klen,
   if (w == nullptr || klen > w->key_cap || newlen > w->stride ||
       explen > w->stride)
     return -1;
+  WriteCpuSection cpu(w->stats);
   uint64_t cap = w->capacity;
   uint64_t idx = fnv1a(k, klen) % cap;
   for (uint64_t probes = 0; probes < cap; ++probes) {

@@ -45,6 +45,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string.h>
+#include <time.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/mman.h>
@@ -264,6 +265,14 @@ struct VerbStat {
   double sum = 0.0;
   uint64_t count = 0;
   uint64_t errors = 0;
+  // CPU self-time (CLOCK_THREAD_CPUTIME_ID) spent ANSWERING this verb —
+  // the native plane's contribution to the continuous-profiling plane
+  // (obs/profiler.py): no sampler runs here, the handler sections are
+  // measured directly and exported both as
+  // tpums_native_self_seconds_total counters (METRICS) and as synthetic
+  // "native;<verb>" folded stacks (PROFILE), so fleet profile merges
+  // carry C++ cost next to Python samples in the same seconds unit.
+  double cpu_s = 0.0;
 };
 
 #ifdef TPUMS_HAVE_URING
@@ -536,8 +545,17 @@ void trace_spill(ServerState* s, const std::string& raw_tid,
   s->trace_file_bytes += static_cast<long long>(line.size());
 }
 
+// This thread's consumed CPU seconds (user+sys).  Cost of one
+// clock_gettime on the hot path is ~25ns (vDSO) — two calls bracket each
+// handler section, well inside the enforced <=3% profiling-overhead bar.
+double thread_cpu_s() {
+  struct timespec ts;
+  if (clock_gettime(CLOCK_THREAD_CPUTIME_ID, &ts) != 0) return 0.0;
+  return static_cast<double>(ts.tv_sec) + ts.tv_nsec * 1e-9;
+}
+
 void observe_verb(ServerState* s, const std::string& verb, double dt,
-                  bool is_err) {
+                  bool is_err, double cpu_s = 0.0) {
   if (s->lat_bounds.empty()) return;
   std::lock_guard<std::mutex> g(s->metrics_mu);
   VerbStat& st = s->verb_stats[verb.empty() ? "?" : verb];
@@ -551,6 +569,7 @@ void observe_verb(ServerState* s, const std::string& verb, double dt,
   st.sum += dt;
   st.count += 1;
   if (is_err) st.errors += 1;
+  if (cpu_s > 0.0) st.cpu_s += cpu_s;
 }
 
 bool set_nonblocking(int fd) {
@@ -1211,6 +1230,17 @@ std::string metrics_reply(ServerState* s) {
     escape_json_into(j, kv.first);
     j += "\"},\"value\":" + std::to_string(kv.second.count) + "}";
   }
+  // profiling plane: per-verb handler CPU self-time (the same numbers the
+  // PROFILE verb folds into "native;<verb>" stacks), as counters so the
+  // watch plane can rate() them like any other series
+  for (const auto& kv : stats) {
+    if (!first) j.push_back(',');
+    first = false;
+    j += "{\"name\":\"tpums_native_self_seconds_total\",\"labels\":"
+         "{\"verb\":\"";
+    escape_json_into(j, kv.first);
+    j += "\"},\"value\":" + format_score_d(kv.second.cpu_s) + "}";
+  }
   // arena-backed server: the shared-store gauges + the lock-free path's
   // retry counter ride the same snapshot (obs/scrape fleet_signals reads
   // them off either plane — the Python writer exports the same names)
@@ -1240,6 +1270,14 @@ std::string metrics_reply(ServerState* s) {
            "},{\"name\":\"tpums_arena_cas_retry_total\",\"labels\":{},"
            "\"value\":" +
            std::to_string(static_cast<uint64_t>(c_retry)) + "}";
+    }
+    // write-plane CPU self-time (sidecar, CLOCK_THREAD_CPUTIME_ID in the
+    // batch/CAS writers) — the arena writer's row in the fleet profile
+    double w_cpu;
+    if (tpums_arena_write_cpu_seconds(s->store, &w_cpu) == 0 &&
+        w_cpu > 0.0) {
+      j += ",{\"name\":\"tpums_arena_write_cpu_seconds_total\","
+           "\"labels\":{},\"value\":" + format_score_d(w_cpu) + "}";
     }
   }
   j += "],\"gauges\":[";
@@ -1272,6 +1310,50 @@ std::string metrics_reply(ServerState* s) {
     j += ",\"count\":" + std::to_string(kv.second.count) + "}";
   }
   j += "],\"meta\":{\"job_id\":\"";
+  escape_json_into(j, s->job_id);
+  j += "\",\"port\":" + std::to_string(s->port) +
+       ",\"plane\":\"native\"}}\n";
+  return j;
+}
+
+// PROFILE verb: the native plane's contribution to the continuous
+// profiling plane, shipped exactly like METRICS — one "P\t<json>" line in
+// the obs/profiler.py profile schema.  No sampler runs in C++: handler
+// sections are measured directly (CLOCK_THREAD_CPUTIME_ID bracketing in
+// observe_verb), so the "stacks" are synthetic two-segment folds
+// "native;<verb>" weighted in CPU seconds, plus "native;arena_writer"
+// from the batch writer's sidecar.  merge_profiles sums these next to
+// Python sample-seconds — one unit, one associative fold, one fleet
+// flamegraph.
+std::string profile_reply(ServerState* s) {
+  std::map<std::string, VerbStat> stats;
+  {
+    std::lock_guard<std::mutex> g(s->metrics_mu);
+    stats = s->verb_stats;
+  }
+  double ts = std::chrono::duration<double>(
+                  std::chrono::system_clock::now().time_since_epoch())
+                  .count();
+  std::string j = "P\t{\"ts\":";
+  j += format_score_d(ts);
+  j += ",\"hz\":0,\"enabled\":true,\"samples\":0,\"wall_s\":0.0,"
+       "\"unit\":\"seconds\",\"stacks\":{";
+  bool first = true;
+  for (const auto& kv : stats) {
+    if (kv.second.cpu_s <= 0.0) continue;
+    if (!first) j.push_back(',');
+    first = false;
+    j += "\"native;";
+    escape_json_into(j, kv.first);
+    j += "\":" + format_score_d(kv.second.cpu_s);
+  }
+  double w_cpu;
+  if (tpums_arena_write_cpu_seconds(s->store, &w_cpu) == 0 && w_cpu > 0.0) {
+    if (!first) j.push_back(',');
+    first = false;
+    j += "\"native;arena_writer\":" + format_score_d(w_cpu);
+  }
+  j += "},\"meta\":{\"job_id\":\"";
   escape_json_into(j, s->job_id);
   j += "\",\"port\":" + std::to_string(s->port) +
        ",\"plane\":\"native\"}}\n";
@@ -1335,6 +1417,12 @@ std::string handle_line(ServerState* s, const std::string* parts, int n) {
     // E\tbad request so their byte-parity pins hold
     if (s->lat_bounds.empty()) return "E\tbad request\n";
     return metrics_reply(s);
+  }
+  if (parts[0] == "PROFILE" && n == 1) {
+    // profiling-plane scrape; start2-compat servers (no ladder, so no
+    // verb stats accumulate) keep the historical E, exactly like METRICS
+    if (s->lat_bounds.empty()) return "E\tbad request\n";
+    return profile_reply(s);
   }
   if (parts[0] == "COUNT" && n == 2) {
     if (parts[1] != s->state_name) {
@@ -1613,6 +1701,7 @@ void topk_worker_loop(ServerState* s) {
     if (task.reply.use_count() > 1) {  // conn still holds its slot — a
       // closed connection's orphaned tasks skip the O(catalog) work
       double t_pop = now_s();
+      double c0 = thread_cpu_s();
       task.reply->text =
           task.verb == "DOT"
               ? handle_dot(s, task.state, task.k_s, task.query_arg)
@@ -1621,11 +1710,15 @@ void topk_worker_loop(ServerState* s) {
       // latency includes queue wait (t0 is submit time), mirroring the
       // Python plane's deferred-reply observation at resolve time; an
       // orphaned task is never observed — its Python twin (handler thread
-      // gone mid-request) never reaches _finish either
+      // gone mid-request) never reaches _finish either.  CPU self-time
+      // deliberately does NOT include queue wait: it brackets the worker
+      // section only, so the profile says what the core burned, not what
+      // the queue delayed.
       double t_done = now_s();
       bool is_err =
           !task.reply->text.empty() && task.reply->text[0] == 'E';
-      observe_verb(s, task.verb, t_done - task.t0, is_err);
+      observe_verb(s, task.verb, t_done - task.t0, is_err,
+                   thread_cpu_s() - c0);
       if (!task.tid.empty()) {
         // queue wait vs device/serve split is exactly what the slow-vs-
         // fast diff attributes, so spill both
@@ -1737,10 +1830,11 @@ bool route_parts(ServerState* s, Conn* c, std::string* parts, int n,
   }
   double t0 = now_s();
   double t0_wall = tid.empty() ? 0.0 : wall_s();
+  double c0 = thread_cpu_s();
   std::string text = handle_line(s, parts, n);
   double dt = now_s() - t0;
   bool is_err = !text.empty() && text[0] == 'E';
-  observe_verb(s, parts[0], dt, is_err);
+  observe_verb(s, parts[0], dt, is_err, thread_cpu_s() - c0);
   if (!tid.empty()) {
     trace_spill(s, tid, parts[0], t0_wall, dt, 0.0, dt, is_err);
   }

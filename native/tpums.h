@@ -79,6 +79,11 @@ int tpums_arena_stats(void* h, double* rows, double* capacity,
 int tpums_arena_write_stats(void* h, double* batch_rows,
                             double* batch_seconds, double* cas_success,
                             double* cas_retry);
+// Thread-CPU seconds burned inside the native write plane (put_batch +
+// cas_floats sections, sidecar offset [40:48)) — the profiling plane's
+// "native;arena_writer" row.  Separate export so the frozen
+// tpums_arena_write_stats ABI never moves; same -1 semantics.
+int tpums_arena_write_cpu_seconds(void* h, double* cpu_s);
 
 // -- shared-memory arena writer (arena.cpp) ---------------------------------
 // The native half of ArenaModelTable's write path.  A writer handle maps

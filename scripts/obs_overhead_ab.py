@@ -15,6 +15,10 @@ Round 14 adds the tail-forensics arms: spans (head-sampled at
 off, same ABAB discipline, on the GET hot path.  That arm's dispatch-level
 p50 overhead is ENFORCED <= 3% (exit 1 past the bar) — the in-process
 measurement is reproducible where the socket ratio rides machine noise.
+
+Round 19 adds the continuous-profiler arm: the sampling profiler running
+at ``PROF_HZ`` (default 47) vs stopped, same ABAB discipline on the GET
+hot path, same ENFORCED <= 3% bar.
 """
 
 import json
@@ -36,6 +40,8 @@ N_Q = int(os.environ.get("N_Q", 400))
 ROUNDS = int(os.environ.get("ROUNDS", 4))
 TRACE_SAMPLE = float(os.environ.get("TRACE_SAMPLE", 0.01))
 TRACE_BAR_PCT = float(os.environ.get("TRACE_BAR_PCT", 3.0))
+PROF_HZ = float(os.environ.get("PROF_HZ", 47.0))
+PROF_BAR_PCT = float(os.environ.get("PROF_BAR_PCT", 3.0))
 
 
 def main() -> int:
@@ -179,12 +185,53 @@ def main() -> int:
             "overhead_pct": round(trace_pct, 2),
             "bar_pct": TRACE_BAR_PCT,
         }
+        # --- continuous-profiler arm: always-on sampler at PROF_HZ ------
+        # Same ABAB discipline on the same GET hot path.  The "prof" arm
+        # runs the sampling profiler (timer thread + per-dispatch stage
+        # mark); the "plain" arm has it stopped.  Metrics stay ON in both
+        # arms — the bar is profiler-on vs the already-instrumented path.
+        from flink_ms_tpu.obs import profiler as Prof
+
+        pdisp = {"prof": [], "plain": []}
+        for r in range(10):
+            order = ("prof", "plain") if r % 2 == 0 else ("plain", "prof")
+            for arm in order:
+                if arm == "prof":
+                    os.environ["TPUMS_PROF"] = "1"
+                    os.environ.setdefault("TPUMS_PROF_HZ", str(PROF_HZ))
+                    Prof.ensure_started()
+                else:
+                    Prof.stop_profiler()
+                xs = []
+                for _ in range(200):
+                    t0 = time.perf_counter()
+                    for _ in range(WINDOW):
+                        srv._dispatch(get_line)
+                    xs.append(
+                        (time.perf_counter() - t0) / WINDOW * 1e6)
+                pdisp[arm].append(float(np.percentile(xs, 50)))
+        Prof.stop_profiler()
+        p_on = float(np.min(pdisp["prof"]))
+        p_off = float(np.min(pdisp["plain"]))
+        prof_pct = 100.0 * (p_on / p_off - 1.0)
+        out["profiler"] = {
+            "hz": float(os.environ.get("TPUMS_PROF_HZ", PROF_HZ)),
+            "p50_on_us": round(p_on, 2), "p50_off_us": round(p_off, 2),
+            "delta_us": round(p_on - p_off, 2),
+            "overhead_pct": round(prof_pct, 2),
+            "bar_pct": PROF_BAR_PCT,
+        }
         print(json.dumps(out, indent=1))
+        rc = 0
         if trace_pct > TRACE_BAR_PCT:
             print(f"FAIL: spans+exemplars GET p50 overhead "
                   f"{trace_pct:.2f}% > {TRACE_BAR_PCT}%", file=sys.stderr)
-            return 1
-        return 0
+            rc = 1
+        if prof_pct > PROF_BAR_PCT:
+            print(f"FAIL: profiler GET p50 overhead "
+                  f"{prof_pct:.2f}% > {PROF_BAR_PCT}%", file=sys.stderr)
+            rc = 1
+        return rc
     finally:
         job.stop()
 

@@ -58,6 +58,7 @@ class FakeWorker:
         self.topology_gen = topology_gen
         self.requests = 0          # every record seen
         self.gets = 0              # GET records seen
+        self.tids = []             # tid= trace context seen, in order
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -130,10 +131,13 @@ class FakeWorker:
 
     def _answer(self, parts):
         parts = list(parts)
+        tid = None
         if parts and parts[-1].startswith("tid="):
-            parts.pop()
+            tid = parts.pop()[4:]
         with self._lock:
             self.requests += 1
+            if tid is not None:
+                self.tids.append(tid)
             if parts[0] == "GET":
                 self.gets += 1
         verb = parts[0]
@@ -502,3 +506,155 @@ def test_edge_client_discovers_and_rotates_across_proxies():
         p0.stop()
         p1.stop()
         _stop_all(workers)
+
+
+# ---------------------------------------------------------------------------
+# trace propagation through the proxy tier: the coalesce/hedge trace gap
+# ---------------------------------------------------------------------------
+
+from flink_ms_tpu.obs import tracing as T  # noqa: E402
+
+
+def _raw_get(port, line):
+    with socket.create_connection(("127.0.0.1", port), 10) as s:
+        s.settimeout(10)
+        s.sendall((line + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+    return buf
+
+
+def test_traced_get_spans_proxy_hop_and_reparents_upstream():
+    T.clear_events()
+    workers = _mk_fleet("tr", 1, KEYS)
+    proxy = EdgeProxy("tr", register=False, hedge=False,
+                      coalesce=False).start()
+    try:
+        trace, csid = T.new_trace_id(), T.new_span_id()
+        raw = f"{trace}/{csid}"
+        k = KEYS[3]
+        got = _raw_get(proxy.port, f"GET\t{STATE}\t{k}\ttid={raw}")
+        # downstream echo keeps the RAW incoming tid — the client's
+        # exact-suffix unstamp depends on it
+        assert got == f"V\tv:{k}\ttid={raw}\n".encode()
+        evs = T.recent_events(tid=trace, kind="edge_proxy")
+        assert len(evs) == 1
+        assert evs[0]["psid"] == csid      # parented under the client rpc
+        proxy_sid = evs[0]["sid"]
+        assert proxy_sid and proxy_sid != csid
+        assert evs[0]["ok"] is True and evs[0]["verb"] == "GET"
+        # the upstream leg was re-parented under the PROXY span, so the
+        # worker's server_reply span hangs off the hop that routed it
+        assert workers[0].tids == [f"{trace}/{proxy_sid}"]
+    finally:
+        proxy.stop()
+        _stop_all(workers)
+
+
+def test_untraced_get_through_proxy_stays_byte_identical():
+    workers = _mk_fleet("ut", 1, KEYS)
+    proxy = EdgeProxy("ut", register=False, hedge=False).start()
+    try:
+        k = KEYS[4]
+        # wire-byte pin: no tid in, not one extra byte out, and the
+        # proxy never invents trace context for the upstream leg
+        assert _raw_get(proxy.port, f"GET\t{STATE}\t{k}") \
+            == f"V\tv:{k}\n".encode()
+        assert workers[0].tids == []
+    finally:
+        proxy.stop()
+        _stop_all(workers)
+
+
+def test_coalesce_waiters_link_to_leader_upstream_span():
+    T.clear_events()
+    gate = threading.Event()
+    hot = KEYS[0]
+    workers = _mk_fleet("cl", 1, KEYS, delay_for=[hot], gate=gate)
+    proxy = EdgeProxy("cl", register=False, hedge=False).start()
+    traces = [T.new_trace_id() for _ in range(3)]
+    replies = []
+    lock = threading.Lock()
+
+    def one_get(trace):
+        got = _raw_get(proxy.port,
+                       f"GET\t{STATE}\t{hot}\ttid={trace}/"
+                       f"{T.new_span_id()}")
+        with lock:
+            replies.append(got)
+
+    try:
+        threads = [threading.Thread(target=one_get, args=(t,))
+                   for t in traces]
+        threads[0].start()
+        deadline = time.time() + 10
+        while workers[0].gets < 1 and time.time() < deadline:
+            time.sleep(0.005)  # leader's fetch is parked on the gate
+        for th in threads[1:]:
+            th.start()
+        time.sleep(0.3)        # followers reach the proxy and coalesce
+        gate.set()
+        for th in threads:
+            th.join(timeout=30)
+        assert len(replies) == 3
+        assert workers[0].gets == 1          # one upstream request
+        (leader_tid,) = workers[0].tids      # leader's rewritten tid
+        links = T.recent_events(kind="edge_coalesce_link")
+        assert len(links) == 2
+        for ev in links:
+            # every waiter's trace points at the ONE upstream span that
+            # actually fetched its answer
+            assert ev["upstream"] == leader_tid
+            assert ev["key"] == hot and ev["state"] == STATE
+        leader_trace = leader_tid.split("/")[0]
+        assert {ev["tid"] for ev in links} \
+            == set(traces) - {leader_trace}
+    finally:
+        gate.set()
+        proxy.stop()
+        _stop_all(workers)
+
+
+def test_hedge_legs_traced_as_won_and_lost_spans():
+    T.clear_events()
+    slow_key = KEYS[1]
+    w0 = FakeWorker(0, 1, KEYS, delay_for=[slow_key], delay_s=0.4)
+    w0.register("ht", 1, replica=0)
+    w1 = FakeWorker(0, 1, KEYS).register("ht", 1, replica=1)
+    registry.publish_topology("ht", 1, 2)
+    proxy = EdgeProxy("ht", register=False, hedge=True, coalesce=False,
+                      hedge_warmup=4, hedge_pct=50,
+                      hedge_min_ms=1.0).start()
+    try:
+        for k in KEYS[2:10]:   # warm the latency window, untraced
+            assert _raw_get(proxy.port, f"GET\t{STATE}\t{k}") \
+                == f"V\tv:{k}\n".encode()
+        # the slow key twice: round-robin lands one run on the slow
+        # primary, so at least one hedge fires
+        traces = []
+        for _ in range(2):
+            trace = T.new_trace_id()
+            traces.append(trace)
+            got = _raw_get(proxy.port,
+                           f"GET\t{STATE}\t{slow_key}\ttid={trace}/"
+                           f"{T.new_span_id()}")
+            assert got.startswith(f"V\tv:{slow_key}".encode())
+        legs = T.recent_events(kind="edge_hedge_leg")
+        assert len(legs) >= 2
+        hedged_traces = {ev["tid"] for ev in legs}
+        assert hedged_traces <= set(traces)
+        for t in hedged_traces:
+            pair = [ev for ev in legs if ev["tid"] == t]
+            # BOTH attempts traced, exactly one winner, same parent
+            assert {ev["leg"] for ev in pair} == {"primary", "backup"}
+            assert sorted(ev["result"] for ev in pair) == ["lost", "won"]
+            assert len({ev["psid"] for ev in pair}) == 1
+            prox = T.recent_events(tid=t, kind="edge_proxy")
+            assert len(prox) == 1 and prox[0]["sid"] == pair[0]["psid"]
+    finally:
+        proxy.stop()
+        _stop_all([w0, w1])

@@ -1,0 +1,407 @@
+"""Continuous profiling plane (obs/profiler.py + obs/profdiff.py): sampler
+determinism under a pinned synthetic workload, CPU-gated idle exclusion,
+folded-stack merge associativity, span-keyed attribution, artifact
+rotation/retention, PROFILE verb round-trip parity on both server planes,
+and the fleet merge folding >=2 Python replicas plus native per-verb
+self-time into one artifact."""
+
+import json
+import math
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from flink_ms_tpu.obs import profdiff
+from flink_ms_tpu.obs import profiler as P
+from flink_ms_tpu.obs import tracing as T
+from flink_ms_tpu.serve import registry
+from flink_ms_tpu.serve.consumer import ALS_STATE
+from flink_ms_tpu.serve.server import LookupServer
+from flink_ms_tpu.serve.table import ModelTable
+
+pytestmark = pytest.mark.usefixtures("_fresh_profiler")
+
+
+@pytest.fixture
+def _fresh_profiler():
+    P.stop_profiler()
+    yield
+    P.stop_profiler()
+
+
+class _Parked:
+    """A worker thread pinned inside an optional stage, parked on an
+    event — the deterministic sampling target."""
+
+    def __init__(self, stage=None):
+        self.stage = stage
+        self.ev = threading.Event()
+        self.inside = threading.Event()
+        self.t = threading.Thread(target=self._run, daemon=True)
+        self.t.start()
+        assert self.inside.wait(5)
+
+    def _run(self):
+        if self.stage:
+            with P.prof_stage(self.stage):
+                self.inside.set()
+                self.ev.wait(30)
+        else:
+            self.inside.set()
+            self.ev.wait(30)
+
+    def stop(self):
+        self.ev.set()
+        self.t.join(timeout=5)
+
+
+def _raw_line(port, line):
+    with socket.create_connection(("127.0.0.1", port), 10) as s:
+        s.settimeout(10)
+        s.sendall((line + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+    return buf.decode().rstrip("\n")
+
+
+# ---------------------------------------------------------------------------
+# sampler core
+# ---------------------------------------------------------------------------
+
+def test_sample_once_deterministic_under_pinned_workload(monkeypatch):
+    # wall-clock mode: a parked thread is sampled on EVERY pass, so the
+    # folded weight is exactly n_samples/hz — no timer race, no jitter
+    monkeypatch.setenv("TPUMS_PROF_IDLE", "1")
+    prof = P.SamplingProfiler(hz=50.0)
+    w = _Parked(stage="pinned")
+    try:
+        for _ in range(20):
+            prof.sample_once()
+        snap = prof.snapshot()
+        keys = [k for k in snap["stacks"] if k.startswith("pinned;")]
+        assert len(keys) == 1          # one stable stack, one key
+        assert snap["stacks"][keys[0]] == pytest.approx(20 / 50.0)
+        assert keys[0].endswith("threading.wait")
+    finally:
+        w.stop()
+
+
+def test_cpu_gating_excludes_parked_threads():
+    # default CPU semantics: the parked thread is charged at most its
+    # first-sight sample while a busy thread keeps accruing
+    assert not P.SamplingProfiler().include_idle
+    prof = P.SamplingProfiler(hz=50.0)
+    w = _Parked(stage="idlezone")
+    stop = threading.Event()
+
+    def busy():
+        with P.prof_stage("hotzone"):
+            x = 0.0
+            while not stop.is_set():
+                x += math.sqrt(x + 1.0)
+
+    b = threading.Thread(target=busy, daemon=True)
+    b.start()
+    try:
+        time.sleep(0.05)
+        for _ in range(10):
+            prof.sample_once()
+            time.sleep(0.03)           # let the busy thread burn a jiffy
+        snap = prof.snapshot()
+        idle = sum(v for k, v in snap["stacks"].items()
+                   if k.startswith("idlezone;"))
+        hot = sum(v for k, v in snap["stacks"].items()
+                  if k.startswith("hotzone;"))
+        assert idle <= 1 / 50.0 + 1e-9  # first sight only
+        assert hot >= 5 / 50.0 - 1e-9   # kept being counted
+    finally:
+        stop.set()
+        b.join(timeout=5)
+        w.stop()
+
+
+def test_span_keyed_attribution(monkeypatch):
+    # a sample taken while a thread is inside a span lands under that
+    # span's stage — the "span-correlated" in the plane's name
+    monkeypatch.setenv("TPUMS_PROF_IDLE", "1")
+    prof = P.SamplingProfiler(hz=50.0)
+    inside, release = threading.Event(), threading.Event()
+
+    def staged():
+        with T.trace_span(T.new_trace_id()):
+            with T.span("stage_x", verb="GET"):
+                inside.set()
+                release.wait(30)
+
+    t = threading.Thread(target=staged, daemon=True)
+    t.start()
+    assert inside.wait(5)
+    try:
+        prof.sample_once()
+        snap = prof.snapshot()
+        staged_keys = [k for k in snap["stacks"]
+                       if k.startswith("stage_x;")]
+        assert len(staged_keys) == 1
+    finally:
+        release.set()
+        t.join(timeout=5)
+    # after span exit the same thread keys under the untraced stage
+    assert T.thread_stages().get(t.ident) is None
+
+
+def test_overflow_bucket_caps_distinct_stacks(monkeypatch):
+    monkeypatch.setenv("TPUMS_PROF_MAX_STACKS", "16")
+    prof = P.SamplingProfiler(hz=50.0)
+    with prof._lock:
+        for i in range(16):
+            prof._stacks[f"-;synthetic.f{i}"] = 1
+    w = _Parked(stage="late")
+    try:
+        prof.include_idle = True
+        prof.sample_once()
+        snap = prof.snapshot()
+        assert not any(k.startswith("late;") for k in snap["stacks"])
+        assert snap["stacks"][P.OVERFLOW_KEY] > 0
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# profile algebra
+# ---------------------------------------------------------------------------
+
+def _prof(stacks, samples=0, plane=None, hz=47.0):
+    return {"ts": 1.0, "hz": hz, "samples": samples, "wall_s": 1.0,
+            "unit": "seconds", "stacks": dict(stacks),
+            "meta": {"plane": plane} if plane else {}}
+
+
+def test_merge_is_associative_key_for_key():
+    a = _prof({"-;x.f": 0.25, "s;x.g": 0.5}, samples=3, plane="python")
+    b = _prof({"-;x.f": 0.75, "native;GET": 0.125}, samples=2,
+              plane="native", hz=0.0)
+    c = _prof({"s;x.g": 1.0, "-;y.h": 2.0}, samples=7, plane="python")
+    left = P.merge_profiles([P.merge_profiles([a, b]), c])
+    right = P.merge_profiles([a, P.merge_profiles([b, c])])
+    assert left["stacks"] == right["stacks"]
+    assert left["samples"] == right["samples"] == 12
+    assert left["stacks"]["-;x.f"] == pytest.approx(1.0)
+    # plane lists survive nested merges (the "planes" plural propagates)
+    assert left["meta"]["planes"] == right["meta"]["planes"] \
+        == ["native", "python"]
+    # mixed hz marks the merge as multi-rate
+    assert left["hz"] == 0.0
+
+
+def test_folded_round_trip_preserves_weights(tmp_path):
+    src = _prof({"-;m.f;m.g": 1.234567, "st;m.h": 0.021277})
+    folded = P.profile_to_folded(src)
+    back = P.folded_to_profile(folded)
+    for k, v in src["stacks"].items():
+        assert back["stacks"][k] == pytest.approx(v, abs=1e-6)
+    # and load_profile reads both folded text and the wire line
+    p1 = tmp_path / "p.folded"
+    p1.write_text(folded)
+    assert P.load_profile(str(p1))["stacks"] == back["stacks"]
+    p2 = tmp_path / "p.json"
+    p2.write_text(P.profile_reply_line(meta={"plane": "python"})[0:].strip())
+    assert "stacks" in P.load_profile(str(p2))
+
+
+def test_profdiff_ranks_injected_frame_first():
+    base = _prof({"-;m.steady": 1.0})
+    cur = _prof({"-;m.steady": 1.1, "hot;m.regressed": 0.9})
+    rep = profdiff.diff_profiles(base, cur)
+    assert rep["frames"][0]["frame"] == "m.regressed"
+    assert rep["frames"][0]["delta_share"] == pytest.approx(0.9, abs=0.01)
+    top = profdiff.top_frames(base, cur, n=2)
+    assert top[0]["frame"] == "m.regressed"
+    # by-stage mirrors forensics' stage ranking
+    hot_rows = rep["by_stage"]["hot"]
+    assert hot_rows[0]["frame"] == "m.regressed"
+    assert hot_rows[0]["delta_s"] == pytest.approx(0.9)
+
+
+# ---------------------------------------------------------------------------
+# rotation / retention
+# ---------------------------------------------------------------------------
+
+def test_artifact_rotation_keeps_k(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUMS_PROF_KEEP", "2")
+    prof = P.SamplingProfiler(hz=50.0, artifact_dir=str(tmp_path),
+                              flush_s=999.0)
+    with prof._lock:
+        prof._stacks["-;m.f"] = 100
+    for _ in range(4):
+        prof.flush()
+    names = sorted(os.listdir(tmp_path))
+    assert names == [P.ARTIFACT_NAME, P.ARTIFACT_NAME + ".1",
+                     P.ARTIFACT_NAME + ".2"]
+    newest = P.load_profile(str(tmp_path / P.ARTIFACT_NAME))
+    assert newest["stacks"]["-;m.f"] == pytest.approx(100 / 50.0)
+
+
+def test_flush_publishes_counters(monkeypatch):
+    from flink_ms_tpu.obs import metrics as obs_metrics
+
+    prof = P.SamplingProfiler(hz=50.0)
+    with prof._lock:
+        prof._stacks["-;m.f"] = 5
+        prof.samples = 5
+    reg = obs_metrics.get_registry()
+
+    def total(name):
+        return sum(c["value"] for c in reg.snapshot()["counters"]
+                   if c["name"] == name)
+
+    before = total(P.SAMPLES_SERIES)
+    prof.flush()
+    assert total(P.SAMPLES_SERIES) == before + 5
+    prof.flush()                       # no double publish
+    assert total(P.SAMPLES_SERIES) == before + 5
+
+
+# ---------------------------------------------------------------------------
+# PROFILE verb round-trip parity
+# ---------------------------------------------------------------------------
+
+def test_profile_verb_python_server_round_trip(monkeypatch):
+    monkeypatch.setenv("TPUMS_PROF", "1")
+    P.ensure_started()
+    table = ModelTable(2)
+    table.put("1-U", "0.5;1.5")
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0,
+                       job_id="prof-py").start()
+    try:
+        line = _raw_line(srv.port, "PROFILE")
+        doc = P.parse_profile_reply(line)
+        assert doc is not None
+        assert doc["unit"] == "seconds" and doc["enabled"] is True
+        assert doc["meta"]["plane"] == "python"
+        assert doc["meta"]["job_id"] == "prof-py"
+        # the scrape helper sees the same document
+        scraped = P.scrape_profile("127.0.0.1", srv.port)
+        assert scraped is not None and scraped["hz"] == doc["hz"]
+    finally:
+        srv.stop()
+
+
+def test_profile_verb_parses_with_profiler_off(monkeypatch):
+    monkeypatch.setenv("TPUMS_PROF", "0")
+    assert P.ensure_started() is None
+    table = ModelTable(2)
+    srv = LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0,
+                       job_id="prof-off").start()
+    try:
+        doc = P.parse_profile_reply(_raw_line(srv.port, "PROFILE"))
+        assert doc is not None
+        assert doc["enabled"] is False and doc["stacks"] == {}
+    finally:
+        srv.stop()
+    # non-PROFILE lines never parse as profiles
+    assert P.parse_profile_reply("E\tbad request") is None
+    assert P.parse_profile_reply("V\t1.0") is None
+
+
+def test_profile_verb_native_self_time(tmp_path):
+    from flink_ms_tpu.serve.native_store import (NativeLookupServer,
+                                                 NativeStore)
+
+    store = NativeStore(str(tmp_path / "store"))
+    store.put("1-U", "0.5;1.5")
+    with NativeLookupServer(store, ALS_STATE, job_id="prof-nat",
+                            port=0) as srv:
+        for _ in range(100):
+            assert _raw_line(srv.port, f"GET\t{ALS_STATE}\t1-U") \
+                == "V\t0.5;1.5"
+        doc = P.scrape_profile("127.0.0.1", srv.port)
+        assert doc is not None and doc["meta"]["plane"] == "native"
+        assert doc["stacks"].get("native;GET", 0.0) > 0.0
+        # METRICS carries the same self-time as counters
+        mline = _raw_line(srv.port, "METRICS")
+        assert mline.startswith("J\t")
+        snap = json.loads(mline[2:])
+        self_cs = [c for c in snap["counters"]
+                   if c["name"] == "tpums_native_self_seconds_total"
+                   and c["labels"].get("verb") == "GET"]
+        assert self_cs and self_cs[0]["value"] > 0.0
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet merge: >=2 Python replicas + native self-time -> one artifact
+# ---------------------------------------------------------------------------
+
+def test_fleet_profile_merges_replicas_and_native(tmp_path, monkeypatch):
+    from flink_ms_tpu.obs.scrape import scrape_fleet_profiles
+    from flink_ms_tpu.serve.native_store import (NativeLookupServer,
+                                                 NativeStore)
+
+    monkeypatch.setenv("TPUMS_PROF", "1")
+    monkeypatch.setenv("TPUMS_PROF_HZ", "200")
+    P.stop_profiler()
+    prof = P.ensure_started()
+
+    table = ModelTable(2)
+    table.put("1-U", "0.5;1.5")
+    servers = [LookupServer({ALS_STATE: table}, host="127.0.0.1", port=0,
+                            job_id=f"prof-r{i}").start() for i in range(2)]
+    store = NativeStore(str(tmp_path / "store"))
+    store.put("1-U", "0.5;1.5")
+    nsrv = NativeLookupServer(store, ALS_STATE, job_id="prof-nat",
+                              port=0).__enter__()
+    try:
+        for i, srv in enumerate(servers):
+            registry.register(f"prof-r{i}", "127.0.0.1", srv.port,
+                              ALS_STATE, ready=True, ttl_s=300.0)
+        registry.register("prof-nat", "127.0.0.1", nsrv.port, ALS_STATE,
+                          ready=True, ttl_s=300.0)
+        for _ in range(50):
+            assert _raw_line(nsrv.port, f"GET\t{ALS_STATE}\t1-U") \
+                == "V\t0.5;1.5"
+        # guarantee Python samples regardless of sampler timing
+        with P.prof_stage("fleet_burn"):
+            stop_t = time.perf_counter() + 0.1
+            x = 0.0
+            while time.perf_counter() < stop_t:
+                x += math.sqrt(x + 1.0)
+        deadline = time.time() + 5
+        while prof.samples == 0 and time.time() < deadline:
+            time.sleep(0.02)
+
+        result = scrape_fleet_profiles()
+        assert result["scraped"] >= 3
+        fleet = result["fleet"]
+        assert sorted(fleet["meta"]["planes"]) == ["native", "python"]
+        assert fleet["samples"] > 0                      # Python samples
+        assert fleet["stacks"].get("native;GET", 0.0) > 0.0
+        assert any(not k.startswith("native;") for k in fleet["stacks"])
+        # ... folded into ONE artifact that round-trips
+        art = tmp_path / "fleet.folded"
+        art.write_text(P.profile_to_folded(fleet))
+        loaded = P.load_profile(str(art))
+        assert loaded["stacks"].get("native;GET", 0.0) > 0.0
+    finally:
+        nsrv.__exit__(None, None, None)
+        store.close()
+        for srv in servers:
+            srv.stop()
+
+
+def test_ensure_started_kill_switch_and_idempotent(monkeypatch):
+    monkeypatch.setenv("TPUMS_PROF", "0")
+    assert P.ensure_started() is None
+    assert not P.profiler_active()
+    monkeypatch.setenv("TPUMS_PROF", "1")
+    p1 = P.ensure_started()
+    p2 = P.ensure_started()
+    assert p1 is p2 and p1.running and P.profiler_active()
+    P.stop_profiler()
+    assert not P.profiler_active()
