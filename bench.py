@@ -41,8 +41,11 @@ BENCH_SECTIONS (comma list: als,svm,serving,svmserve,serving_ingest,
 serving_ha,serving_elastic,serving_rehearsal,serving_bootstrap,
 serving_native,serving_update_plane,serving_rollout,serving_ann,
 serving_watch,serving_autopilot,serving_forensics,serving_geo,
-serving_arena,serving_arena_ingest,serving_edge,serving_profiler;
-default all),
+serving_arena,serving_arena_ingest,serving_edge,serving_profiler,
+serving_push; default all),
+BENCH_PUSH_UPDATES / BENCH_PUSH_FANOUT / BENCH_PUSH_TOPK_SUBS /
+BENCH_PUSH_SEL_UPDATES (push plane: update->push p99, edge fan-out
+amplification, TOPK re-score selectivity under zipf updates),
 BENCH_ANN_ROWS_EXACT / BENCH_ANN_ROWS_IVF / BENCH_ANN_ARM_TIMEOUT_S
 (retrieval-plane A/B arm sizes: sharded-exact question at 1M rows,
 IVF question at 10M, recall@100 >= 0.95 gate recorded),
@@ -895,6 +898,9 @@ _COMPACT_KEYS = (
     "serving_profiler_diff_ok", "serving_profiler_alert_fired",
     "serving_profiler_page_names_frame", "serving_profiler_replicas",
     "serving_profiler_native_stacks", "serving_profiler_ok",
+    "serving_push_latency_p99_ms", "serving_push_fanout_amplification",
+    "serving_push_selectivity", "serving_push_core_starved",
+    "serving_push_ok",
     "mse_live_value", "degraded", "recovered", "terminated", "crash_error",
     "watchdog", "host_ref_ms",
 )
@@ -1150,7 +1156,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         "serving_elastic,serving_rehearsal,serving_bootstrap,"
         "serving_native,serving_update_plane,serving_rollout,serving_ann,"
         "serving_watch,serving_autopilot,serving_forensics,serving_geo,"
-        "serving_arena,serving_arena_ingest,serving_edge,serving_profiler"
+        "serving_arena,serving_arena_ingest,serving_edge,serving_profiler,"
+        "serving_push"
     ).split(",")
     result: dict = {}
     _CURRENT_RESULT = result  # the SIGTERM emitter's view of progress
@@ -1249,6 +1256,8 @@ def _run_all(recovery_enabled: bool = True) -> dict:
         ("serving_edge", "run_serving_edge_section",
          lambda f: f(small)),
         ("serving_profiler", "run_serving_profiler_section",
+         lambda f: f(small)),
+        ("serving_push", "run_serving_push_section",
          lambda f: f(small)),
     )
     for name, fn_name, call in extra:

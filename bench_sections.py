@@ -4382,3 +4382,221 @@ def run_serving_profiler_section(small: bool) -> dict:
                 os.environ[k] = v
         shutil.rmtree(tmp, ignore_errors=True)
     return out
+
+# ---------------------------------------------------------------------------
+# push-plane section: update->push latency, edge fan-out, re-score selectivity
+# ---------------------------------------------------------------------------
+
+def run_serving_push_section(small: bool) -> dict:
+    """Push plane A/B (serve/push.py + the edge hub, round 20).  Three
+    arms, each answering one question the subscription design hinges on:
+
+      latency     update->push p99: a KEY subscriber on a direct B2
+                  connection, timed from ``table.put`` to the delta
+                  arriving at the client.  Target: p99 < 5ms.  On a box
+                  with < 3 usable cores the engine's delivery thread,
+                  the server and the bench fight for one CPU, so
+                  ``serving_push_core_starved`` is recorded and the gate
+                  is waived (honestly slow, not unmeasurable).
+      fanout      amplification through the edge hub: N downstream KEY
+                  subscribers on the same key collapse into ONE upstream
+                  subscription; every update must reach all N.  Gate:
+                  notifications/upstream-delta >= 100x with zero lost
+                  deltas (every client drains exactly M pushes).
+      selectivity re-score narrowing under zipf item updates: S TOPK
+                  subscribers with diverse query vectors; the member
+                  index + entrant filter must re-score only the
+                  intersecting subset.  Gate: mean selectivity
+                  (candidates / (batches * subs)) < 0.9 AND strictly
+                  fewer re-scores than the re-score-everyone baseline.
+    """
+    import threading
+
+    from flink_ms_tpu.serve import registry
+    from flink_ms_tpu.serve.client import QueryClient
+    from flink_ms_tpu.serve.consumer import ALS_STATE
+    from flink_ms_tpu.serve.edge import EdgeClient, EdgeProxy
+    from flink_ms_tpu.serve.elastic import generation_group
+    from flink_ms_tpu.serve.ha import shard_group
+    from flink_ms_tpu.serve.server import LookupServer
+    from flink_ms_tpu.serve.table import ModelTable
+    from flink_ms_tpu.serve.topk import make_als_topk_handler
+
+    n_pushes = int(os.environ.get("BENCH_PUSH_UPDATES",
+                                  400 if small else 2_000))
+    n_fan = int(os.environ.get("BENCH_PUSH_FANOUT",
+                               100 if small else 120))
+    fan_updates = int(os.environ.get("BENCH_PUSH_FANOUT_UPDATES", 10))
+    n_topk_subs = int(os.environ.get("BENCH_PUSH_TOPK_SUBS",
+                                     48 if small else 64))
+    n_items = 200 if small else 500
+    sel_updates = int(os.environ.get("BENCH_PUSH_SEL_UPDATES",
+                                     150 if small else 400))
+
+    tmp = tempfile.mkdtemp(prefix="tpums_push_bench_")
+    saved = os.environ.get("TPUMS_REGISTRY_DIR")
+    os.environ["TPUMS_REGISTRY_DIR"] = os.path.join(tmp, "registry")
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n_cpus = os.cpu_count() or 1
+    starved = n_cpus < 3
+    out: dict = {"serving_push_cpus": n_cpus,
+                 "serving_push_core_starved": starved}
+    srv = proxy = None
+    fan_clients = []
+    try:
+        rng = np.random.default_rng(20)
+        table = ModelTable(4)
+        for i in range(n_items):
+            table.put(f"{i}-I", ";".join(
+                f"{v:.4f}" for v in rng.normal(size=4)))
+        table.put("7-U", "1.0;2.0;0.5;-1.0")
+        srv = LookupServer(
+            {ALS_STATE: table}, host="127.0.0.1", port=0,
+            job_id="bench-push",
+            topk_handlers={ALS_STATE: make_als_topk_handler(table)},
+        ).start()
+
+        # -- 1. update->push latency (direct B2 subscriber) --------------
+        lat_ms = []
+        with QueryClient("127.0.0.1", srv.port, proto="b2",
+                         push=True, timeout_s=30) as c:
+            sub = c.subscribe_key(ALS_STATE, "0-I")
+            lost = 0
+            for i in range(n_pushes):
+                val = f"{i}.0;1.0;2.0;3.0"
+                t0 = time.perf_counter()
+                table.put("0-I", val)
+                msg = c.next_push(timeout_s=5.0)
+                dt = (time.perf_counter() - t0) * 1e3
+                if msg is None or msg[2] != val:
+                    lost += 1
+                else:
+                    lat_ms.append(dt)
+            c.unsubscribe(sub["sub_id"])
+        p = _pcts(lat_ms) if lat_ms else {"p50": None, "p95": None,
+                                          "p99": None}
+        out["serving_push_latency_p50_ms"] = p["p50"]
+        out["serving_push_latency_p99_ms"] = p["p99"]
+        out["serving_push_latency_lost"] = lost
+        _log(f"[bench:push] update->push p50={p['p50']}ms "
+             f"p99={p['p99']}ms over {len(lat_ms)} updates "
+             f"(core_starved={starved})")
+
+        # -- 2. fan-out amplification through the edge hub ---------------
+        group = "bench-push"
+        registry.register(
+            f"w:{srv.port}", "127.0.0.1", srv.port, ALS_STATE,
+            replica_of=shard_group(
+                generation_group(registry.qualify_group(group), 1), 0),
+            replica=0, ready=True, ttl_s=600.0)
+        registry.publish_topology(group, 1)
+        proxy = EdgeProxy(group, register=False, hedge=False).start()
+        up0 = _edge_counter_total("tpums_push_upstream_deltas_total")
+        notif0 = _edge_counter_total("tpums_push_notifications_total")
+        for i in range(n_fan):
+            fc = EdgeClient(endpoints=[("127.0.0.1", proxy.port)],
+                            proto="b2", push=True, timeout_s=30)
+            fc.subscribe_key(ALS_STATE, "1-I")
+            fan_clients.append(fc)
+        fan_lost = 0
+        for m in range(fan_updates):
+            table.put("1-I", f"9.0;9.0;9.0;{m}.0")
+            time.sleep(0.05)  # let the hub drain between bursts
+        deadline = time.time() + 30
+        for fc in fan_clients:
+            got = 0
+            while got < fan_updates and time.time() < deadline:
+                if fc.next_push(timeout_s=1.0) is not None:
+                    got += 1
+            fan_lost += fan_updates - got
+
+        up_deltas = _edge_counter_total(
+            "tpums_push_upstream_deltas_total") - up0
+        notifications = _edge_counter_total(
+            "tpums_push_notifications_total") - notif0
+        amplification = (round(notifications / up_deltas, 1)
+                         if up_deltas else None)
+        out["serving_push_fanout_subs"] = n_fan
+        out["serving_push_fanout_upstream_deltas"] = round(up_deltas)
+        out["serving_push_fanout_notifications"] = round(notifications)
+        out["serving_push_fanout_amplification"] = amplification
+        out["serving_push_fanout_lost"] = fan_lost
+        _log(f"[bench:push] fan-out {n_fan} subs x {fan_updates} "
+             f"updates -> {amplification}x amplification, "
+             f"lost={fan_lost}")
+        for fc in fan_clients:
+            fc.close()
+        fan_clients = []
+
+        # -- 3. re-score selectivity under zipf item updates -------------
+        topk_clients = []
+        for s in range(n_topk_subs):
+            tc = QueryClient("127.0.0.1", srv.port, proto="b2",
+                             push=True, timeout_s=30)
+            vec = rng.normal(size=4)
+            tc.subscribe_topk(
+                ALS_STATE, ";".join(f"{v:.4f}" for v in vec), 8)
+            topk_clients.append(tc)
+        eng = srv._push_engine
+        b0, c0, t0_, r0 = (eng.batches, eng.candidates,
+                           eng.candidate_total, eng.rescored)
+        draws = np.minimum(rng.zipf(1.3, size=sel_updates) - 1,
+                           n_items - 1)
+        for i, d in enumerate(draws):
+            table.put(f"{int(d)}-I", ";".join(
+                f"{v:.4f}" for v in rng.normal(size=4) * 0.5))
+            if i % 25 == 0:
+                time.sleep(0.05)  # mix batched and solo dirty sets
+        deadline = time.time() + 15
+        while eng.batches == b0 or eng.candidate_total == t0_:
+            if time.time() > deadline:
+                break
+            time.sleep(0.05)
+        time.sleep(0.5)  # drain the last dirty batch
+        batches = eng.batches - b0
+        candidates = eng.candidates - c0
+        population = eng.candidate_total - t0_
+        rescored = eng.rescored - r0
+        selectivity = (round(candidates / population, 4)
+                       if population else None)
+        out["serving_push_sel_batches"] = batches
+        out["serving_push_sel_rescored"] = rescored
+        out["serving_push_sel_population"] = population
+        out["serving_push_selectivity"] = selectivity
+        _log(f"[bench:push] selectivity {selectivity} "
+             f"({rescored} rescored / {population} sub-batches "
+             f"over {batches} zipf batches)")
+        for tc in topk_clients:
+            tc.close()
+
+        out["serving_push_ok"] = (
+            lost == 0 and fan_lost == 0
+            and (starved or (p["p99"] is not None and p["p99"] < 5.0))
+            and amplification is not None and amplification >= 100.0
+            and selectivity is not None and selectivity < 0.9
+            and population > 0 and rescored < population)
+        _log(f"[bench:push] ok={out['serving_push_ok']}")
+    except Exception:
+        _log(traceback.format_exc())
+        out["serving_push_error"] = traceback.format_exc(limit=3)
+        out["serving_push_ok"] = False
+    finally:
+        for fc in fan_clients:
+            try:
+                fc.close()
+            except Exception:
+                pass
+        for closer in (proxy, srv):
+            if closer is not None:
+                try:
+                    closer.stop()
+                except Exception:
+                    pass
+        if saved is None:
+            os.environ.pop("TPUMS_REGISTRY_DIR", None)
+        else:
+            os.environ["TPUMS_REGISTRY_DIR"] = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out

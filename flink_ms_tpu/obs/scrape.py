@@ -25,7 +25,7 @@ import socket
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..serve import registry
 from .metrics import (LATENCY_BUCKETS_S, merge_snapshots, render_prometheus,
@@ -212,7 +212,8 @@ def scrape_fleet_profiles(timeout_s: float = 2.0) -> dict:
 
 # verbs that are plumbing, not user traffic — excluded from the qps signal
 # so a scrape/health poller can't talk an autoscaler into scaling out
-_NON_QUERY_VERBS = frozenset({"HEALTH", "METRICS", "PING", "PROFILE"})
+_NON_QUERY_VERBS = frozenset({"HEALTH", "METRICS", "PING", "PROFILE",
+                              "SUBSCRIBE", "RESUME", "UNSUB"})
 
 
 def _query_hists(snapshot: dict) -> List[dict]:
@@ -339,6 +340,30 @@ def fleet_signals(before: dict, after: dict,
                            verbs at AFTER (same log-bucket ladder as the
                            server's, so edge overhead is one
                            subtraction; None when no proxy served)}
+
+    Push plane (round 20 — ``serve/push.py`` on the workers plus the
+    edge hub's fan-out; subscription verbs are in ``_NON_QUERY_VERBS``,
+    so a million idle subscribers never look like query load):
+
+        {"push_subs_active": fleet-summed live subscriptions at AFTER
+                           (worker-held and proxy-held both feed the
+                           same gauge),
+         "push_deltas_per_s": worker-emitted deltas/s over the window,
+         "push_notifications_per_s": downstream notifications/s out of
+                           the edge hubs — notifications/deltas is the
+                           realized fan-out amplification,
+         "push_fanout_ratio": WORST-case (max) downstream-subs per
+                           upstream-sub across proxies at AFTER,
+         "push_resumes_per_s": RESUME verbs served/s (replay and
+                           snapshot-fallback both count; sustained rate
+                           means clients are churning connections),
+         "push_ring_evictions_per_s": replay-ring entries dropped/s —
+                           nonzero means disconnected subscribers are
+                           outliving their rings and will pay a full
+                           snapshot on resume,
+         "push_p99_s":     update→push p99 at AFTER over the window
+                           (``tpums_push_latency_seconds`` — the ladder
+                           the SLO_REPORT freshness gate reads)}
 
     Continuous-profiling plane (round 19 — ``obs/profiler.py``; the
     sampler's flush publishes these, so they ride the normal METRICS
@@ -544,6 +569,47 @@ def fleet_signals(before: dict, after: dict,
         - (_counter_total(before, "tpums_native_self_seconds_total")
            + _counter_total(before, "tpums_arena_write_cpu_seconds_total")),
         0.0)
+    # push plane (round 20 — serve/push.py + the edge hub): live subs
+    # and fan-out as LEVELS, delta/notification/resume/eviction traffic
+    # as RATES, and the update→push freshness ladder's window p99
+    push_subs = sum(
+        g["value"] for g in after.get("gauges", [])
+        if g["name"] == "tpums_push_subs_active")
+    push_deltas = max(
+        _counter_total(after, "tpums_push_deltas_total")
+        - _counter_total(before, "tpums_push_deltas_total"), 0.0)
+    push_notifications = max(
+        _counter_total(after, "tpums_push_notifications_total")
+        - _counter_total(before, "tpums_push_notifications_total"), 0.0)
+    push_fanout = max(
+        (g["value"] for g in after.get("gauges", [])
+         if g["name"] == "tpums_push_fanout_ratio"), default=0.0)
+    push_resumes = max(
+        _counter_total(after, "tpums_push_resume_total")
+        - _counter_total(before, "tpums_push_resume_total"), 0.0)
+    push_evictions = max(
+        _counter_total(after, "tpums_push_ring_evictions_total")
+        - _counter_total(before, "tpums_push_ring_evictions_total"), 0.0)
+    push_window = None  # delta histogram of update→push latency
+    for h in after.get("histograms", []):
+        if h["name"] != "tpums_push_latency_seconds":
+            continue
+        k = (h["name"], tuple(sorted(h.get("labels", {}).items())))
+        prev = b_all.get(k, {"counts": [0] * len(h["counts"]),
+                             "count": 0, "sum": 0.0})
+        dc = h["count"] - prev["count"]
+        if dc <= 0:
+            continue
+        dcounts = [a - b for a, b in zip(h["counts"], prev["counts"])]
+        if push_window is None:
+            push_window = {"name": "push_window", "le": list(h["le"]),
+                           "counts": dcounts, "count": dc,
+                           "sum": h["sum"] - prev["sum"]}
+        elif push_window["le"] == list(h["le"]):
+            push_window["counts"] = [a + b for a, b in
+                                     zip(push_window["counts"], dcounts)]
+            push_window["count"] += dc
+            push_window["sum"] += h["sum"] - prev["sum"]
     edge_window = None  # delta histogram across the proxy's query verbs
     for h in after.get("histograms", []):
         if h["name"] != "tpums_edge_latency_seconds":
@@ -598,11 +664,91 @@ def fleet_signals(before: dict, after: dict,
         "edge_shed_per_s": edge_shed / dt_s,
         "edge_p99_s": (snapshot_quantile(edge_window, 99)
                        if edge_window else None),
+        "push_subs_active": push_subs,
+        "push_deltas_per_s": push_deltas / dt_s,
+        "push_notifications_per_s": push_notifications / dt_s,
+        "push_fanout_ratio": push_fanout,
+        "push_resumes_per_s": push_resumes / dt_s,
+        "push_ring_evictions_per_s": push_evictions / dt_s,
+        "push_p99_s": (snapshot_quantile(push_window, 99)
+                       if push_window else None),
         "prof_samples_per_s": prof_samples / dt_s,
         "process_cpu_per_s": process_cpu / dt_s,
         "native_self_cpu_per_s": native_self / dt_s,
         "dt_s": dt_s,
         "requests": requests,
+    }
+
+
+def push_freshness(samples: Sequence[Tuple[float, dict]]) -> dict:
+    """Reset-aware update→push freshness over a SERIES of fleet scrapes.
+
+    ``fleet_signals`` differences two endpoint snapshots, which is blind
+    to counter resets in between: an elastic generation cutover (or any
+    worker restart) replaces the processes whose counters held the
+    window's history, so ``after - before`` clamps to zero and the
+    latency histogram's delta goes empty — a healthy push plane reads as
+    a silent one.  Here each CONSECUTIVE scrape pair contributes its
+    increment instead, with the standard reset rule: when a fleet-merged
+    total shrinks, the new snapshot's value IS the increment (the
+    replacement processes started from zero, so their total is exactly
+    what they did since).  While old and new generations are briefly
+    co-registered their merged total covers both, so a cutover costs at
+    most one scrape interval of re-counted new-generation traffic — an
+    acceptable overcount for a freshness gate, never an undercount.
+
+    ``samples`` are ``(unix_ts, fleet_snapshot)`` pairs as collected by
+    the rehearsal's sampler (obs/workload.py).  Returns::
+
+        {"deltas": accumulated tpums_push_deltas_total increments,
+         "p99_s": update→push p99 over the accumulated window ladder
+                  (None when no observation landed),
+         "dt_s": wall span of the series}
+    """
+    def _total(snap, name):
+        return sum(c["value"] for c in snap.get("counters", [])
+                   if c["name"] == name)
+
+    def _hists(snap):
+        return {tuple(sorted(h.get("labels", {}).items())): h
+                for h in snap.get("histograms", [])
+                if h["name"] == "tpums_push_latency_seconds"}
+
+    deltas = 0.0
+    window: Optional[dict] = None
+    for (_, before), (_, after) in zip(samples, samples[1:]):
+        inc = _total(after, "tpums_push_deltas_total") \
+            - _total(before, "tpums_push_deltas_total")
+        if inc < 0:  # reset: the survivors' total is the increment
+            inc = _total(after, "tpums_push_deltas_total")
+        deltas += inc
+        prev = _hists(before)
+        for key, h in _hists(after).items():
+            p = prev.get(key)
+            if p is None or h["count"] < p["count"] \
+                    or list(p["le"]) != list(h["le"]):
+                p = {"counts": [0] * len(h["counts"]), "count": 0,
+                     "sum": 0.0}
+            dc = h["count"] - p["count"]
+            if dc <= 0:
+                continue
+            dcounts = [a - b for a, b in zip(h["counts"], p["counts"])]
+            if any(d < 0 for d in dcounts):  # partial reset mid-merge
+                dcounts, dc = list(h["counts"]), h["count"]
+            dsum = max(h["sum"] - p["sum"], 0.0)
+            if window is None:
+                window = {"name": "push_window", "le": list(h["le"]),
+                          "counts": dcounts, "count": dc, "sum": dsum}
+            elif window["le"] == list(h["le"]):
+                window["counts"] = [a + b for a, b in
+                                    zip(window["counts"], dcounts)]
+                window["count"] += dc
+                window["sum"] += dsum
+    return {
+        "deltas": deltas,
+        "p99_s": (snapshot_quantile(window, 99) if window else None),
+        "dt_s": (samples[-1][0] - samples[0][0]) if len(samples) > 1
+                else 0.0,
     }
 
 

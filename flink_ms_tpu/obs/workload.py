@@ -36,7 +36,11 @@ CLI::
     python -m flink_ms_tpu.obs.workload --rehearsal [--out SLO_REPORT.json]
         [--shards 2 --replication 2 --durationS 12 --baseQps 120
          --burstQps 480 --autoscale live|dry|off --kill 1 --seed 0
-         --abusiveQps 0]   # >0: add an over-quota "abuse" tenant on top
+         --abusiveQps 0    # >0: add an over-quota "abuse" tenant on top
+         --subscribers 0   # >0: that many live push subscriptions ride
+                           # the run (serve/push.py) and the SLO report
+                           # gates update->push freshness
+         --pushP99Ms 250]
     python -m flink_ms_tpu.obs.workload --group <topology-group> ...
         # attach mode: drive load + report against an ALREADY-RUNNING
         # elastic group instead of spawning one (no kill, no autoscaler)
@@ -608,6 +612,79 @@ _ABUSE_VERBS = ("GET", "MGET", "TOPK", "TOPKV")
 ABUSIVE_TENANT = "abuse"
 
 
+def _run_subscriber(idx: int, live_group: str, edge: int, state: str,
+                    key: str, stop: threading.Event, stats: dict,
+                    lock: threading.Lock) -> None:
+    """One push subscriber (serve/push.py): hold a ``su=1`` connection
+    with a KEY subscription on a hot factor row, drain deltas until
+    told to stop.  A dead connection (replica kill, reshard cutover,
+    proxy death) reconnects and RESUMEs at the last delivered seq — the
+    replay-or-snapshot answer is counted either way, so the stats show
+    churn without ever double-counting a delta."""
+    from ..serve import registry as reg_mod
+    from ..serve.client import QueryClient
+    from ..serve.elastic import generation_group
+    from ..serve.ha import resolve_shard_endpoints
+    from ..serve.sharded import owner_of
+
+    qgroup = reg_mod.qualify_group(live_group)
+
+    def connect():
+        if edge > 0:
+            from ..serve.edge import EdgeClient
+            return EdgeClient(live_group, proto="b2", push=True,
+                              timeout_s=10.0)
+        topo = reg_mod.resolve_topology(qgroup)
+        if topo is None:
+            raise ConnectionError(f"no topology for {live_group!r}")
+        gen, shards = int(topo["gen"]), int(topo["shards"])
+        eps = resolve_shard_endpoints(generation_group(qgroup, gen),
+                                      owner_of(key, shards))
+        if not eps:
+            raise ConnectionError(f"no endpoints for key {key!r}")
+        host, port = eps[idx % len(eps)]
+        return QueryClient(host=host, port=port, proto="b2", push=True,
+                           timeout_s=10.0)
+
+    c = None
+    sub = None
+    backoff = 0
+    while not stop.is_set():
+        try:
+            if c is None:
+                c = connect()
+                if sub is None:
+                    got = c.subscribe_key(state, key)
+                else:
+                    got = c.resume_subscription(
+                        state, "KEY", key, 0, sub["sub_id"], sub["seq"])
+                    with lock:
+                        stats["resumes"] += 1
+                sub = {"sub_id": got["sub_id"], "seq": got["seq"]}
+                backoff = 0
+            p = c.next_push(timeout_s=0.25)
+            if p is not None:
+                sub["seq"] = p[1]
+                with lock:
+                    stats["pushes"] += 1
+        except Exception:
+            with lock:
+                stats["errors"] += 1
+            try:
+                if c is not None:
+                    c.close()
+            except Exception:
+                pass
+            c = None
+            backoff = min(backoff + 1, 10)
+            stop.wait(0.05 * backoff)
+    try:
+        if c is not None:
+            c.close()
+    except Exception:
+        pass
+
+
 def _seed_journal(base: str, topic: str, users: int, dim: int, seed: int):
     from ..core import formats as F
     from ..serve.journal import Journal
@@ -655,6 +732,8 @@ def run_rehearsal(
     watch_canary=None,
     watch_interval_s: float = 0.5,
     edge: int = 0,
+    subscribers: int = 0,
+    push_p99_ms: float = 250.0,
 ) -> dict:
     """The closed loop: elastic sharded group + open-loop zipfian mixed-verb
     engine + autoscaler + one chaos kill, all acting on the same fleet,
@@ -689,6 +768,16 @@ def run_rehearsal(
     attribution must still come out clean: ``edge_hedge``/``edge_shed``/
     ``proxy_reconnect`` are timeline events, never unattributed errors.
     In attach mode the proxies must already be registered for the group.
+
+    With ``subscribers > 0`` that many live push subscriptions
+    (``serve/push.py``: KEY subs on the zipf-hot factor rows, through
+    the edge tier when ``edge > 0``) ride the whole run, draining
+    deltas fed by the UPDATE verb's factor writes.  The report gains a
+    ``"push"`` section — subscriber population, deltas delivered,
+    resume churn, and the fleet's update→push p99 off
+    ``tpums_push_latency_seconds`` — and the overall gate additionally
+    requires that p99 under ``push_p99_ms`` with at least one delta
+    delivered: push freshness becomes an SLO, not a hope.
     """
     from . import slo as obs_slo
     from .scrape import scrape_fleet
@@ -841,7 +930,8 @@ def run_rehearsal(
         if update_plane and journal is not None:
             from ..serve.update_plane import UpdatePlaneClient
             upd_client = UpdatePlaneClient(journal.dir, "models")
-        ops = ServingOps(client_factory, ZipfKeys(users, zipf_exponent, seed),
+        zkeys = ZipfKeys(users, zipf_exponent, seed)
+        ops = ServingOps(client_factory, zkeys,
                          ALS_STATE, journal=journal, dim=dim,
                          update_plane=upd_client,
                          client_factories=client_factories)
@@ -863,6 +953,24 @@ def run_rehearsal(
                     except Exception:
                         break
         ops.close_local()
+
+        # push subscriber population: live subscriptions on the hottest
+        # factor rows, fed by the UPDATE verb's writes for the whole run
+        push_stop = threading.Event()
+        push_stats = {"pushes": 0, "resumes": 0, "errors": 0}
+        push_lock = threading.Lock()
+        sub_threads: List[threading.Thread] = []
+        if subscribers > 0:
+            hot_n = max(1, min(16, users))
+            for i in range(subscribers):
+                key = f"{zkeys.ids[i % hot_n]}-U"
+                t = threading.Thread(
+                    target=_run_subscriber,
+                    args=(i, live_group, edge, ALS_STATE, key, push_stop,
+                          push_stats, push_lock),
+                    daemon=True, name=f"tpums-sub-{i}")
+                t.start()
+                sub_threads.append(t)
 
         # the SLO timeline starts HERE: the bring-up cutover above is
         # plumbing, not an excursion cause
@@ -912,6 +1020,12 @@ def run_rehearsal(
             killer_t.join(timeout=10)
         if autoscaler is not None:
             autoscaler.stop()
+        # give in-flight deltas a beat to land before stopping the drain
+        if sub_threads:
+            time.sleep(0.5)
+            push_stop.set()
+            for t in sub_threads:
+                t.join(timeout=10)
         sampler_stop.set()
         sampler_t.join(timeout=10)
         alerts_section = None
@@ -956,10 +1070,46 @@ def run_rehearsal(
                 "seed": seed,
                 "abusive_qps": abusive_qps,
                 "edge": edge,
+                "subscribers": subscribers,
             },
         )
         if alerts_section is not None:
             report["alerts"] = alerts_section
+        if subscribers > 0:
+            # push freshness as an SLO: the fleet's own update→push
+            # ladder (tpums_push_latency_seconds) must hold its p99
+            # under budget AND at least one delta must have actually
+            # reached a subscriber — a silent push plane with a vacuous
+            # histogram does not pass.  Folded over the sampler's scrape
+            # SERIES, not the endpoint pair: an autoscaler cutover or a
+            # chaos kill mid-run replaces the worker processes whose
+            # counters held the window, and the endpoint difference
+            # would read a healthy plane as a silent one (push_freshness
+            # is reset-aware pair by pair).
+            from .scrape import fleet_signals, push_freshness
+            sig = fleet_signals(fleet_before, fleet_after)
+            fresh = push_freshness(scrapes)
+            p99_s = fresh["p99_s"]
+            with push_lock:
+                delivered = push_stats["pushes"]
+                resumes = push_stats["resumes"]
+                sub_errors = push_stats["errors"]
+            fresh_ok = bool(delivered > 0 and p99_s is not None
+                            and p99_s * 1e3 <= push_p99_ms)
+            report["push"] = {
+                "subscribers": subscribers,
+                "pushes_received": delivered,
+                "resumes": resumes,
+                "subscriber_errors": sub_errors,
+                "subs_active": sig.get("push_subs_active"),
+                "deltas_per_s": (fresh["deltas"] / fresh["dt_s"]
+                                 if fresh["dt_s"] > 0 else 0.0),
+                "p99_ms": (round(p99_s * 1e3, 3)
+                           if p99_s is not None else None),
+                "p99_budget_ms": push_p99_ms,
+                "fresh_ok": fresh_ok,
+            }
+            report["ok"] = bool(report["ok"] and fresh_ok)
         if out_path:
             with open(out_path, "w") as f:
                 json.dump(report, f, indent=1, default=str)
@@ -1031,6 +1181,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         abusive_qps=float(params.get("abusiveQps", "0")),
         watch=params.get_int("watch", 0) != 0,
         edge=params.get_int("edge", 0),
+        subscribers=params.get_int("subscribers", 0),
+        push_p99_ms=float(params.get("pushP99Ms", "250")),
     )
     sys.stderr.write(obs_slo.human_summary(report) + "\n")
     out = {
@@ -1045,6 +1197,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if "alerts" in report:
         out["alerts"] = {k: report["alerts"][k] for k in
                          ("fired_total", "unattributed_page", "detection")}
+    if "push" in report:
+        out["push"] = {k: report["push"][k] for k in
+                       ("pushes_received", "p99_ms", "fresh_ok")}
     print(json.dumps(out, indent=1))
     return 0 if report["ok"] else 1
 

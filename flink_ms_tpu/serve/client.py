@@ -16,6 +16,7 @@ import os
 import random
 import socket
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Optional
 
@@ -76,6 +77,7 @@ class QueryClient:
         proto: Optional[str] = None,
         tenant: Optional[str] = None,
         stale: Optional[bool] = None,
+        push: Optional[bool] = None,
     ):
         self.host = host
         self.port = port
@@ -124,11 +126,31 @@ class QueryClient:
         # because the proxy strips it before routing upstream.
         self._stale_ext = wire_proto.STALE_EXT
         self.last_staleness_s: Optional[float] = None
+        # push plane (serve/push.py): opt-in, same wire contract as the
+        # extensions above — the HELLO gains ``su=1`` and the connection
+        # may then receive unsolicited ``PUSH\t...`` frames between
+        # replies, which the read paths below route into ``_pushes``
+        # instead of treating as the next reply.  Off (the default) keeps
+        # the wire byte-identical to the seed protocol.  Subscribing
+        # needs a B2 connection: the binary frame reader owns an explicit
+        # buffer, so buffered-vs-inflight pushes are separable without
+        # racing the line reader (the tab SUBSCRIBE verb still exists on
+        # the server for raw-socket clients).
+        if push is None:
+            push = os.environ.get("TPUMS_PUSH", "0") != "0"
+        self.push = bool(push)
+        if self.push and self.proto == "tab":
+            raise ValueError("push=True needs a B2 connection "
+                             "(proto='b2' or 'auto')")
+        from collections import deque
+
+        self._pushes = deque()  # (sub_id, seq, payload) awaiting next_push
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._binary = False  # per-connection: set by the HELLO exchange
         self._b2_trace = False  # per-connection: tr=1 accepted
         self._b2_stale = False  # per-connection: st=1 accepted
+        self._b2_push = False  # per-connection: su=1 accepted
         self._frame_reader = None
 
     def _connect(self):
@@ -139,6 +161,7 @@ class QueryClient:
         self._binary = False
         self._b2_trace = False
         self._b2_stale = False
+        self._b2_push = False
         self._frame_reader = None
         if self.proto in ("b2", "auto"):
             # with a tenant, the HELLO carries it (connection-scoped — B2
@@ -154,6 +177,8 @@ class QueryClient:
                 hello += f"\t{wire_proto.TRACE_EXT}"
             if self.stale:
                 hello += f"\t{self._stale_ext}"
+            if self.push:
+                hello += f"\t{wire_proto.PUSH_EXT}"
             sock.sendall(hello.encode("utf-8") + b"\n")
             line = self._rfile.readline()
             if not line:
@@ -164,6 +189,7 @@ class QueryClient:
                 self._binary = True
                 self._b2_trace = self._want_b2_trace
                 self._b2_stale = self.stale
+                self._b2_push = self.push
                 self._frame_reader = wire_proto.FrameReader(self._rfile)
             elif self.proto == "b2":
                 self.close()
@@ -218,7 +244,7 @@ class QueryClient:
                     self._sock.sendall(wire_proto.encode_request_frame(
                         [request],
                         tids=[wt] if self._b2_trace else None))
-                    texts = self._frame_reader.read_frame()
+                    texts = self._read_reply_frame()
                     if len(texts) != 1:
                         raise ConnectionError(
                             f"reply frame carried {len(texts)} records "
@@ -238,11 +264,7 @@ class QueryClient:
                     f"{line}\t{obs_tracing.TID_FIELD}{wt}\n"
                     .encode("utf-8"))
                 self._sock.sendall(wire)
-                line = self._rfile.readline()
-                if not line:
-                    raise ConnectionError(
-                        "lookup server closed the connection")
-                reply = line.decode("utf-8").rstrip("\n")
+                reply = self._read_reply_line()
                 if tid is not None:
                     reply = obs_tracing.unstamp_reply(reply, wt)
                     dt = time.perf_counter() - t0
@@ -381,7 +403,7 @@ class QueryClient:
                         if self._b2_trace else None))
                     inflight.append(len(chunk))
                     next_send += 1
-                texts = self._frame_reader.read_frame()
+                texts = self._read_reply_frame()
                 expect = inflight.pop(0)
                 if len(texts) != expect:
                     raise ConnectionError(
@@ -430,12 +452,7 @@ class QueryClient:
                 self._sock.sendall(data.encode("utf-8"))
                 sent = burst_end
                 continue
-            line = self._rfile.readline()
-            if not line:
-                raise ConnectionError(
-                    "lookup server closed the connection mid-pipeline"
-                )
-            replies.append(line.decode("utf-8").rstrip("\n"))
+            replies.append(self._read_reply_line())
         if tid is not None:
             replies = [obs_tracing.unstamp_reply(r, wt) for r in replies]
             dt = time.perf_counter() - t0
@@ -447,6 +464,133 @@ class QueryClient:
         if self.stale:
             replies = [self._pop_reply_stale(r) for r in replies]
         return replies
+
+    def _read_reply_frame(self) -> list:
+        """One reply frame off the B2 connection, routing any unsolicited
+        ``PUSH`` frames (serve/push.py: single-record, prefix-tagged —
+        no reply verb shares the prefix) into the push queue instead of
+        returning them as the next reply.  This is what keeps the
+        request/reply pairing intact on a subscribed connection; on a
+        pull-only connection the predicate never fires and behavior is
+        byte-identical."""
+        while True:
+            texts = self._frame_reader.read_frame()
+            if len(texts) == 1 and wire_proto.is_push_text(texts[0]):
+                self._queue_push(texts[0])
+                continue
+            return texts
+
+    def _read_reply_line(self) -> str:
+        """One tab reply line, skipping unsolicited push lines the same
+        way (tab subscriptions are raw-socket territory, but a reader
+        that tolerates the frames costs one prefix check per line)."""
+        while True:
+            line = self._rfile.readline()
+            if not line:
+                raise ConnectionError("lookup server closed the connection")
+            reply = line.decode("utf-8").rstrip("\n")
+            if wire_proto.is_push_text(reply):
+                self._queue_push(reply)
+                continue
+            return reply
+
+    def _queue_push(self, text: str) -> None:
+        from .push import parse_push  # lazy: keeps the client numpy-free
+
+        self._pushes.append(parse_push(text))
+
+    # ------------------------------------------------------------------
+    # push plane (serve/push.py; requires push=True)
+    # ------------------------------------------------------------------
+
+    def subscribe_key(self, name: str, key: str) -> dict:
+        """SUBSCRIBE to a key -> ``{"sub_id", "seq", "snapshot"}`` where
+        snapshot is the current value ("" when absent).  Each later change
+        arrives via ``next_push`` as the new value with the next seq."""
+        if "\t" in key or "\n" in key:
+            raise ValueError("keys must not contain tabs/newlines")
+        self._require_push()
+        return self._parse_sub_reply(
+            self._roundtrip(f"SUBSCRIBE\t{name}\tKEY\t{key}\t0"))
+
+    def subscribe_topk(self, name: str, factors_payload: str,
+                       k: int) -> dict:
+        """SUBSCRIBE to a top-k query -> ``{"sub_id", "seq", "snapshot"}``
+        with the materialized ``item:score;...`` shortlist.  Deltas
+        (``+item:score`` / ``-item`` entries) arrive via ``next_push``;
+        fold them with ``push.apply_delta``."""
+        if "\t" in factors_payload or "\n" in factors_payload:
+            raise ValueError("factor payloads must not contain tabs/newlines")
+        self._require_push()
+        return self._parse_sub_reply(self._roundtrip(
+            f"SUBSCRIBE\t{name}\tTOPK\t{factors_payload}\t{int(k)}"))
+
+    def resume_subscription(self, name: str, kind: str, arg: str, k: int,
+                            sub_id: str, last_seq: int) -> dict:
+        """RESUME after a reconnect -> ``{"mode": "replay", "sub_id",
+        "seq"}`` (missed deltas follow as ordinary pushes) or ``{"mode":
+        "snapshot", "sub_id", "seq", "snapshot"}`` — a FRESH subscription
+        whose snapshot is the catch-up (new id: the old stream cannot be
+        bridged, e.g. the replica that held it is gone)."""
+        self._require_push()
+        reply = self._roundtrip(
+            f"RESUME\t{name}\t{kind}\t{arg}\t{int(k)}\t{sub_id}:{int(last_seq)}")
+        if reply.startswith("R\t"):
+            _, rid, from_seq = reply.split("\t")
+            return {"mode": "replay", "sub_id": rid, "seq": int(from_seq)}
+        return self._parse_sub_reply(reply)
+
+    def unsubscribe(self, sub_id: str) -> None:
+        self._require_push()
+        reply = self._roundtrip(f"UNSUB\t{sub_id}")
+        if reply != f"U\t{sub_id}":
+            raise RuntimeError(f"unsubscribe failed: {reply}")
+
+    def next_push(self, timeout_s: float = 1.0):
+        """The next queued push -> ``(sub_id, seq, payload)``, or None
+        after ``timeout_s`` with nothing pushed.  Polls the frame
+        reader's buffer FIRST (a push that shared a TCP segment with a
+        reply is already buffered, invisible to select), then waits on
+        the socket."""
+        if self._pushes:
+            return self._pushes.popleft()
+        if not self._binary or self._frame_reader is None:
+            raise RuntimeError("push needs an open B2 connection "
+                               "(push=True + a prior request)")
+        import select as _select
+
+        deadline = time.monotonic() + timeout_s
+        while not self._pushes:
+            texts = self._frame_reader.poll_frame()
+            if texts is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                readable, _, _ = _select.select(
+                    [self._sock], [], [], remaining)
+                if not readable:
+                    return None
+                texts = self._frame_reader.read_frame()
+            if len(texts) == 1 and wire_proto.is_push_text(texts[0]):
+                self._queue_push(texts[0])
+            else:
+                raise ConnectionError(
+                    "non-push reply frame with no request in flight: "
+                    f"{texts[:1]!r}")
+        return self._pushes.popleft()
+
+    def _require_push(self) -> None:
+        if not self.push:
+            raise RuntimeError(
+                "push plane not enabled on this client (pass push=True)")
+
+    @staticmethod
+    def _parse_sub_reply(reply: str) -> dict:
+        if not reply.startswith("S\t"):
+            raise RuntimeError(f"subscribe failed: {reply}")
+        _, sub_id, seq, payload = reply.split("\t", 3)
+        return {"mode": "snapshot", "sub_id": sub_id, "seq": int(seq),
+                "snapshot": payload}
 
     def _pop_reply_stale(self, reply: str) -> str:
         """Strip the trailing ``st=<seconds>`` field the server appends to

@@ -38,17 +38,21 @@ MAGIC = b"B2"
 # The negotiation handshake, sent as a plain tab-protocol line.  Optional
 # extensions ride as extra tab fields, each self-describing: ``tn=<tenant>``
 # (admission identity, serve/admission.py), ``tr=1`` (per-record trace
-# field, obs/tracing.py) and ``st=1`` (per-read staleness reporting,
+# field, obs/tracing.py), ``st=1`` (per-read staleness reporting,
 # serve/georepl.py — every reply record gains a trailing ``st=<seconds>``
-# field).  A HELLO with any OTHER extra field is malformed and answers
-# ``E\tbad request`` — pinned, so old and new servers refuse unknown
-# extensions identically.  The accept reply stays the frozen two-field
-# line either way.
+# field) and ``su=1`` (push plane, serve/push.py — the client accepts
+# UNSOLICITED ``PUSH\t`` frames between replies; SUBSCRIBE on a B2
+# connection requires it).  A HELLO with any OTHER extra field is malformed
+# and answers ``E\tbad request`` — pinned, so old and new servers refuse
+# unknown extensions identically (the native C++ plane refuses ``su=1``
+# this way: push serving is Python-plane only).  The accept reply stays
+# the frozen two-field line either way.
 HELLO_VERB = "HELLO"
 HELLO_LINE = "HELLO\tB2"
 HELLO_REPLY = "HELLO\tB2"
 TRACE_EXT = "tr=1"
 STALE_EXT = "st=1"
+PUSH_EXT = "su=1"
 STALE_FIELD = "st="  # request: trailing tab field opting one read into
                      # staleness reporting; reply: trailing ``st=<seconds>``
 _TENANT_FIELD = "tn="  # mirrors serve/admission.py TENANT_FIELD (no import:
@@ -57,14 +61,15 @@ _TENANT_FIELD = "tn="  # mirrors serve/admission.py TENANT_FIELD (no import:
 
 def parse_hello(parts: Sequence[str]) -> Optional[dict]:
     """Validate a split HELLO line -> ``{"proto", "tenant", "trace",
-    "stale"}`` or None when structurally malformed (unknown extension,
-    duplicate tenant).  The caller still refuses protos other than
-    ``B2``."""
+    "stale", "push"}`` or None when structurally malformed (unknown
+    extension, duplicate tenant).  The caller still refuses protos other
+    than ``B2``."""
     if len(parts) < 2 or parts[0] != HELLO_VERB:
         return None
     tenant: Optional[str] = None
     trace = False
     stale = False
+    push = False
     for ext in parts[2:]:
         if ext.startswith(_TENANT_FIELD) and tenant is None:
             tenant = ext[len(_TENANT_FIELD):]
@@ -72,10 +77,26 @@ def parse_hello(parts: Sequence[str]) -> Optional[dict]:
             trace = True
         elif ext == STALE_EXT and not stale:
             stale = True
+        elif ext == PUSH_EXT and not push:
+            push = True
         else:
             return None
     return {"proto": parts[1], "tenant": tenant, "trace": trace,
-            "stale": stale}
+            "stale": stale, "push": push}
+
+
+# Push frames (serve/push.py).  A ``su=1`` connection may receive
+# unsolicited single-text reply records ``PUSH\t<sub_id>\t<seq>\t<payload>``
+# interleaved between (never inside) ordinary replies.  The token is
+# deliberately NOT a single letter: ``P\t`` already belongs to the
+# PROFILE reply and ``PONG`` to PING, and a client must be able to route
+# a decoded text by prefix alone without consulting its in-flight window.
+PUSH_PREFIX = "PUSH\t"
+
+
+def is_push_text(text: str) -> bool:
+    """True when a decoded reply text is an unsolicited push frame."""
+    return text.startswith(PUSH_PREFIX)
 
 
 def pop_stale(parts: List[str]) -> bool:
@@ -99,6 +120,9 @@ OPCODES = {
     "HEALTH": 7,
     "METRICS": 8,
     "PING": 9,
+    "SUBSCRIBE": 10,
+    "RESUME": 11,
+    "UNSUB": 12,
 }
 VERB_BY_OP = {op: verb for verb, op in OPCODES.items()}
 
@@ -115,6 +139,9 @@ FIELD_COUNTS = {
     "HEALTH": 1,   # state
     "METRICS": 0,
     "PING": 0,
+    "SUBSCRIBE": 4,  # state, kind (KEY|TOPK), arg, k
+    "RESUME": 5,     # state, kind, arg, k, cursor ("<sub_id>:<seq>")
+    "UNSUB": 1,      # sub_id
 }
 
 # Caps.  Requests are client-authored and small; replies can carry wide MGET /
@@ -378,6 +405,19 @@ class FrameReader:
     def __init__(self, rfile):
         self._rfile = rfile
         self._buf = bytearray()
+
+    def poll_frame(self) -> Optional[List[str]]:
+        """Decode one already-buffered frame without touching the socket
+        (None when the buffer holds no complete frame).  Push-capable
+        clients poll this before selecting on the socket: a PUSH frame
+        that arrived in the same TCP segment as a reply sits in this
+        buffer, invisible to select."""
+        res = decode_reply_frame(self._buf)
+        if res is None:
+            return None
+        texts, consumed = res
+        del self._buf[:consumed]
+        return texts
 
     def read_frame(self) -> List[str]:
         """Read one reply frame.

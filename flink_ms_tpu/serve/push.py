@@ -1,0 +1,739 @@
+"""Push plane: subscribed queries and materialized top-k deltas.
+
+The reference's loop ends at queryable state — online SGD updates land in
+the serving tables and every client POLLS them.  This module inverts the
+last hop: a client SUBSCRIBEs to a key or a top-k query once, the engine
+materializes the answer, and each update batch pushes score/membership
+DELTAS over the already-open connection instead of being re-asked.
+
+Wire surface (serve/proto.py; servers answer, engines never read)::
+
+    SUBSCRIBE\t<state>\t<kind>\t<arg>\t<k>
+        kind KEY : arg is the key, k is ignored ("0" by convention)
+        kind TOPK: arg is the query-factor payload ``f1;f2;...``
+        -> S\t<sub_id>\t<seq>\t<snapshot>      (seq 0 baseline)
+    RESUME\t<state>\t<kind>\t<arg>\t<k>\t<sub_id>:<seq>
+        -> R\t<sub_id>\t<from_seq>             then the missed deltas
+           replayed as ordinary PUSH frames (ring hit), or
+        -> S\t<new_sub_id>\t0\t<snapshot>      (ring miss / unknown sub /
+           different replica: a FRESH subscription whose snapshot IS the
+           catch-up — new id, new sequence space)
+    UNSUB\t<sub_id>
+        -> U\t<sub_id>
+    pushes: PUSH\t<sub_id>\t<seq>\t<payload>   (unsolicited, between —
+           never inside — ordinary replies)
+
+Delta payloads are ``;``-joined entries: ``+item:score`` (entered the
+shortlist, or its score changed) and ``-item`` (evicted).  KEY deltas
+carry the new value verbatim.  Snapshots carry the full materialized
+answer (``item:score;...`` / the value).
+
+Delivery contract — the invariant the chaos arm audits: per subscription
+id, sequence numbers are strictly contiguous from the S baseline.  A gap
+is a missed notification, a repeat is a duplicate; ``audit_push_sequences``
+(the PR-9 ``audit_partitions`` idea applied to subscription streams)
+counts both, tiled by subscription.  Reshards, replica kills and region
+failovers stay inside the contract because a RESUME that cannot replay
+NEVER reuses the old id: subscription ids are ``<epoch>-<n>`` with the
+epoch CAS-claimed from the registry (``registry.next_push_epoch``), so a
+replacement replica mints ids in a fresh sequence space and bridges the
+client with a snapshot instead of guessing at the old stream.
+
+Re-score work scales with the subscriptions an update batch can actually
+affect, not with the subscription population:
+
+* KEY subs are a direct hash — ``(state, key) -> sub ids``.
+* TOPK subs intersect a dirty batch two ways, both cheap: a MEMBER index
+  from shortlist items to sub ids (an update to a current member may
+  re-rank or evict it), and an ENTRANT filter — one ``Q @ V.T`` matmul of
+  every stacked query vector against the batch's changed rows, compared
+  row-wise against each sub's materialized admission threshold (its
+  current k-th score; a short shortlist admits anything).  When the index
+  runs the IVF tier (serve/ann.py), a sub only probes ``nprobe`` centroid
+  lists, so entrant candidates are additionally narrowed to dirty rows
+  whose centroid falls in the sub's probed set — the posting lists give
+  the candidate index nearly for free.
+* Candidates re-score through ``DeviceFactorIndex.topk_many`` — ONE
+  batched device dispatch per (state, k) group, not one per subscription.
+
+Knobs: ``TPUMS_PUSH_RING`` (per-sub replay ring length, default 256),
+``TPUMS_PUSH_MAX_SUBS`` (engine-wide cap, default 65536),
+``TPUMS_PUSH_SCORE_EPS`` (min score change worth a delta, default 0 =
+any change).
+
+Freshness caveat, stated honestly: the engine re-scores through the same
+serve-stale top-k index queries use, so a structural change that kicks a
+BACKGROUND index rebuild is reflected at the next dirty batch after the
+rebuild lands, exactly like polled queries observe it.  Sequence
+contiguity (the audited invariant) is unaffected.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from . import proto
+
+KIND_KEY = "KEY"
+KIND_TOPK = "TOPK"
+
+_NEG_INF = float("-inf")
+
+
+def ring_capacity() -> int:
+    try:
+        return max(int(os.environ.get("TPUMS_PUSH_RING", 256)), 1)
+    except ValueError:
+        return 256
+
+
+def max_subscriptions() -> int:
+    try:
+        return max(int(os.environ.get("TPUMS_PUSH_MAX_SUBS", 65536)), 1)
+    except ValueError:
+        return 65536
+
+
+def score_eps() -> float:
+    try:
+        return max(float(os.environ.get("TPUMS_PUSH_SCORE_EPS", 0.0)), 0.0)
+    except ValueError:
+        return 0.0
+
+
+class PushError(ValueError):
+    """Semantically invalid subscribe/resume/unsub (server answers E)."""
+
+
+def format_push(sub_id: str, seq: int, payload: str) -> str:
+    return f"{proto.PUSH_PREFIX}{sub_id}\t{seq}\t{payload}"
+
+
+def parse_push(text: str) -> Tuple[str, int, str]:
+    """``PUSH\\t<sub_id>\\t<seq>\\t<payload>`` -> (sub_id, seq, payload).
+    Raises ValueError on anything else — push routing is prefix-based, so
+    a frame that matched the prefix but not the shape is corruption."""
+    parts = text.split("\t", 3)
+    if len(parts) != 4 or parts[0] != "PUSH":
+        raise ValueError(f"not a push frame: {text[:40]!r}")
+    return parts[1], int(parts[2]), parts[3]
+
+
+def apply_delta(shortlist: Dict[str, float], payload: str) -> None:
+    """Fold one TOPK delta payload into a client-side shortlist dict —
+    the client half of the materialization contract (tests and the
+    rehearsal subscribers use it; a real device client would too)."""
+    for entry in payload.split(";"):
+        if not entry:
+            continue
+        if entry.startswith("-"):
+            shortlist.pop(entry[1:], None)
+        elif entry.startswith("+"):
+            item, _, score = entry[1:].rpartition(":")
+            shortlist[item] = float(score)
+        else:
+            raise ValueError(f"bad delta entry: {entry[:40]!r}")
+
+
+class _Subscription:
+    __slots__ = ("sub_id", "state", "kind", "arg", "k", "vec", "seq",
+                 "ring", "sink", "scores", "last_value", "threshold",
+                 "probe_cache")
+
+    def __init__(self, sub_id: str, state: str, kind: str, arg: str,
+                 k: int, sink):
+        self.sub_id = sub_id
+        self.state = state
+        self.kind = kind
+        self.arg = arg
+        self.k = k
+        self.vec: Optional[np.ndarray] = None  # TOPK query factors
+        self.seq = 0  # the S baseline; first delta is 1
+        self.ring: deque = deque()  # (seq, payload), contiguous
+        self.sink = sink
+        self.scores: Dict[str, float] = {}  # TOPK materialized shortlist
+        self.last_value: Optional[str] = None  # KEY last pushed value
+        # admission threshold: current k-th score; -inf while the
+        # shortlist is short of k (anything can enter)
+        self.threshold = _NEG_INF
+        # (ann identity token, probed-centroid id set) — recomputed when
+        # the index swaps in a different ANN build
+        self.probe_cache: Optional[Tuple[int, Set[int]]] = None
+
+
+class PushEngine:
+    """Materialized-subscription engine for one serving process.
+
+    Change feed: a batched table listener per state (the same hook the
+    top-k index's dirty set rides) that only ENQUEUES — it runs on the
+    writer thread under the table lock, so the O(candidates) work happens
+    on the engine's own thread.  Sinks (one per connection, owned by the
+    server) expose ``send_push(text)``, ``defer(texts)`` and ``arm()``;
+    ``arm`` is called while the subscribe/resume reply is still pending
+    so deltas can never overtake their own baseline on the wire."""
+
+    def __init__(self, tables: Dict[str, object],
+                 topk_handlers: Optional[Dict[str, object]] = None,
+                 scope: str = "local"):
+        self.tables = tables
+        self.topk_handlers = topk_handlers or {}
+        self.scope = scope
+        self.epoch = self._claim_epoch(scope)
+        self.ring_cap = ring_capacity()
+        self.max_subs = max_subscriptions()
+        self.score_eps = score_eps()
+        self._lock = threading.RLock()  # subs + indexes + processing
+        self._subs: Dict[str, _Subscription] = {}
+        self._next_n = 0
+        self._key_index: Dict[Tuple[str, str], Set[str]] = {}
+        self._member_index: Dict[Tuple[str, str], Set[str]] = {}
+        self._topk_subs: Dict[str, Set[str]] = {}  # state -> sub ids
+        # dirty feed: tiny dedicated lock — the listener runs under the
+        # TABLE lock, and the worker holds self._lock while reading
+        # tables, so routing the feed through self._lock would deadlock
+        self._dirty_lock = threading.Lock()
+        self._dirty_cond = threading.Condition(self._dirty_lock)
+        self._pending: List[Tuple[str, tuple, float]] = []
+        self._has_subs = False  # lock-free fast path for the listener
+        self._listened: Set[str] = set()
+        self._closed = False
+        # plain counters tests/bench read directly (the metric series
+        # below are the fleet-facing copies)
+        self.deltas = 0
+        self.rescored = 0
+        self.batches = 0
+        self.candidates = 0
+        self.candidate_total = 0  # sum of per-batch TOPK populations
+        reg = obs_metrics.get_registry()
+        self._obs_ring_evictions = reg.counter(
+            "tpums_push_ring_evictions_total")
+        self._obs_resume = {
+            "replay": reg.counter("tpums_push_resume_total",
+                                  result="replay"),
+            "snapshot": reg.counter("tpums_push_resume_total",
+                                    result="snapshot"),
+        }
+        self._obs_deltas: Dict[Tuple[str, str], object] = {}
+        self._obs_latency: Dict[str, object] = {}
+        self._obs_subs: Dict[Tuple[str, str], object] = {}
+        self._obs_rescored: Dict[str, object] = {}
+        self._obs_selectivity: Dict[str, object] = {}
+        self._thread = threading.Thread(
+            target=self._run, name="push-engine", daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _claim_epoch(scope: str) -> int:
+        try:
+            from . import registry as _registry
+
+            return _registry.next_push_epoch(scope)
+        except Exception:
+            # registry unreachable (read-only disk, lock timeout): fall
+            # back to a time-derived epoch — still fresh across restarts
+            # with overwhelming probability, and the audit treats an id
+            # collision as duplicates, i.e. LOUD, not silent
+            return int(time.time() * 1000) % (1 << 31) + os.getpid()
+
+    # ------------------------------------------------------------------
+    # change feed
+    # ------------------------------------------------------------------
+
+    def watch_table(self, state: str) -> None:
+        """Attach the dirty listener to a state's table (idempotent).
+        Registering a listener forces the consumer's Python ingest path,
+        exactly like the top-k index's dirty set does — which is why the
+        server only builds an engine on the FIRST subscribe."""
+        with self._lock:
+            if state in self._listened:
+                return
+            table = self.tables.get(state)
+            if table is None or not hasattr(table, "add_change_listener"):
+                raise PushError(f"unknown state: {state}")
+            self._listened.add(state)
+        table.add_change_listener(
+            lambda key, _s=state: self._notify(_s, (key,)),
+            batch_fn=lambda keys, _s=state: self._notify(_s, tuple(keys)))
+
+    def _notify(self, state: str, keys: tuple) -> None:
+        """Writer-thread hook: enqueue only (the table lock is held)."""
+        if not self._has_subs or self._closed or not keys:
+            return
+        now = time.perf_counter()
+        with self._dirty_cond:
+            self._pending.append((state, keys, now))
+            self._dirty_cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._dirty_cond:
+                while not self._pending and not self._closed:
+                    self._dirty_cond.wait(timeout=1.0)
+                if self._closed:
+                    return
+                batch, self._pending = self._pending, []
+            # merge per state; the earliest enqueue stamps the batch (the
+            # push-latency histogram measures worst-case update->push)
+            merged: Dict[str, Tuple[Set[str], float]] = {}
+            for state, keys, t0 in batch:
+                keyset, first = merged.get(state, (set(), t0))
+                keyset.update(keys)
+                merged[state] = (keyset, min(first, t0))
+            for state, (keys, t0) in merged.items():
+                try:
+                    self._process_state(state, keys, t0)
+                except Exception:
+                    # a poisoned batch must not kill the delivery thread;
+                    # affected subs simply see no delta (their shortlist
+                    # catches up on the next batch that touches them)
+                    continue
+
+    # ------------------------------------------------------------------
+    # dirty-batch processing
+    # ------------------------------------------------------------------
+
+    def _process_state(self, state: str, keys: Set[str], t0: float) -> None:
+        table = self.tables.get(state)
+        if table is None:
+            return
+        dead: List[str] = []
+        with self._lock:
+            self.batches += 1
+            for key in keys:
+                for sid in tuple(self._key_index.get((state, key), ())):
+                    sub = self._subs.get(sid)
+                    if sub is None:
+                        continue
+                    value = table.get(key)
+                    if value is None or value == sub.last_value:
+                        continue
+                    sub.last_value = value
+                    self._emit_locked(sub, value, t0, dead)
+            if self._topk_subs.get(state):
+                self._process_topk_locked(state, keys, table, t0, dead)
+            for sid in dead:
+                self._remove_locked(sid)
+
+    def _process_topk_locked(self, state: str, keys: Set[str], table,
+                             t0: float, dead: List[str]) -> None:
+        handler = self.topk_handlers.get(state)
+        index = getattr(handler, "index", None)
+        if index is None:
+            return
+        suffix = getattr(index, "suffix", "-I")
+        items = [k[:-len(suffix)] for k in keys
+                 if k.endswith(suffix) and not k.startswith("MEAN")]
+        if not items:
+            return
+        sub_ids = self._topk_subs.get(state, ())
+        subs = [self._subs[sid] for sid in sub_ids if sid in self._subs]
+        total = len(subs)
+        if not total:
+            return
+        cand: Set[str] = set()
+        for item in items:
+            cand.update(self._member_index.get((state, item), ()))
+        self._entrant_candidates_locked(state, items, table, index,
+                                        [s for s in subs
+                                         if s.sub_id not in cand], cand)
+        self.candidates += len(cand)
+        self.candidate_total += total
+        self._selectivity_gauge(state).set(len(cand) / total)
+        if not cand:
+            return
+        by_k: Dict[int, List[_Subscription]] = {}
+        for sid in cand:
+            sub = self._subs.get(sid)
+            if sub is not None:
+                by_k.setdefault(sub.k, []).append(sub)
+        for k, group in by_k.items():
+            try:
+                results = index.topk_many(
+                    np.stack([s.vec for s in group]), k)
+            except Exception:
+                continue  # width-mismatch mid-rebuild: next batch catches up
+            self.rescored += len(group)
+            self._rescored_counter(state).inc(len(group))
+            for sub, res in zip(group, results):
+                self._diff_and_emit_locked(sub, res, t0, dead)
+
+    def _entrant_candidates_locked(self, state: str, items: List[str],
+                                   table, index,
+                                   subs: List[_Subscription],
+                                   cand: Set[str]) -> None:
+        """Add subs a dirty row could ENTER: one stacked matmul against
+        each sub's admission threshold, optionally narrowed by the ANN
+        tier's probed-centroid sets."""
+        if not subs:
+            return
+        suffix = getattr(index, "suffix", "-I")
+        vecs, kept_items = [], []
+        for item in items:
+            payload = table.get(f"{item}{suffix}")
+            if payload is None:
+                continue
+            try:
+                vec = np.array([t for t in payload.split(";") if t],
+                               dtype=np.float32)
+            except ValueError:
+                continue
+            vecs.append(vec)
+            kept_items.append(item)
+        if not vecs:
+            return
+        width = len(vecs[0])
+        if any(len(v) != width for v in vecs):
+            # mixed widths mid-republish: be conservative, take everyone
+            cand.update(s.sub_id for s in subs)
+            return
+        v_mat = np.stack(vecs)  # (n_dirty, d)
+        subs = [s for s in subs
+                if s.vec is not None and s.vec.shape[0] == width]
+        if not subs:
+            return
+        q_mat = np.stack([s.vec for s in subs])  # (n_subs, d)
+        scores = q_mat @ v_mat.T  # (n_subs, n_dirty)
+        ann = getattr(index, "_ann", None)
+        if ann is not None:
+            allowed = self._ann_mask(ann, subs, v_mat)
+            if allowed is not None:
+                scores = np.where(allowed, scores, _NEG_INF)
+        thresholds = np.array([s.threshold for s in subs],
+                              dtype=np.float64)
+        hits = (scores >= thresholds[:, None]).any(axis=1)
+        for sub, hit in zip(subs, hits):
+            if hit:
+                cand.add(sub.sub_id)
+
+    @staticmethod
+    def _ann_mask(ann, subs: List[_Subscription],
+                  v_mat: np.ndarray) -> Optional[np.ndarray]:
+        """(n_subs, n_dirty) bool: dirty row j's centroid is in sub i's
+        probed set.  Exact w.r.t. ANN-served results: an item outside the
+        probed lists cannot appear in that sub's top-k, so filtering it
+        out of the entrant check loses nothing the query could return."""
+        try:
+            cents = np.asarray(ann.centroids, dtype=np.float32)
+            nprobe = int(getattr(ann, "nprobe", 1))
+            if cents.ndim != 2 or cents.shape[1] != v_mat.shape[1]:
+                return None
+            # IVF assigns rows to centroids by L2, probes by inner
+            # product (serve/ann.py) — mirror both exactly
+            cnorm = np.sum(cents * cents, axis=1)
+            assign = np.argmin(cnorm[None, :] - 2.0 * (v_mat @ cents.T),
+                               axis=1)  # (n_dirty,)
+            token = id(ann)
+            allowed = np.zeros((len(subs), v_mat.shape[0]), dtype=bool)
+            for i, sub in enumerate(subs):
+                cache = sub.probe_cache
+                if cache is None or cache[0] != token:
+                    ip = sub.vec @ cents.T
+                    n = min(nprobe, ip.shape[0])
+                    probed = set(
+                        np.argpartition(-ip, n - 1)[:n].tolist())
+                    sub.probe_cache = (token, probed)
+                    cache = sub.probe_cache
+                probed = cache[1]
+                for j, c in enumerate(assign):
+                    if int(c) in probed:
+                        allowed[i, j] = True
+            return allowed
+        except Exception:
+            return None  # narrowing is an optimization, never a gate
+
+    def _diff_and_emit_locked(self, sub: _Subscription, res, t0: float,
+                              dead: List[str]) -> None:
+        new = {item: float(score) for item, score in res}
+        old = sub.scores
+        eps = self.score_eps
+        ups = [f"+{item}:{score}" for item, score in res
+               if item not in old or (abs(old[item] - float(score)) > eps
+                                      if eps else old[item] != float(score))]
+        downs = [f"-{item}" for item in old if item not in new]
+        state = sub.state
+        for item in new:
+            if item not in old:
+                self._member_index.setdefault(
+                    (state, item), set()).add(sub.sub_id)
+        for item in old:
+            if item not in new:
+                members = self._member_index.get((state, item))
+                if members is not None:
+                    members.discard(sub.sub_id)
+                    if not members:
+                        del self._member_index[(state, item)]
+        sub.scores = new
+        sub.threshold = (min(new.values())
+                         if len(new) >= sub.k and new else _NEG_INF)
+        if not ups and not downs:
+            return
+        self._emit_locked(sub, ";".join(ups + downs), t0, dead)
+
+    def _emit_locked(self, sub: _Subscription, payload: str, t0: float,
+                     dead: List[str]) -> None:
+        sub.seq += 1
+        sub.ring.append((sub.seq, payload))
+        while len(sub.ring) > self.ring_cap:
+            sub.ring.popleft()
+            self._obs_ring_evictions.inc()
+        self.deltas += 1
+        self._delta_counter(sub.state, sub.kind).inc()
+        self._latency_hist(sub.state).observe(time.perf_counter() - t0)
+        try:
+            sub.sink.send_push(format_push(sub.sub_id, sub.seq, payload))
+        except Exception:
+            dead.append(sub.sub_id)
+
+    # ------------------------------------------------------------------
+    # verbs
+    # ------------------------------------------------------------------
+
+    def subscribe(self, state: str, kind: str, arg: str, k: int,
+                  sink) -> Tuple[str, int, str]:
+        """-> (sub_id, baseline_seq, snapshot).  Raises PushError on
+        anything the server should answer with an E line."""
+        self.watch_table(state)
+        with self._lock:
+            return self._subscribe_locked(state, kind, arg, k, sink)
+
+    def _subscribe_locked(self, state: str, kind: str, arg: str, k: int,
+                          sink) -> Tuple[str, int, str]:
+        if len(self._subs) >= self.max_subs:
+            raise PushError("too many subscriptions")
+        table = self.tables.get(state)
+        if table is None:
+            raise PushError(f"unknown state: {state}")
+        sub_id = f"{self.epoch}-{self._next_n}"
+        sub = _Subscription(sub_id, state, kind, arg, k, sink)
+        if kind == KIND_KEY:
+            sub.last_value = table.get(arg)
+            snapshot = sub.last_value or ""
+            self._key_index.setdefault((state, arg), set()).add(sub_id)
+        elif kind == KIND_TOPK:
+            handler = self.topk_handlers.get(state)
+            index = getattr(handler, "index", None)
+            if index is None:
+                raise PushError(f"no topk index for state: {state}")
+            if k < 1:
+                raise PushError("k must be >= 1")
+            try:
+                sub.vec = np.array([t for t in arg.split(";") if t],
+                                   dtype=np.float32)
+                res = index.topk(sub.vec, k)
+            except Exception as e:
+                raise PushError(f"bad topk subscription: {e}")
+            sub.scores = {item: float(score) for item, score in res}
+            sub.threshold = (min(sub.scores.values())
+                             if len(sub.scores) >= k and sub.scores
+                             else _NEG_INF)
+            snapshot = ";".join(f"{item}:{score}" for item, score in res)
+            for item in sub.scores:
+                self._member_index.setdefault(
+                    (state, item), set()).add(sub_id)
+            self._topk_subs.setdefault(state, set()).add(sub_id)
+        else:
+            raise PushError(f"bad subscription kind: {kind}")
+        # arm BEFORE the sub becomes visible: deltas raced in by the
+        # worker queue behind the pending S reply instead of overtaking it
+        sink.arm()
+        self._next_n += 1
+        self._subs[sub_id] = sub
+        self._has_subs = True
+        self._subs_gauge(state, kind).inc(1)
+        return sub_id, 0, snapshot
+
+    def resume(self, state: str, kind: str, arg: str, k: int, cursor: str,
+               sink):
+        """-> ("replay", sub_id, from_seq, None) with the missed deltas
+        deferred onto the sink, or ("snapshot", new_sub_id, 0, snapshot)
+        when the ring cannot bridge (fresh epoch — see module doc)."""
+        sub_id, sep, seq_s = cursor.rpartition(":")
+        if not sep or not sub_id:
+            raise PushError("bad resume cursor")
+        try:
+            cursor_seq = int(seq_s)
+        except ValueError:
+            raise PushError("bad resume cursor")
+        self.watch_table(state)
+        with self._lock:
+            sub = self._subs.get(sub_id)
+            if (sub is not None and sub.state == state
+                    and sub.kind == kind and sub.arg == arg
+                    and sub.k == k and cursor_seq <= sub.seq):
+                ring_lo = sub.ring[0][0] if sub.ring else sub.seq + 1
+                if cursor_seq >= ring_lo - 1:
+                    sub.sink = sink
+                    sink.arm()
+                    sink.defer([format_push(sub_id, s, p)
+                                for s, p in sub.ring if s > cursor_seq])
+                    self._obs_resume["replay"].inc()
+                    return ("replay", sub_id, cursor_seq, None)
+            # ring miss / unknown id / spec mismatch: fresh subscription
+            new_id, seq, snapshot = self._subscribe_locked(
+                state, kind, arg, k, sink)
+            self._obs_resume["snapshot"].inc()
+            return ("snapshot", new_id, seq, snapshot)
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        with self._lock:
+            if sub_id not in self._subs:
+                return False
+            self._remove_locked(sub_id)
+            return True
+
+    def drop_sink(self, sink) -> int:
+        """Remove every subscription bound to a (closed) connection."""
+        with self._lock:
+            doomed = [sid for sid, sub in self._subs.items()
+                      if sub.sink is sink]
+            for sid in doomed:
+                self._remove_locked(sid)
+            return len(doomed)
+
+    def _remove_locked(self, sub_id: str) -> None:
+        sub = self._subs.pop(sub_id, None)
+        if sub is None:
+            return
+        state = sub.state
+        if sub.kind == KIND_KEY:
+            bucket = self._key_index.get((state, sub.arg))
+            if bucket is not None:
+                bucket.discard(sub_id)
+                if not bucket:
+                    del self._key_index[(state, sub.arg)]
+        else:
+            for item in sub.scores:
+                members = self._member_index.get((state, item))
+                if members is not None:
+                    members.discard(sub_id)
+                    if not members:
+                        del self._member_index[(state, item)]
+            bucket = self._topk_subs.get(state)
+            if bucket is not None:
+                bucket.discard(sub_id)
+                if not bucket:
+                    del self._topk_subs[state]
+        self._has_subs = bool(self._subs)
+        self._subs_gauge(state, sub.kind).inc(-1)
+
+    def subscription_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def close(self) -> None:
+        with self._dirty_cond:
+            self._closed = True
+            self._dirty_cond.notify_all()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------------
+    # instruments (lazy per-label caches, obs/metrics.py contract)
+    # ------------------------------------------------------------------
+
+    def _delta_counter(self, state: str, kind: str):
+        c = self._obs_deltas.get((state, kind))
+        if c is None:
+            c = obs_metrics.get_registry().counter(
+                "tpums_push_deltas_total", state=state, kind=kind)
+            self._obs_deltas[(state, kind)] = c
+        return c
+
+    def _latency_hist(self, state: str):
+        h = self._obs_latency.get(state)
+        if h is None:
+            h = obs_metrics.get_registry().histogram(
+                "tpums_push_latency_seconds", state=state)
+            self._obs_latency[state] = h
+        return h
+
+    def _subs_gauge(self, state: str, kind: str):
+        g = self._obs_subs.get((state, kind))
+        if g is None:
+            g = obs_metrics.get_registry().gauge(
+                "tpums_push_subs_active", state=state, kind=kind)
+            self._obs_subs[(state, kind)] = g
+        return g
+
+    def _rescored_counter(self, state: str):
+        c = self._obs_rescored.get(state)
+        if c is None:
+            c = obs_metrics.get_registry().counter(
+                "tpums_push_rescored_total", state=state)
+            self._obs_rescored[state] = c
+        return c
+
+    def _selectivity_gauge(self, state: str):
+        g = self._obs_selectivity.get(state)
+        if g is None:
+            g = obs_metrics.get_registry().gauge(
+                "tpums_push_selectivity", state=state)
+            self._obs_selectivity[state] = g
+        return g
+
+
+# ---------------------------------------------------------------------------
+# delivery audit (the PR-9 tiling idea applied to subscription streams)
+# ---------------------------------------------------------------------------
+
+def audit_push_sequences(events: Sequence[Tuple[str, str, int]],
+                         tiles: int = 8) -> dict:
+    """Zero-miss/zero-dup audit over client-observed push streams.
+
+    ``events`` is every subscription-bearing frame a client (or many
+    clients, concatenated) received, in arrival order per subscription:
+    ``("S", sub_id, seq)`` for a snapshot baseline (SUBSCRIBE reply or a
+    RESUME snapshot fallback — a fresh id starts a fresh stream),
+    ``("S", sub_id, from_seq)`` for a RESUME replay acknowledgment (the
+    R line: the stream resumes AFTER from_seq), and ``("P", sub_id,
+    seq)`` for every delta.  Per subscription the P sequence must be
+    strictly contiguous from its latest baseline: a hole counts into
+    ``missed``, a repeat into ``duplicates``.
+
+    Like ``update_plane.audit_partitions``, results are tiled —
+    subscriptions hash into ``tiles`` buckets so a localized failure
+    (one replica's sequence space) shows up as hot tiles rather than a
+    fleet-wide smear."""
+    if tiles < 1:
+        raise ValueError("tiles must be >= 1")
+    tile_stats = [{"subs": 0, "delivered": 0, "missed": 0,
+                   "duplicates": 0} for _ in range(tiles)]
+    expected: Dict[str, int] = {}
+    for kind, sub_id, seq in events:
+        t = zlib.crc32(sub_id.encode("utf-8")) % tiles
+        if sub_id not in expected:
+            tile_stats[t]["subs"] += 1
+        if kind == "S":
+            expected[sub_id] = int(seq) + 1
+            continue
+        if kind != "P":
+            raise ValueError(f"bad audit event kind: {kind!r}")
+        seq = int(seq)
+        exp = expected.get(sub_id)
+        if exp is None:
+            # a delta with no baseline: everything before it is missing
+            tile_stats[t]["missed"] += max(seq - 1, 0)
+            tile_stats[t]["delivered"] += 1
+            expected[sub_id] = seq + 1
+        elif seq == exp:
+            tile_stats[t]["delivered"] += 1
+            expected[sub_id] = seq + 1
+        elif seq > exp:
+            tile_stats[t]["missed"] += seq - exp
+            tile_stats[t]["delivered"] += 1
+            expected[sub_id] = seq + 1
+        else:
+            tile_stats[t]["duplicates"] += 1
+    out = {"subs": sum(ts["subs"] for ts in tile_stats),
+           "delivered": sum(ts["delivered"] for ts in tile_stats),
+           "missed": sum(ts["missed"] for ts in tile_stats),
+           "duplicates": sum(ts["duplicates"] for ts in tile_stats),
+           "tiles": tile_stats}
+    return out

@@ -703,6 +703,40 @@ def drop_topology(group: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# push-plane subscription epochs (serve/push.py)
+# ---------------------------------------------------------------------------
+
+def _push_epoch_path(scope: str) -> str:
+    return _group_path(f"pushes/{scope}", "push.json")
+
+
+def next_push_epoch(scope: str) -> int:
+    """Atomically claim the scope's next subscription epoch -> int >= 1.
+
+    Every push engine (one per serving process that ever accepts a
+    SUBSCRIBE) claims one epoch at startup and mints subscription ids as
+    ``<epoch>-<n>``, so ids stay globally unique across replica restarts,
+    reshards and failovers — the property the zero-miss/zero-dup sequence
+    audit leans on: a RESUME that lands on a replica which never saw the
+    subscription can only answer with a FRESH id + snapshot, never reuse
+    the old id with a colliding sequence space.  Same read-modify-write
+    discipline as ``publish_topology`` (group lock + tmp + rename)."""
+    os.makedirs(os.path.dirname(_push_epoch_path(scope)) or ".",
+                exist_ok=True)
+    path = _push_epoch_path(scope)
+    with _GroupLock(path):
+        current = _read_record(path, "push_epoch")
+        epoch = (int(current["epoch"]) if current else 0) + 1
+        record = {"kind": "push_epoch", "scope": scope, "epoch": epoch,
+                  "claimed_at": time.time(), "pid": os.getpid()}
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+    return epoch
+
+
+# ---------------------------------------------------------------------------
 # snapshot manifests (serve/snapshot.py publishes, fleet scrape reads)
 # ---------------------------------------------------------------------------
 

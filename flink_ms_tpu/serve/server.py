@@ -115,7 +115,63 @@ from ..obs import profiler as obs_profiler
 from ..obs import tracing as obs_tracing
 from . import admission as admission_ctl
 from . import proto
+from . import push as push_plane
 from .table import ModelTable
+
+
+class _ConnPushSink:
+    """Per-connection ordered write gate shared by the reply writer and
+    the push engine (serve/push.py).
+
+    Replies and unsolicited PUSH frames leave through ONE lock, so engine
+    writes never interleave bytes with a reply write.  ``arm()`` (called
+    by the engine while a subscribe/resume reply is still pending) flips
+    pushes into a deferred buffer that ``write_reply`` flushes right
+    after the reply bytes — a delta can therefore never overtake its own
+    S/R baseline on the wire.  Pull-only connections pay one uncontended
+    lock acquisition per reply burst and write byte-identical output."""
+
+    __slots__ = ("_wfile", "_binary", "_lock", "_deferred", "used")
+
+    def __init__(self, wfile, binary: bool):
+        self._wfile = wfile
+        self._binary = binary
+        self._lock = threading.Lock()
+        self._deferred = None
+        self.used = False  # a push verb bound subscriptions to this conn
+
+    def arm(self) -> None:
+        with self._lock:
+            if self._deferred is None:
+                self._deferred = []
+
+    def defer(self, texts) -> None:
+        with self._lock:
+            if self._deferred is None:
+                self._deferred = []
+            self._deferred.extend(texts)
+
+    def send_push(self, text: str) -> None:
+        with self._lock:
+            if self._deferred is not None:
+                self._deferred.append(text)
+                return
+            self._write(text)
+
+    def _write(self, text: str) -> None:
+        if self._binary:
+            self._wfile.write(proto.encode_reply_frame([text]))
+        else:
+            self._wfile.write((text + "\n").encode("utf-8"))
+
+    def write_reply(self, data: bytes) -> None:
+        """Reply bytes, then any deferred pushes, one critical section."""
+        with self._lock:
+            self._wfile.write(data)
+            deferred, self._deferred = self._deferred, None
+            if deferred:
+                for text in deferred:
+                    self._write(text)
 
 
 class _DeferredReply:
@@ -197,6 +253,12 @@ class LookupServer:
         self._conns: set = set()
         self._conn_threads: set = set()
         self._conn_lock = threading.Lock()
+        # push plane (serve/push.py): built lazily on the FIRST subscribe
+        # — constructing the engine registers table change listeners,
+        # which forces the consumer's Python ingest path (same trade the
+        # top-k dirty set makes), so pull-only deployments never pay it
+        self._push_engine: Optional[push_plane.PushEngine] = None
+        self._push_create_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -227,6 +289,12 @@ class LookupServer:
                 conn_tenant = None
                 conn_trace = False
                 conn_stale = False  # ``st=1``: staleness on every reply
+                conn_push = False   # ``su=1``: B2 push frames accepted
+                # one ordered write gate per connection: replies and any
+                # engine pushes share it (tab SUBSCRIBE is its own opt-in
+                # — sending the verb marks the connection push-capable,
+                # so the tab sink is always offered to dispatch)
+                sink = _ConnPushSink(self.wfile, binary=False)
                 try:
                     while True:
                         # block for at least one complete line (or EOF)
@@ -285,6 +353,7 @@ class LookupServer:
                                     conn_tenant = ext["tenant"] or None
                                     conn_trace = ext["trace"]
                                     conn_stale = ext.get("stale", False)
+                                    conn_push = ext.get("push", False)
                                     hello = True
                                     break
                         if eof and buf and not hello:
@@ -304,7 +373,8 @@ class LookupServer:
                             outer._obs_burst.observe(len(lines))
                         # submit ALL, then resolve in order
                         replies = [
-                            outer._dispatch_async(ln, burst=len(lines))
+                            outer._dispatch_async(ln, burst=len(lines),
+                                                  push_sink=sink)
                             for ln in lines
                         ]
                         if len(lines) > 1:
@@ -319,18 +389,20 @@ class LookupServer:
                             for r in replies
                         )
                         try:
-                            self.wfile.write(out)
+                            sink.write_reply(out)
                         except (BrokenPipeError, OSError):
                             return
                         if hello:
                             outer._serve_binary(sock, self.wfile, buf, eof,
                                                 tenant=conn_tenant,
                                                 trace=conn_trace,
-                                                stale=conn_stale)
+                                                stale=conn_stale,
+                                                push=conn_push)
                             return
                         if eof:
                             return
                 finally:
+                    outer._drop_push_sink(sink)
                     with outer._conn_lock:
                         outer._conns.discard(self.connection)
                         outer._conn_threads.discard(
@@ -429,7 +501,7 @@ class LookupServer:
                 except Exception:
                     pass
 
-    def _dispatch_async(self, line: str, burst: int = 1):
+    def _dispatch_async(self, line: str, burst: int = 1, push_sink=None):
         """-> reply str, or a _DeferredReply for TOPK/TOPKV riding the
         microbatcher (the handler loop submits a whole pipelined burst
         before resolving any, so the burst shares a device dispatch).
@@ -437,11 +509,13 @@ class LookupServer:
         belongs to — burst members must enqueue rather than take the
         batcher's idle inline path, or the burst serializes back into
         singles."""
-        return self._dispatch_parts(line.split("\t"), burst)
+        return self._dispatch_parts(line.split("\t"), burst,
+                                    push_sink=push_sink)
 
     def _dispatch_parts(self, parts, burst: int = 1, traced: bool = True,
                         tenant: Optional[str] = None,
-                        echo_tid: bool = True, stale: bool = False):
+                        echo_tid: bool = True, stale: bool = False,
+                        push_sink=None):
         """Dispatch over already-split fields — the shared core of the tab
         line loop and the B2 frame loop (binary records arrive pre-split,
         and their fields may legally contain tabs, so they must never take
@@ -497,7 +571,7 @@ class LookupServer:
         # push/pop the stage) — no per-dispatch stage mark here; even a
         # gated push/pop pair costs ~0.7us, past the 3% hot-path bar.
         # Untraced requests fold under the "-" stage by design.
-        reply = self._handle(parts, burst)
+        reply = self._handle(parts, burst, push_sink)
         if isinstance(reply, _DeferredReply):
             reply.post = lambda rendered, resolver: self._finish(
                 verb, tid, t0, rendered, resolver, echo=echo_tid,
@@ -508,7 +582,8 @@ class LookupServer:
 
     def _serve_binary(self, sock, wfile, buf: bytearray, eof: bool,
                       tenant: Optional[str] = None,
-                      trace: bool = False, stale: bool = False) -> None:
+                      trace: bool = False, stale: bool = False,
+                      push: bool = False) -> None:
         """B2 frame loop, entered after an accepted HELLO (``serve.proto``).
 
         One request frame in -> one reply frame out, records answered in
@@ -518,52 +593,64 @@ class LookupServer:
         corruption answers a single-record ``E\\tbad frame: <reason>``
         frame and closes; a partial frame at EOF is dropped silently (the
         tab plane's unterminated-line parity does not apply — a frame is
-        atomic or absent)."""
-        while True:
-            try:
-                res = proto.decode_request_frame(buf, trace=trace)
-            except proto.ProtoError as e:
+        atomic or absent).
+
+        ``push`` (the HELLO's ``su=1``) arms the connection for the push
+        plane: subscribe verbs get a sink, and engine deltas ride the
+        same write gate as replies (single-record ``PUSH`` frames between
+        reply frames).  Without it the subscribe verbs answer the generic
+        ``E\\tbad request`` and the wire stays byte-identical."""
+        sink = _ConnPushSink(wfile, binary=True)
+        try:
+            while True:
                 try:
-                    wfile.write(proto.error_frame(str(e)))
-                except (BrokenPipeError, OSError):
-                    pass
-                return
-            if res is None:
-                if eof:
+                    res = proto.decode_request_frame(buf, trace=trace)
+                except proto.ProtoError as e:
+                    try:
+                        wfile.write(proto.error_frame(str(e)))
+                    except (BrokenPipeError, OSError):
+                        pass
                     return
-                try:
-                    chunk = sock.recv(65536)
-                except (ConnectionResetError, OSError):
-                    return
-                if not chunk:
-                    eof = True
+                if res is None:
+                    if eof:
+                        return
+                    try:
+                        chunk = sock.recv(65536)
+                    except (ConnectionResetError, OSError):
+                        return
+                    if not chunk:
+                        eof = True
+                        continue
+                    buf += chunk
                     continue
-                buf += chunk
-                continue
-            records, consumed = res
-            del buf[:consumed]
-            if len(records) > 1:
-                self._obs_burst.observe(len(records))
-            replies = [
-                # tr=1 records surface their tid as the standard trailing
-                # field (decoder contract), so ``traced=trace`` reuses the
-                # tab plane's pop/span path — but B2 replies are never
-                # tid-suffixed (the client keeps its own request order)
-                self._dispatch_parts(parts, burst=len(records),
-                                     traced=trace, tenant=tenant,
-                                     echo_tid=False, stale=stale)
-                for parts in records
-            ]
-            if len(records) > 1:
-                self._flush_batchers()
-            texts = [
-                r.resolve() if isinstance(r, _DeferredReply) else r
-                for r in replies
-            ]
-            try:
-                wfile.write(proto.encode_reply_frame(texts))
-            except (BrokenPipeError, OSError):
-                return
+                records, consumed = res
+                del buf[:consumed]
+                if len(records) > 1:
+                    self._obs_burst.observe(len(records))
+                replies = [
+                    # tr=1 records surface their tid as the standard
+                    # trailing field (decoder contract), so
+                    # ``traced=trace`` reuses the tab plane's pop/span
+                    # path — but B2 replies are never tid-suffixed (the
+                    # client keeps its own request order)
+                    self._dispatch_parts(parts, burst=len(records),
+                                         traced=trace, tenant=tenant,
+                                         echo_tid=False, stale=stale,
+                                         push_sink=sink if push else None)
+                    for parts in records
+                ]
+                if len(records) > 1:
+                    self._flush_batchers()
+                texts = [
+                    r.resolve() if isinstance(r, _DeferredReply) else r
+                    for r in replies
+                ]
+                try:
+                    sink.write_reply(proto.encode_reply_frame(texts))
+                except (BrokenPipeError, OSError):
+                    return
+        finally:
+            self._drop_push_sink(sink)
 
     def _verb_obs(self, verb: str) -> tuple:
         inst = self._obs_verbs.get(verb)
@@ -693,7 +780,30 @@ class LookupServer:
         except Exception as e:
             return f"E\tprofile failed: {e}"
 
-    def _handle(self, parts, burst: int = 1):
+    def _push(self) -> push_plane.PushEngine:
+        """The lazily-built push engine (serve/push.py).  First call —
+        the first SUBSCRIBE this process ever serves — registers table
+        change listeners; see the constructor comment for why that is
+        deferred until someone actually subscribes."""
+        eng = self._push_engine
+        if eng is None:
+            with self._push_create_lock:
+                eng = self._push_engine
+                if eng is None:
+                    eng = push_plane.PushEngine(
+                        self.tables, self.topk_handlers, scope=self.job_id)
+                    self._push_engine = eng
+        return eng
+
+    def _drop_push_sink(self, sink) -> None:
+        """Connection epilogue: drop every subscription bound to it."""
+        if sink is None or not sink.used:
+            return
+        eng = self._push_engine
+        if eng is not None:
+            eng.drop_sink(sink)
+
+    def _handle(self, parts, burst: int = 1, push_sink=None):
         """Verb dispatch over already-split fields (tid removed)."""
         if parts[0] == "PING":
             return f"PONG\t{self.job_id}\t{','.join(self.tables)}"
@@ -853,6 +963,43 @@ class LookupServer:
             except Exception as e:
                 return f"E\ttopk failed: {e}"
             return "N" if payload is None else f"V\t{payload}"
+        if parts[0] in ("SUBSCRIBE", "RESUME") and \
+                len(parts) == (5 if parts[0] == "SUBSCRIBE" else 6):
+            # push plane (serve/push.py).  ``push_sink`` is the opt-in
+            # gate: on B2 it exists only after a ``su=1`` HELLO; on tab
+            # the verb itself is the opt-in, so the sink is always
+            # offered.  Without a sink the verbs answer the generic bad
+            # request — byte-identical to a server without a push plane.
+            if push_sink is None:
+                return "E\tbad request"
+            _, state, kind, arg, k_s = parts[:5]
+            try:
+                k = int(k_s)
+            except ValueError:
+                return "E\tbad request"
+            try:
+                eng = self._push()
+                push_sink.used = True
+                if parts[0] == "SUBSCRIBE":
+                    sub_id, seq, snapshot = eng.subscribe(
+                        state, kind, arg, k, push_sink)
+                    return f"S\t{sub_id}\t{seq}\t{snapshot}"
+                mode, sub_id, seq, snapshot = eng.resume(
+                    state, kind, arg, k, parts[5], push_sink)
+                if mode == "replay":
+                    return f"R\t{sub_id}\t{seq}"
+                return f"S\t{sub_id}\t{seq}\t{snapshot}"
+            except push_plane.PushError as e:
+                return f"E\t{e}"
+            except Exception as e:
+                return f"E\tsubscribe failed: {e}"
+        if parts[0] == "UNSUB" and len(parts) == 2:
+            if push_sink is None:
+                return "E\tbad request"
+            eng = self._push_engine
+            if eng is not None and eng.unsubscribe(parts[1]):
+                return f"U\t{parts[1]}"
+            return f"E\tunknown subscription: {parts[1]}"
         return "E\tbad request"
 
     def start(self) -> "LookupServer":
@@ -883,6 +1030,13 @@ class LookupServer:
                 pass
         for t in threads:
             t.join(timeout=5)
+        # stop the push-delivery thread (after the handler quiesce: a
+        # handler mid-SUBSCRIBE must not race the engine teardown)
+        if self._push_engine is not None:
+            try:
+                self._push_engine.close()
+            except Exception:
+                pass
         # stop the top-k microbatcher dispatchers (drains their queues
         # first, so no late in-flight query parks forever); handlers
         # without a close() — plain callables in tests — are fine as-is

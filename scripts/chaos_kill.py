@@ -70,7 +70,19 @@ Knobs (env):
                            hedged requests mask the stalled replica,
                            the mark-down/retry path absorbs its death,
                            clients rotate to the surviving proxy, and
-                           no client ever sees an error)
+                           no client ever sees an error),
+                           or "push" (SIGKILL a subscribed-to replica and
+                           an edge proxy mid-update-storm while push
+                           subscribers hold live KEY/TOPK subscriptions
+                           through the proxy tier: the client-observed
+                           sequence audit must show zero missed and zero
+                           duplicate notifications across both kills —
+                           hub resync bridges the replica death, RESUME
+                           against the survivor bridges the proxy death —
+                           every KEY subscriber's push-built value
+                           converges to the pulled truth, and concurrent
+                           pull traffic holds availability 1.0;
+                           CHAOS_PUSH_SUBS=6 sets the subscriber count)
     CHAOS_ROWS=20000       seeded journal length (snapshot mode — long
                            history over few keys so the fold has work)
     CHAOS_UPDATE_BATCH=200 ratings per producer tick (update mode)
@@ -1776,6 +1788,324 @@ def edge_main() -> int:
         ctl.stop(drop_topology=True)
 
 
+def push_main() -> int:
+    """SIGKILL a subscribed-to replica AND an edge proxy mid-update-storm
+    while push subscribers hold live KEY/TOPK subscriptions through the
+    proxy tier (serve/push.py + the edge push hub).  The storm rewrites
+    the hot item factors through the journal — the same ingest path the
+    SGD update plane uses — so every write fans out as KEY deltas and
+    TOPK shortlist deltas.  Contracts under test: the client-observed
+    sequence audit (``push.audit_push_sequences``) shows ZERO missed and
+    ZERO duplicate notifications across both kills (the replica death is
+    bridged by the hub's resync catch-up delta on the same sub ids; the
+    proxy death by RESUME against the survivor — replay or a fresh-id
+    snapshot, never a silent gap); every KEY subscriber's push-built
+    value converges to the pulled truth after the storm quiesces; and
+    concurrent pull traffic holds availability 1.0 throughout."""
+    from flink_ms_tpu.serve.edge import (
+        EdgeClient, spawn_edge_procs, stop_edge_procs,
+    )
+    from flink_ms_tpu.serve.elastic import ScaleController
+    from flink_ms_tpu.serve.push import apply_delta, audit_push_sequences
+
+    base = tempfile.mkdtemp(prefix="tpums_chaos_push_")
+    os.environ.setdefault(
+        "TPUMS_REGISTRY_DIR", tempfile.mkdtemp(prefix="tpums_chaos_reg_"))
+    journal, keys = seed_journal(base)
+    replication = max(R, 2)  # the resync needs a sibling to land on
+    n_subs = int(os.environ.get("CHAOS_PUSH_SUBS", 6))
+    hot = [f"{i}-I" for i in range(8)]  # the storm's targets
+
+    ctl = ScaleController("chaos-push", journal.dir, "models",
+                          port_dir=os.path.join(base, "ports"),
+                          ready_timeout_s=180)
+    event("chaos_push_start", workers=W, replication=replication,
+          proxies=2, subscribers=n_subs)
+
+    stop = threading.Event()        # storm + pull load
+    drain_stop = threading.Event()  # subscribers (set AFTER the quiesce)
+    ok = [0] * 2
+    errs = [0] * 2
+    err_sample = []
+    audit_events = []  # ("S"|"P", sub_id, seq) in per-sub arrival order
+    audit_lock = threading.Lock()
+    sub_state = [{"key": None, "value": None, "shortlist": None,
+                  "pushes": 0, "resumes": 0, "reconnects": 0, "up": False}
+                 for _ in range(n_subs)]
+    storm = {"writes": 0}
+    eps = []  # filled once the proxies are up
+
+    def storm_loop():
+        rng = np.random.default_rng(1)
+        while not stop.is_set():
+            journal.append([F.format_als_row(i, "I", rng.normal(size=4))
+                            for i in range(len(hot))])
+            storm["writes"] += len(hot)
+            time.sleep(0.05)
+
+    def pull_load(widx):
+        c = EdgeClient(endpoints=eps, prefer=widx,
+                       proto=("b2" if widx % 2 else "tab"),
+                       retry=RetryPolicy(attempts=8, backoff_s=0.02,
+                                         max_backoff_s=0.5),
+                       timeout_s=10)
+        r = random.Random(widx)
+        with c:
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                try:
+                    good = c.query_state(ALS_STATE, key) is not None
+                except Exception as e:
+                    good = False
+                    if len(err_sample) < 8:
+                        err_sample.append((key, repr(e)))
+                (ok if good else errs)[widx] += 1
+
+    def subscriber(idx):
+        st = sub_state[idx]
+        topk_sub = (idx == 0)  # one shortlist sub exercises the merged
+        key = hot[idx % len(hot)]  # plane; the rest are KEY subs
+        if not topk_sub:
+            st["key"] = key
+        sub = None
+        c = None
+        backoff = 0
+        while not drain_stop.is_set():
+            try:
+                if c is None:
+                    c = EdgeClient(endpoints=eps, prefer=idx + backoff,
+                                   proto="b2", push=True, timeout_s=10)
+                    if sub is None:
+                        if topk_sub:
+                            sub = c.subscribe_topk(
+                                ALS_STATE, "1.0;2.0;0.5;-1.0", TOPK_K)
+                            st["shortlist"] = {}
+                            apply_delta(st["shortlist"], "".join(
+                                f"+{e};" for e in
+                                sub["snapshot"].split(";") if e))
+                        else:
+                            sub = c.subscribe_key(ALS_STATE, key)
+                            st["value"] = sub["snapshot"]
+                        with audit_lock:
+                            audit_events.append(
+                                ("S", sub["sub_id"], sub["seq"]))
+                    else:
+                        st["resumes"] += 1
+                        r = c.resume_subscription(
+                            ALS_STATE, "TOPK" if topk_sub else "KEY",
+                            "1.0;2.0;0.5;-1.0" if topk_sub else key,
+                            TOPK_K if topk_sub else 0,
+                            sub["sub_id"], sub["seq"])
+                        with audit_lock:
+                            audit_events.append(
+                                ("S", r["sub_id"], r["seq"]))
+                        if r["mode"] == "replay":
+                            sub["seq"] = r["seq"]  # deltas follow as pushes
+                        else:  # fresh id: the snapshot IS the catch-up
+                            sub = r
+                            if topk_sub:
+                                st["shortlist"] = {}
+                                apply_delta(st["shortlist"], "".join(
+                                    f"+{e};" for e in
+                                    r["snapshot"].split(";") if e))
+                            else:
+                                st["value"] = r["snapshot"]
+                    st["up"] = True
+                    backoff = 0
+                msg = c.next_push(timeout_s=0.25)
+                if msg is None:
+                    continue
+                sub_id, seq, payload = msg
+                with audit_lock:
+                    audit_events.append(("P", sub_id, seq))
+                sub["seq"] = seq
+                st["pushes"] += 1
+                if topk_sub:
+                    apply_delta(st["shortlist"], payload)
+                else:
+                    st["value"] = payload
+            except Exception:
+                st["up"] = False
+                try:
+                    if c is not None:
+                        c.close()
+                except Exception:
+                    pass
+                c = None
+                st["reconnects"] += 1
+                backoff = min(backoff + 1, 8)
+                time.sleep(0.05 * backoff)
+        try:
+            if c is not None:
+                c.close()
+        except Exception:
+            pass
+
+    def push_counters(ports):
+        """Sum the hub's push counters across the live proxies."""
+        notif, resumes = 0, {"replay": 0, "snapshot": 0}
+        for port in ports:
+            try:
+                with EdgeClient(endpoints=[("127.0.0.1", port)],
+                                timeout_s=5) as mc:
+                    snap = mc.metrics()
+            except Exception:
+                continue
+            for cc in snap.get("counters", []):
+                if cc.get("name") == "tpums_push_notifications_total":
+                    notif += cc.get("value", 0)
+                elif cc.get("name") == "tpums_push_resume_total":
+                    res = cc.get("labels", {}).get("result")
+                    if res in resumes:
+                        resumes[res] += cc.get("value", 0)
+        return notif, resumes
+
+    def wait_recovered(sup, shard, replica, old_pid, timeout_s=60.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            members = registry.resolve_replicas(sup.group_of(shard))
+            if any(e.get("replica") == replica and e.get("ready")
+                   and e.get("pid") not in (None, old_pid)
+                   for e in members):
+                return True
+            time.sleep(0.05)
+        return False
+
+    procs = []
+    threads = []
+    try:
+        ctl.scale_to(W, replicas=replication)
+        procs, ports = spawn_edge_procs(
+            "chaos-push", 2, os.path.join(base, "edge_ports"))
+        eps.extend(("127.0.0.1", p) for p in ports)
+        threads = [threading.Thread(target=pull_load, args=(i,),
+                                    daemon=True) for i in range(2)]
+        sub_threads = [threading.Thread(target=subscriber, args=(i,),
+                                        daemon=True)
+                       for i in range(n_subs)]
+        storm_t = threading.Thread(target=storm_loop, daemon=True)
+        for t in threads + sub_threads:
+            t.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and not all(
+                s["up"] for s in sub_state):
+            time.sleep(0.05)
+        storm_t.start()
+        time.sleep(2.0)  # deltas flowing before anything dies
+
+        # phase 1 — the subscribed-to replica: SIGKILL mid-storm.  The
+        # hub's upstream pipes die, resync re-subscribes against the HA
+        # sibling and emits ONE catch-up delta per downstream sub with
+        # the next contiguous seq — the audit below proves no gap.
+        sup = ctl.active_supervisor
+        victim_sr = (0, 0)
+        proc = sup.procs.get(victim_sr)
+        killed_replica = False
+        if proc is not None and proc.poll() is None and any(
+                e.get("replica") != victim_sr[1] and e.get("ready")
+                for e in registry.resolve_replicas(
+                    sup.group_of(victim_sr[0]))):
+            event("chaos_kill", shard=victim_sr[0],
+                  replica=victim_sr[1], pid=proc.pid,
+                  group=sup.group_of(victim_sr[0]))
+            proc.send_signal(signal.SIGKILL)
+            killed_replica = True
+        recovered = killed_replica and wait_recovered(
+            sup, victim_sr[0], victim_sr[1],
+            proc.pid if proc else None)
+        time.sleep(1.0)  # storm keeps running through the resync
+
+        # phase 2 — the proxy: SIGKILL; its subscribers reconnect to the
+        # survivor and RESUME — replay from the survivor's ring if the
+        # spec is warm there, else a fresh-id snapshot.  Either way the
+        # audit sees a clean baseline, never a hole.
+        event("chaos_kill", proxy=0, pid=procs[0].pid,
+              group=registry.edge_group("chaos-push"))
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        time.sleep(2.0)
+
+        stop.set()  # storm off; subscribers keep draining in-flight deltas
+        for t in threads:
+            t.join(timeout=30)
+        storm_t.join(timeout=10)
+
+        # convergence: each KEY subscriber's push-built value must reach
+        # the pulled truth once the pipeline drains (bounded wait — the
+        # last deltas are still in flight when the storm stops)
+        verify = EdgeClient(endpoints=[eps[1]], proto="b2", timeout_s=10,
+                            retry=RetryPolicy(attempts=8, backoff_s=0.05,
+                                              max_backoff_s=0.5))
+        converged = {}
+        with verify:
+            deadline = time.time() + 15
+            pending = {i: s["key"] for i, s in enumerate(sub_state)
+                       if s["key"] is not None}
+            while pending and time.time() < deadline:
+                for i, key in list(pending.items()):
+                    truth = verify.query_state(ALS_STATE, key)
+                    if truth == sub_state[i]["value"]:
+                        converged[i] = True
+                        del pending[i]
+                if pending:
+                    time.sleep(0.25)
+            for i in pending:
+                converged[i] = False
+        drain_stop.set()
+        for t in sub_threads:
+            t.join(timeout=30)
+
+        audit = audit_push_sequences(audit_events, tiles=8)
+        notif, resume_counts = push_counters(ports[1:])
+        total_ok, total_err = sum(ok), sum(errs)
+        topk_deltas = sub_state[0]["pushes"]
+        summary = {
+            "mode": "push", "workers": W, "replication": replication,
+            "proxies": 2, "subscribers": n_subs,
+            "storm_writes": storm["writes"],
+            "queries": total_ok + total_err,
+            "ok": total_ok, "errors": total_err,
+            "error_sample": err_sample,
+            "availability": round(
+                total_ok / max(total_ok + total_err, 1), 6),
+            "replica_killed": killed_replica,
+            "replica_recovered": recovered,
+            "proxy_killed": procs[0].poll() is not None,
+            "pushes_delivered": audit["delivered"],
+            "missed": audit["missed"],
+            "duplicates": audit["duplicates"],
+            "audit_tiles": audit["tiles"],
+            "topk_deltas": topk_deltas,
+            "resumes": sum(s["resumes"] for s in sub_state),
+            "reconnects": sum(s["reconnects"] for s in sub_state),
+            "survivor_resumes": resume_counts,
+            "survivor_notifications": round(notif),
+            "key_converged": converged,
+            "timeline": [e for e in recent_events()
+                         if e["kind"].startswith(("chaos_", "edge_",
+                                                  "push_", "replica_"))],
+        }
+        print(json.dumps(summary, indent=1, default=str))
+        failed = (
+            total_err > 0                     # pull plane saw the chaos
+            or not killed_replica             # kill 1 never landed
+            or not recovered                  # the respawn never came back
+            or procs[0].poll() is None        # kill 2 never landed
+            or audit["delivered"] <= 0        # no deltas at all: vacuous
+            or audit["missed"] > 0            # a subscriber lost a delta
+            or audit["duplicates"] > 0        # or saw one twice
+            or topk_deltas <= 0               # shortlist plane never moved
+            or not all(converged.values())    # push-built value != truth
+        )
+        return 1 if failed else 0
+    finally:
+        stop.set()
+        drain_stop.set()
+        event("chaos_teardown", mode="push")
+        stop_edge_procs(procs)
+        ctl.stop(drop_topology=True)
+
+
 def run_with_watch(mode_fn) -> int:
     """The watch arm (CHAOS_WATCH=1, default): run the mode under a live
     ``obs.watch.FleetWatcher`` and tighten the exit gate with the alert
@@ -1830,4 +2160,5 @@ if __name__ == "__main__":
                              "autopilot": autopilot_main,
                              "region": region_main,
                              "arena": arena_main,
-                             "edge": edge_main}.get(MODE, main)))
+                             "edge": edge_main,
+                             "push": push_main}.get(MODE, main)))

@@ -1,0 +1,771 @@
+"""Push plane (serve/push.py + the edge hub, round 20): wire helpers and
+the client-observed sequence audit, literal byte pins proving pull-only
+connections are untouched on both planes while the engine is live,
+SUBSCRIBE/RESUME/UNSUB end-to-end over B2 and tab, materialized top-k
+deltas with re-score selectivity, and the zero-miss/zero-dup invariant
+through the edge hub across replica death, a live 2->4 reshard, a region
+failover, and cross-connection RESUME."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from flink_ms_tpu.obs import metrics as obs_metrics
+from flink_ms_tpu.serve import proto, registry
+from flink_ms_tpu.serve import push as push_plane
+from flink_ms_tpu.serve.client import QueryClient
+from flink_ms_tpu.serve.consumer import ALS_STATE
+from flink_ms_tpu.serve.edge import EdgeClient, EdgeProxy
+from flink_ms_tpu.serve.elastic import generation_group
+from flink_ms_tpu.serve.ha import shard_group
+from flink_ms_tpu.serve.push import (
+    apply_delta,
+    audit_push_sequences,
+    format_push,
+    parse_push,
+)
+from flink_ms_tpu.serve.server import LookupServer
+from flink_ms_tpu.serve.sharded import owner_of
+from flink_ms_tpu.serve.table import ModelTable
+from flink_ms_tpu.serve.topk import make_als_topk_handler
+
+# the 0.25-grid fixture from test_native_protocol: every product and sum
+# is exact in f32, so snapshots and deltas format deterministic scores
+ROWS = [
+    ("10-I", "1.0;0.5;-2.0;0.25"),
+    ("11-I", "0.5;0.5;0.5;0.5"),
+    ("12-I", "-1.0;2.0;1.5;-0.5"),
+    ("7-U", "1.0;2.0;0.5;-1.0"),
+]
+Q7 = "1.0;2.0;0.5;-1.0"  # 7-U's factors; TOPK k=2 -> 12:4.25;11:1.25
+
+HELLO = b"HELLO\tB2\n"
+
+
+def _server(rows=ROWS, job_id="jid"):
+    table = ModelTable(2)
+    for k, v in rows:
+        table.put(k, v)
+    srv = LookupServer(
+        {ALS_STATE: table}, host="127.0.0.1", port=0, job_id=job_id,
+        topk_handlers={ALS_STATE: make_als_topk_handler(table)},
+    ).start()
+    return srv, table
+
+
+@pytest.fixture
+def pysrv():
+    srv, table = _server()
+    srv.table = table
+    yield srv
+    srv.stop()
+
+
+def _raw(port, payload):
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as sock:
+        sock.sendall(payload)
+        sock.shutdown(socket.SHUT_WR)
+        out = b""
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                return out
+            out += chunk
+
+
+def _push_client(port, **kw):
+    return QueryClient("127.0.0.1", port, proto="b2", push=True,
+                       timeout_s=10, **kw)
+
+
+def _counter_total(name, **labels):
+    snap = obs_metrics.get_registry().snapshot()
+    out = 0.0
+    for c in snap.get("counters", []):
+        if c["name"] != name:
+            continue
+        if labels and any(c.get("labels", {}).get(k) != v
+                          for k, v in labels.items()):
+            continue
+        out += c["value"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wire helpers + the sequence audit
+# ---------------------------------------------------------------------------
+
+def test_parse_hello_push_flag():
+    assert proto.parse_hello(["HELLO", "B2"])["push"] is False
+    assert proto.parse_hello(["HELLO", "B2", "su=1"])["push"] is True
+    assert proto.parse_hello(
+        ["HELLO", "B2", "tr=1", "su=1"]) == {
+            "proto": "B2", "tenant": None, "trace": True,
+            "stale": False, "push": True}
+    # duplicate and unknown extensions stay malformed
+    assert proto.parse_hello(["HELLO", "B2", "su=1", "su=1"]) is None
+    assert proto.parse_hello(["HELLO", "B2", "su=2"]) is None
+
+
+def test_push_text_format_parse_roundtrip():
+    text = format_push("3-7", 12, "+12:10.0;-11")
+    assert text == "PUSH\t3-7\t12\t+12:10.0;-11"
+    assert proto.is_push_text(text)
+    assert parse_push(text) == ("3-7", 12, "+12:10.0;-11")
+    # the prefix is deliberately not P\t: PROFILE replies own that
+    assert not proto.is_push_text("P\tprofile-things")
+    assert not proto.is_push_text("PONG\tjid\tALS_MODEL")
+    with pytest.raises(ValueError):
+        parse_push("V\t1.0;2.0")
+
+
+def test_apply_delta_folds_shortlist():
+    shortlist = {"12": 4.25, "11": 1.25}
+    apply_delta(shortlist, "+12:10.0")
+    assert shortlist == {"12": 10.0, "11": 1.25}
+    apply_delta(shortlist, "-11;+10:12.5")
+    assert shortlist == {"12": 10.0, "10": 12.5}
+    with pytest.raises(ValueError):
+        apply_delta(shortlist, "12:4.0")
+
+
+def test_audit_clean_stream_and_resume_baselines():
+    events = [("S", "a", 0), ("P", "a", 1), ("P", "a", 2),
+              ("S", "a", 2),               # RESUME replay ack at seq 2
+              ("P", "a", 3),
+              ("S", "b", 0), ("P", "b", 1)]
+    audit = audit_push_sequences(events, tiles=4)
+    assert (audit["missed"], audit["duplicates"]) == (0, 0)
+    assert audit["subs"] == 2 and audit["delivered"] == 4
+    assert sum(t["delivered"] for t in audit["tiles"]) == 4
+
+
+def test_audit_detects_holes_and_duplicates():
+    audit = audit_push_sequences(
+        [("S", "a", 0), ("P", "a", 1), ("P", "a", 3),   # hole: 2
+         ("P", "a", 3),                                 # duplicate
+         ("P", "b", 5)])                                # no baseline
+    assert audit["missed"] == 1 + 4   # a's hole + b's missing 1..4
+    assert audit["duplicates"] == 1
+    with pytest.raises(ValueError):
+        audit_push_sequences([("X", "a", 1)])
+
+
+def test_push_freshness_survives_counter_reset():
+    """The rehearsal freshness gate folds the scrape SERIES reset-aware:
+    a generation cutover that replaces every counter-holding process
+    must not read a healthy push plane as a silent one (endpoint
+    differencing would: after - before clamps to zero)."""
+    from flink_ms_tpu.obs.scrape import push_freshness
+
+    def snap(deltas, hist_count):
+        le = [0.001, 0.01, 0.1]
+        counts = [hist_count, 0, 0, 0]  # all observations under 1ms
+        return {
+            "counters": [{"name": "tpums_push_deltas_total",
+                          "labels": {"state": "S", "kind": "KEY"},
+                          "value": deltas}],
+            "histograms": [{"name": "tpums_push_latency_seconds",
+                            "labels": {"state": "S"}, "le": le,
+                            "counts": counts, "count": hist_count,
+                            "sum": hist_count * 0.0005}],
+        }
+
+    # gen 1 climbs to 40, cutover resets to 0, gen 2 climbs to 6
+    series = [(0.0, snap(0, 0)), (1.0, snap(25, 25)),
+              (2.0, snap(40, 40)), (3.0, snap(0, 0)),
+              (4.0, snap(6, 6))]
+    out = push_freshness(series)
+    assert out["deltas"] == 46 and out["dt_s"] == 4.0
+    assert out["p99_s"] is not None and out["p99_s"] <= 0.001
+    # the endpoint pair alone would have seen nothing
+    from flink_ms_tpu.obs.scrape import fleet_signals
+    sig = fleet_signals(series[0][1], series[-1][1])
+    assert sig["push_p99_s"] is not None  # 6 post-reset obs survive...
+    assert sig["push_deltas_per_s"] * sig["dt_s"] < out["deltas"]
+    # empty / single-sample series degrade to "no evidence", not a crash
+    assert push_freshness([])["p99_s"] is None
+    assert push_freshness([(0.0, snap(9, 9))])["deltas"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# pull-only byte identity: the opt-in costs unsubscribed clients nothing
+# ---------------------------------------------------------------------------
+
+_PULL_TAB_REQUESTS = (
+    b"GET\tALS_MODEL\t7-U\n"
+    b"TOPK\tALS_MODEL\t7\t2\n"
+    b"SUBSCRIBE\n"          # malformed arity -> the generic error
+    b"PING\n"
+)
+_PULL_TAB_REPLIES = (
+    b"V\t1.0;2.0;0.5;-1.0\n"
+    b"V\t12:4.25;11:1.25\n"
+    b"E\tbad request\n"
+    b"PONG\tjid\tALS_MODEL\n"
+)
+# literal frame bytes, NOT computed: if the B2 plane's framing or reply
+# rendering drifts for pull-only clients, this fails even if the codec
+# helpers drift in sympathy
+_PULL_B2_REQUEST = (
+    HELLO
+    + b"B2 \x03\x01\tALS_MODEL\x037-U\x03\tALS_MODEL\x017\x012\t"
+)
+_PULL_B2_REPLIES = (
+    HELLO
+    + b"B2\x39\x03"                 # one frame, three replies
+    + b"\x12V\t1.0;2.0;0.5;-1.0"
+    + b"\x11V\t12:4.25;11:1.25"
+    + b"\x12PONG\tjid\tALS_MODEL"
+)
+
+
+def test_pull_only_bytes_pinned_while_engine_live(pysrv):
+    """Both pull planes answer byte-identically even while the SAME
+    server holds a live subscription and is streaming deltas."""
+    with _push_client(pysrv.port) as sub_c:
+        sub_c.subscribe_key(ALS_STATE, "11-I")
+        assert _raw(pysrv.port, _PULL_TAB_REQUESTS) == _PULL_TAB_REPLIES
+        assert _raw(pysrv.port, _PULL_B2_REQUEST) == _PULL_B2_REPLIES
+        # the engine really was live: the pull exchanges above did not
+        # swallow the subscriber's delta
+        pysrv.table.put("11-I", "2.0;2.0;2.0;2.0")
+        msg = sub_c.next_push(timeout_s=5.0)
+        assert msg is not None and msg[2] == "2.0;2.0;2.0;2.0"
+
+
+def test_pull_only_client_request_bytes_pinned():
+    """A pull-only QueryClient/EdgeClient (push defaulted off) puts
+    exactly the frozen bytes on the wire — no su=1, no framing drift."""
+    captured = []
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def serve():
+        conn, _ = lsock.accept()
+        with conn, conn.makefile("rb") as f:
+            line = f.readline()
+            captured.append(line)
+            conn.sendall(b"V\t1.0;2.0\n")
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        with EdgeClient(endpoints=[("127.0.0.1", port)],
+                        timeout_s=10) as c:
+            c.query_state(ALS_STATE, "7-U")
+        t.join(timeout=5)
+    finally:
+        lsock.close()
+    assert captured == [b"GET\tALS_MODEL\t7-U\n"]
+
+
+def test_b2_subscribe_without_su_refused(pysrv):
+    """SUBSCRIBE on a B2 connection that did not send su=1 is the
+    pinned generic error — subscribing is strictly opt-in."""
+    body = proto.encode_request_frame(
+        [f"SUBSCRIBE\t{ALS_STATE}\tKEY\t10-I\t0"])
+    out = _raw(pysrv.port, HELLO + body)
+    assert out == HELLO + b"B2\x0f\x01\rE\tbad request"
+
+
+def _native_available():
+    from flink_ms_tpu.serve import native_store
+
+    try:
+        native_store._load_lib()
+        return True
+    except (OSError, RuntimeError):
+        return False
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native toolchain/libtpums.so unavailable")
+def test_native_plane_refuses_push_hello(tmp_path):
+    """The C++ plane never learned su=1 — the unknown-extension HELLO is
+    refused identically on both planes (stays tab, generic error) and
+    the native pull path is untouched."""
+    from flink_ms_tpu.serve.native_store import (NativeLookupServer,
+                                                 NativeStore)
+
+    store = NativeStore(str(tmp_path / "store"))
+    for k, v in ROWS:
+        store.put(k, v)
+    srv_py, _ = _server()
+    try:
+        with NativeLookupServer(store, ALS_STATE, job_id="jid", port=0,
+                                topk_suffixes=("-I", "-U")) as nsrv:
+            nat = _raw(nsrv.port, b"HELLO\tB2\tsu=1\nPING\n")
+            # refused exactly like the Python plane refuses an UNKNOWN
+            # extension: generic error, the connection stays tab
+            assert nat == _raw(srv_py.port, b"HELLO\tB2\txx=1\nPING\n")
+            assert nat.startswith(b"E\tbad request\n")
+            # the native pull path is untouched by the push plane
+            assert _raw(nsrv.port, _PULL_TAB_REQUESTS) == \
+                _PULL_TAB_REPLIES
+    finally:
+        srv_py.stop()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# SUBSCRIBE / UNSUB / RESUME end-to-end (direct B2 connection)
+# ---------------------------------------------------------------------------
+
+def test_subscribe_key_snapshot_delta_monotone_seq(pysrv):
+    with _push_client(pysrv.port) as c:
+        sub = c.subscribe_key(ALS_STATE, "10-I")
+        assert sub["seq"] == 0
+        assert sub["snapshot"] == "1.0;0.5;-2.0;0.25"
+        pysrv.table.put("10-I", "5.0;5.0;5.0;5.0")
+        assert c.next_push(timeout_s=5.0) == (
+            sub["sub_id"], 1, "5.0;5.0;5.0;5.0")
+        pysrv.table.put("10-I", "6.0;6.0;6.0;6.0")
+        assert c.next_push(timeout_s=5.0) == (
+            sub["sub_id"], 2, "6.0;6.0;6.0;6.0")
+
+
+def test_subscribe_topk_materialized_delta_folds_to_truth(pysrv):
+    with _push_client(pysrv.port) as c:
+        sub = c.subscribe_topk(ALS_STATE, Q7, 2)
+        assert sub["snapshot"] == "12:4.25;11:1.25"
+        shortlist = {}
+        apply_delta(shortlist, ";".join(
+            f"+{e}" for e in sub["snapshot"].split(";")))
+        pysrv.table.put("12-I", "2.0;4.0;1.0;0.5")  # q.12 -> 10.0
+        sid, seq, payload = c.next_push(timeout_s=5.0)
+        assert (sid, seq, payload) == (sub["sub_id"], 1, "+12:10.0")
+        apply_delta(shortlist, payload)
+        # the folded client shortlist equals a fresh materialization
+        fresh = c.subscribe_topk(ALS_STATE, Q7, 2)
+        assert shortlist == {item: float(s) for item, s in
+                             (e.rsplit(":", 1)
+                              for e in fresh["snapshot"].split(";"))}
+
+
+def test_pull_queries_interleave_with_pushes(pysrv):
+    with _push_client(pysrv.port) as c:
+        sub = c.subscribe_key(ALS_STATE, "11-I")
+        pysrv.table.put("11-I", "1.5;1.5;1.5;1.5")
+        # the pull reply routes around the buffered push...
+        assert c.query_state(ALS_STATE, "11-I") == "1.5;1.5;1.5;1.5"
+        # ...and the push is still delivered, in order
+        assert c.next_push(timeout_s=5.0) == (
+            sub["sub_id"], 1, "1.5;1.5;1.5;1.5")
+
+
+def test_unsubscribe_stops_deltas(pysrv):
+    with _push_client(pysrv.port) as c:
+        sub = c.subscribe_key(ALS_STATE, "10-I")
+        c.unsubscribe(sub["sub_id"])
+        pysrv.table.put("10-I", "9.0;9.0;9.0;9.0")
+        assert c.next_push(timeout_s=0.4) is None
+        with pytest.raises(RuntimeError):
+            c.unsubscribe(sub["sub_id"])  # unknown now
+
+
+def test_resume_replay_rebinds_live_subscription(pysrv):
+    """A second connection RESUMEs a live subscription: the ring replays
+    exactly the cursor gap and later deltas follow to the NEW conn."""
+    c1 = _push_client(pysrv.port)
+    sub = c1.subscribe_key(ALS_STATE, "10-I")
+    pysrv.table.put("10-I", "5.0;5.0;5.0;5.0")
+    assert c1.next_push(timeout_s=5.0)[1] == 1
+    with _push_client(pysrv.port) as c2:
+        r = c2.resume_subscription(ALS_STATE, "KEY", "10-I", 0,
+                                   sub["sub_id"], 0)
+        assert r == {"mode": "replay", "sub_id": sub["sub_id"], "seq": 0}
+        assert c2.next_push(timeout_s=5.0) == (
+            sub["sub_id"], 1, "5.0;5.0;5.0;5.0")
+        c1.close()  # the old conn's death must not kill the rebound sub
+        pysrv.table.put("10-I", "6.0;6.0;6.0;6.0")
+        assert c2.next_push(timeout_s=5.0) == (
+            sub["sub_id"], 2, "6.0;6.0;6.0;6.0")
+
+
+def test_resume_unknown_falls_back_to_fresh_snapshot(pysrv):
+    """A cursor nothing can bridge -> a FRESH subscription whose
+    snapshot is the catch-up (zero-miss without replay)."""
+    with _push_client(pysrv.port) as c:
+        r = c.resume_subscription(ALS_STATE, "KEY", "10-I", 0,
+                                  "999-1", 7)
+        assert r["mode"] == "snapshot"
+        assert r["sub_id"] != "999-1" and r["seq"] == 0
+        assert r["snapshot"] == "1.0;0.5;-2.0;0.25"
+        # the audit treats the fresh baseline as a clean stream
+        audit = audit_push_sequences([("S", r["sub_id"], r["seq"])])
+        assert (audit["missed"], audit["duplicates"]) == (0, 0)
+
+
+def test_tab_subscribe_self_opts_in():
+    """Sending SUBSCRIBE on a tab connection IS the opt-in: the S reply
+    and newline-framed PUSH lines arrive on the same socket."""
+    srv, table = _server()
+    try:
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=10) as sock:
+            f = sock.makefile("rb")
+            sock.sendall(b"SUBSCRIBE\tALS_MODEL\tKEY\t10-I\t0\n")
+            reply = f.readline().decode("utf-8").rstrip("\n")
+            assert reply.startswith("S\t")
+            sub_id = reply.split("\t")[1]
+            table.put("10-I", "3.0;3.0;3.0;3.0")
+            assert f.readline().decode("utf-8").rstrip("\n") == \
+                f"PUSH\t{sub_id}\t1\t3.0;3.0;3.0;3.0"
+    finally:
+        srv.stop()
+
+
+def test_rescore_selectivity_narrows_to_intersecting_subs(pysrv):
+    """One dirty item re-scores only subscriptions whose shortlist holds
+    it (member index) or that it could enter (entrant filter) — never
+    the whole population."""
+    eng = None
+    clients = []
+    try:
+        # 8 subscriptions whose k=1 shortlists pin to distinct items
+        for q in ("1.0;0.0;0.0;0.0", "0.0;1.0;0.0;0.0",
+                  "0.0;0.0;1.0;0.0", "0.0;0.0;0.0;1.0",
+                  "-1.0;0.0;0.0;0.0", "0.0;-1.0;0.0;0.0",
+                  "0.0;0.0;-1.0;0.0", "0.0;0.0;0.0;-1.0"):
+            c = _push_client(pysrv.port)
+            c.subscribe_topk(ALS_STATE, q, 1)
+            clients.append(c)
+        eng = pysrv._push_engine
+        b0, c0, t0 = eng.batches, eng.candidates, eng.candidate_total
+        pysrv.table.put("11-I", "0.5;0.5;0.5;0.25")  # small nudge
+        deadline = time.time() + 10
+        while eng.candidate_total == t0 and time.time() < deadline:
+            time.sleep(0.02)
+        population = eng.candidate_total - t0
+        candidates = eng.candidates - c0
+        assert population >= 8
+        assert 0 < candidates < population
+    finally:
+        for c in clients:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# client tolerance: unsolicited push frames between replies (fake server)
+# ---------------------------------------------------------------------------
+
+class _PushyFakeServer:
+    """A one-connection B2 server that injects an unsolicited PUSH frame
+    BEFORE every reply — the torture case for reply routing."""
+
+    def __init__(self, replies):
+        self._replies = list(replies)
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(1)
+        self.port = self._srv.getsockname()[1]
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        conn, _ = self._srv.accept()
+        with conn:
+            hello = b""
+            while not hello.endswith(b"\n"):  # byte-wise: no buffer theft
+                b_ = conn.recv(1)
+                if not b_:
+                    return
+                hello += b_
+            conn.sendall(HELLO)
+            n = 0
+            buf = b""
+            while self._replies:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while self._replies:
+                    res = proto.decode_request_frame(buf, 0)
+                    if res is None:
+                        break
+                    _, pos = res
+                    buf = buf[pos:]
+                    n += 1
+                    conn.sendall(proto.encode_reply_frame(
+                        [format_push("9-1", n, f"payload{n}")]))
+                    conn.sendall(proto.encode_reply_frame(
+                        [self._replies.pop(0)]))
+            time.sleep(0.2)
+
+    def close(self):
+        self._srv.close()
+
+
+@pytest.mark.parametrize("client_cls", ["query", "edge"])
+def test_reader_loop_tolerates_unsolicited_push_frames(client_cls):
+    fake = _PushyFakeServer(["V\t1.0;2.0", "C\t4"])
+    try:
+        if client_cls == "edge":
+            c = EdgeClient(endpoints=[("127.0.0.1", fake.port)],
+                           proto="b2", push=True, timeout_s=10)
+        else:
+            c = _push_client(fake.port)
+        with c:
+            # each reply is preceded by a push frame: replies still
+            # pair with their requests, pushes queue for next_push
+            assert c.query_state(ALS_STATE, "7-U") == "1.0;2.0"
+            assert c.count(ALS_STATE) == 4
+            assert c.next_push(timeout_s=1.0) == ("9-1", 1, "payload1")
+            assert c.next_push(timeout_s=1.0) == ("9-1", 2, "payload2")
+    finally:
+        fake.close()
+
+
+# ---------------------------------------------------------------------------
+# the edge hub: dedup fan-out, resync across deaths, RESUME across conns
+# ---------------------------------------------------------------------------
+
+def _register_worker(srv, group, gen=1, shard=0, replica=0):
+    registry.register(
+        f"w:{group}@g{gen}:s{shard}r{replica}:{srv.port}",
+        "127.0.0.1", srv.port, ALS_STATE,
+        replica_of=shard_group(generation_group(group, gen), shard),
+        replica=replica, ready=True, ttl_s=300.0)
+
+
+def _edge_push_client(proxy, **kw):
+    return EdgeClient(endpoints=[("127.0.0.1", proxy.port)],
+                      proto="b2", push=True, timeout_s=10, **kw)
+
+
+def _collect(c, n, timeout_s=20.0):
+    """Drain n pushes (or time out) -> list of (sub_id, seq, payload)."""
+    out = []
+    deadline = time.time() + timeout_s
+    while len(out) < n and time.time() < deadline:
+        msg = c.next_push(timeout_s=0.25)
+        if msg is not None:
+            out.append(msg)
+    return out
+
+
+def test_edge_hub_dedups_fanout_one_upstream_many_downstream():
+    group = "push-fan"
+    srv, table = _server()
+    proxy = None
+    clients = []
+    try:
+        _register_worker(srv, group)
+        registry.publish_topology(group, 1)
+        proxy = EdgeProxy(group, register=False, hedge=False).start()
+        up0 = _counter_total("tpums_push_upstream_deltas_total")
+        no0 = _counter_total("tpums_push_notifications_total")
+        clients = [_edge_push_client(proxy) for _ in range(3)]
+        subs = [c.subscribe_key(ALS_STATE, "10-I") for c in clients]
+        assert len({s["sub_id"] for s in subs}) == 3  # per-client ids
+        table.put("10-I", "4.0;4.0;4.0;4.0")
+        events = []
+        for c, s in zip(clients, subs):
+            events.append(("S", s["sub_id"], s["seq"]))
+            (got,) = _collect(c, 1)
+            assert got[2] == "4.0;4.0;4.0;4.0"
+            events.append(("P", got[0], got[1]))
+        audit = audit_push_sequences(events)
+        assert (audit["missed"], audit["duplicates"]) == (0, 0)
+        # N downstream notifications per ONE upstream delta
+        assert _counter_total("tpums_push_upstream_deltas_total") - up0 \
+            == 1
+        assert _counter_total("tpums_push_notifications_total") - no0 \
+            == 3
+    finally:
+        for c in clients:
+            c.close()
+        if proxy is not None:
+            proxy.stop()
+        srv.stop()
+
+
+def test_edge_resume_replays_exact_gap_across_connections():
+    """Downstream conn dies; the hub ring keeps accumulating; RESUME on
+    a fresh conn replays exactly the missed seqs — nothing more."""
+    group = "push-resume"
+    srv, table = _server()
+    proxy = None
+    try:
+        _register_worker(srv, group)
+        registry.publish_topology(group, 1)
+        proxy = EdgeProxy(group, register=False, hedge=False).start()
+        c1 = _edge_push_client(proxy)
+        sub = c1.subscribe_key(ALS_STATE, "10-I")
+        table.put("10-I", "1.0;1.0;1.0;1.0")
+        assert _collect(c1, 1)[0][1] == 1
+        c1.close()
+        table.put("10-I", "2.0;2.0;2.0;2.0")  # accumulates unbound
+        with _edge_push_client(proxy) as c2:
+            deadline = time.time() + 10
+            while True:  # the hub needs a beat to ingest the delta
+                r = c2.resume_subscription(ALS_STATE, "KEY", "10-I", 0,
+                                           sub["sub_id"], 1)
+                if r["mode"] == "replay":
+                    got = _collect(c2, 1)
+                    if got and got[0] == (sub["sub_id"], 2,
+                                          "2.0;2.0;2.0;2.0"):
+                        break
+                assert time.time() < deadline, r
+                time.sleep(0.1)
+            # a cursor nothing holds -> fresh-id snapshot fallback
+            r = c2.resume_subscription(ALS_STATE, "KEY", "10-I", 0,
+                                       "bogus-9", 3)
+            assert r["mode"] == "snapshot"
+            assert r["sub_id"] != sub["sub_id"]
+            assert r["snapshot"] == "2.0;2.0;2.0;2.0"
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        srv.stop()
+
+
+def _await_catchup(c, events, expect, timeout_s=25.0):
+    """Collect pushes until each predicate in ``expect`` matched one, in
+    order, appending every push to the audit event log."""
+    deadline = time.time() + timeout_s
+    want = list(expect)
+    while want and time.time() < deadline:
+        msg = c.next_push(timeout_s=0.25)
+        if msg is None:
+            continue
+        events.append(("P", msg[0], msg[1]))
+        if want and want[0](msg):
+            want.pop(0)
+    assert not want, f"missed expected pushes, {len(want)} left"
+
+
+def test_edge_resync_bridges_replica_death_zero_gap():
+    """HA kill: the subscribed-to replica dies; the hub re-subscribes
+    against its sibling and emits ONE catch-up delta on the SAME sub id
+    with the next contiguous seq — no hole, no duplicate."""
+    group = "push-ha"
+    srv_a, table_a = _server(job_id="r0")
+    srv_b, table_b = _server(job_id="r1")
+    proxy = None
+    clients = []
+    try:
+        _register_worker(srv_a, group, shard=0, replica=0)
+        registry.publish_topology(group, 1)
+        proxy = EdgeProxy(group, register=False, hedge=False).start()
+        clients = [_edge_push_client(proxy) for _ in range(2)]
+        events = []
+        subs = []
+        for c in clients:
+            s = c.subscribe_key(ALS_STATE, "10-I")
+            subs.append(s)
+            events.append(("S", s["sub_id"], s["seq"]))
+        table_a.put("10-I", "1.0;1.0;1.0;1.0")
+        for c in clients:
+            (got,) = _collect(c, 1)
+            events.append(("P", got[0], got[1]))
+        # the sibling holds newer state; the primary dies
+        table_b.put("10-I", "7.0;7.0;7.0;7.0")
+        _register_worker(srv_b, group, shard=0, replica=1)
+        srv_a.stop()
+        for c, s in zip(clients, subs):
+            _await_catchup(
+                c, events,
+                [lambda m, sid=s["sub_id"]:
+                 m[0] == sid and m[2] == "7.0;7.0;7.0;7.0"])
+        audit = audit_push_sequences(events)
+        assert (audit["missed"], audit["duplicates"]) == (0, 0)
+        assert _counter_total("tpums_push_upstream_resyncs_total") > 0
+    finally:
+        for c in clients:
+            c.close()
+        if proxy is not None:
+            proxy.stop()
+        srv_b.stop()
+
+
+def test_edge_resync_bridges_live_reshard_2_to_4():
+    """2->4 reshard under a live TOPK subscription: gen-1 workers drain
+    and die, the hub re-subscribes against the gen-2 topology, and the
+    merged shortlist converges with contiguous seqs."""
+    group = "push-reshard"
+    gen1 = [_server(job_id=f"g1s{s}")[0:2] for s in range(2)]
+    gen2 = []
+    proxy = None
+    c = None
+    try:
+        for s, (srv, _) in enumerate(gen1):
+            _register_worker(srv, group, gen=1, shard=s)
+        registry.publish_topology(group, 2)
+        proxy = EdgeProxy(group, register=False, hedge=False).start()
+        c = _edge_push_client(proxy)
+        events = []
+        sub = c.subscribe_topk(ALS_STATE, Q7, 2)
+        events.append(("S", sub["sub_id"], sub["seq"]))
+        # a delta flows on gen 1 first (both shards hold the full
+        # fixture, so the merged union stays consistent)
+        for _, table in gen1:
+            table.put("12-I", "2.0;4.0;1.0;0.5")  # q.12 -> 10.0
+        _await_catchup(c, events,
+                       [lambda m: "+12:10.0" in m[2]])
+        # gen 2: four workers seeded with CHANGED state (10-I enters)
+        rows2 = [("10-I", "5.0;5.0;5.0;5.0"), ("11-I", "0.5;0.5;0.5;0.5"),
+                 ("12-I", "2.0;4.0;1.0;0.5"), ("7-U", Q7)]
+        gen2 = [_server(rows=rows2, job_id=f"g2s{s}")[0]
+                for s in range(4)]
+        for s, srv in enumerate(gen2):
+            _register_worker(srv, group, gen=2, shard=s)
+        registry.publish_topology(group, 4)
+        for srv, _ in gen1:
+            srv.stop()  # the cutover: gen-1 pipes die, resync follows
+        # catch-up: 10-I (q.10 = 12.5) displaces 11 in the k=2 shortlist
+        _await_catchup(c, events,
+                       [lambda m: "+10:12.5" in m[2]])
+        audit = audit_push_sequences(events)
+        assert (audit["missed"], audit["duplicates"]) == (0, 0)
+    finally:
+        if c is not None:
+            c.close()
+        if proxy is not None:
+            proxy.stop()
+        for srv, _ in gen1:
+            srv.stop()
+        for srv in gen2:
+            srv.stop()
+
+
+def test_edge_resync_bridges_region_failover():
+    """Region failover: the home fleet vanishes wholesale and a promoted
+    follower (same group, new endpoint, newer state) takes over — the
+    subscription stream stays gapless on the same sub id."""
+    group = "push-region"
+    home, home_table = _server(job_id="home")
+    follower, follower_table = _server(job_id="follower")
+    proxy = None
+    c = None
+    try:
+        _register_worker(home, group, gen=1, shard=0)
+        registry.publish_topology(group, 1)
+        proxy = EdgeProxy(group, register=False, hedge=False).start()
+        c = _edge_push_client(proxy)
+        events = []
+        sub = c.subscribe_key(ALS_STATE, "12-I")
+        events.append(("S", sub["sub_id"], sub["seq"]))
+        home_table.put("12-I", "1.0;1.0;1.0;1.0")
+        _await_catchup(c, events, [lambda m: m[2] == "1.0;1.0;1.0;1.0"])
+        # the follower replicated past the home's last visible write
+        follower_table.put("12-I", "8.0;8.0;8.0;8.0")
+        _register_worker(follower, group, gen=2, shard=0)
+        registry.publish_topology(group, 1)
+        home.stop()  # the whole home region goes dark
+        _await_catchup(c, events,
+                       [lambda m, sid=sub["sub_id"]:
+                        m[0] == sid and m[2] == "8.0;8.0;8.0;8.0"])
+        audit = audit_push_sequences(events)
+        assert (audit["missed"], audit["duplicates"]) == (0, 0)
+    finally:
+        if c is not None:
+            c.close()
+        if proxy is not None:
+            proxy.stop()
+        follower.stop()
